@@ -1,0 +1,2 @@
+from .pipeline import build_step, StepOut  # noqa: F401
+from .replay import replay, ReplayResult  # noqa: F401

@@ -1,0 +1,176 @@
+"""The scheduling cycle as one fused tensor program.
+
+The reference's hot path (SURVEY.md §3.2) is, per pod:
+
+    RunPreFilterPlugins -> Filter x (plugins x nodes) [16 goroutines]
+    -> RunPreScorePlugins -> Score x (plugins x nodes) -> NormalizeScore
+    -> weights -> selectHost -> Reserve/Bind
+
+Here `build_step(cw)` composes, at trace time, the enabled plugins' tensor
+kernels into a single step function
+
+    step(carry, xs_slice) -> (carry', StepOut)
+
+with NO plugin dispatch on device: XLA sees one fused program over [N]-
+shaped arrays.  `lax.scan`ning it over the pod axis replays a whole queue
+in one XLA call (framework/replay.py), because scheduling is inherently
+sequential across pods — each bind mutates node state — while fully
+parallel across nodes and plugins.
+
+Fidelity notes
+  * Filter plugins run in upstream order; the framework stops at the first
+    failing plugin per node — all masks are computed here (cheaper than
+    branching on TPU) and the stop-at-first-fail truncation is
+    reconstructed by the annotation decoder (store/decode.py).
+  * Scoring runs only when >1 node is feasible (upstream schedulePod
+    returns early on a single feasible node); on device we always compute
+    and the decoder drops the results, but selection respects it.
+  * Host selection: highest weighted-normalized total; ties broken by
+    LOWEST node index (upstream picks randomly among ties via reservoir
+    sampling — deterministic tie-break is this framework's documented
+    divergence, applied identically in the CPU reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+
+from ..plugins import affinity, interpod, noderesources, taints, topologyspread
+from ..plugins.registry import PLUGIN_REGISTRY
+from ..state.compile import CompiledWorkload
+
+
+class StepOut(NamedTuple):
+    filter_codes: jnp.ndarray  # [F, N] int32, 0 == pass (already skip-masked)
+    score_raw: jnp.ndarray     # [S, N] int32
+    score_final: jnp.ndarray   # [S, N] int32 (normalized x weight)
+    selected: jnp.ndarray      # int32, -1 == unschedulable
+    feasible_count: jnp.ndarray  # int32
+
+
+def _filter_one(name: str, cw: CompiledWorkload, carry, sl) -> jnp.ndarray:
+    if name == "NodeResourcesFit":
+        return noderesources.fit_filter(cw.statics["core"], sl["core"], carry["core"])
+    if name == "NodeAffinity":
+        return affinity.filter_kernel(sl["NodeAffinity"])
+    if name == "TaintToleration":
+        return taints.taint_filter(sl["TaintToleration"])
+    if name == "NodeUnschedulable":
+        return taints.unsched_filter(sl["NodeUnschedulable"])
+    if name == "NodeName":
+        return taints.nodename_filter(sl["NodeName"])
+    if name == "PodTopologySpread":
+        return topologyspread.filter_kernel(
+            cw.statics["PodTopologySpread"], sl["PodTopologySpread"], carry["PodTopologySpread"]
+        )
+    if name == "InterPodAffinity":
+        return interpod.filter_kernel(
+            cw.statics["InterPodAffinity"], sl["InterPodAffinity"], carry["InterPodAffinity"]
+        )
+    raise ValueError(f"no filter kernel for {name}")
+
+
+def _score_one(name: str, cw: CompiledWorkload, carry, sl, feasible):
+    """-> (raw int64 [N], normalized int64 [N])."""
+    if name == "NodeResourcesFit":
+        raw = noderesources.fit_score(cw.statics["core"], sl["core"], carry["core"])
+        return raw, raw  # no ScoreExtensions
+    if name == "NodeResourcesBalancedAllocation":
+        raw = noderesources.balanced_score(cw.statics["core"], sl["core"], carry["core"])
+        return raw, raw  # no ScoreExtensions
+    if name == "NodeAffinity":
+        raw = affinity.score_kernel(sl["NodeAffinity"])
+        return raw, affinity.normalize(raw, feasible)
+    if name == "TaintToleration":
+        raw = taints.taint_score(sl["TaintToleration"])
+        return raw, taints.taint_normalize(raw, feasible)
+    if name == "PodTopologySpread":
+        raw, ignored = topologyspread.score_kernel(
+            cw.statics["PodTopologySpread"], sl["PodTopologySpread"], carry["PodTopologySpread"]
+        )
+        return raw, topologyspread.normalize(raw, ignored, feasible)
+    if name == "InterPodAffinity":
+        raw = interpod.score_kernel(
+            cw.statics["InterPodAffinity"], sl["InterPodAffinity"], carry["InterPodAffinity"]
+        )
+        return raw, interpod.normalize(raw, feasible)
+    raise ValueError(f"no score kernel for {name}")
+
+
+def build_step(cw: CompiledWorkload):
+    """Returns step(carry_dict, xs_slice_dict) -> (carry', StepOut)."""
+    cfg = cw.config
+    filter_names = cfg.filters()
+    score_names = cfg.scorers()
+    weights = jnp.asarray([cfg.weight(n) for n in score_names], dtype=jnp.int64)
+
+    def step(carry: dict[str, Any], sl: dict[str, Any]):
+        n = cw.n_nodes
+
+        codes = []
+        feasible = jnp.ones(n, dtype=bool)
+        for name in filter_names:
+            code = _filter_one(name, cw, carry, sl)
+            x = sl.get(name)
+            if x is not None and hasattr(x, "filter_skip"):
+                code = jnp.where(x.filter_skip, 0, code)
+            codes.append(code)
+            feasible = feasible & (code == 0)
+        filter_codes = (
+            jnp.stack(codes) if codes else jnp.zeros((0, n), dtype=jnp.int32)
+        )
+
+        raws, finals = [], []
+        total = jnp.zeros(n, dtype=jnp.int64)
+        for i, name in enumerate(score_names):
+            raw, normed = _score_one(name, cw, carry, sl, feasible)
+            final = normed * weights[i]
+            x = sl.get(name)
+            if x is not None and hasattr(x, "score_skip"):
+                skip = x.score_skip
+                raw = jnp.where(skip, 0, raw)
+                final = jnp.where(skip, 0, final)
+            raws.append(raw)
+            finals.append(final)
+            total = total + final
+        score_raw = (
+            jnp.stack(raws) if raws else jnp.zeros((0, n), dtype=jnp.int64)
+        )
+        score_final = (
+            jnp.stack(finals) if finals else jnp.zeros((0, n), dtype=jnp.int64)
+        )
+
+        feasible_count = jnp.sum(feasible, dtype=jnp.int32)
+        total = jnp.where(feasible, total, jnp.int64(-1))
+        selected = jnp.argmax(total).astype(jnp.int32)  # first max == lowest index
+        selected = jnp.where(feasible_count > 0, selected, jnp.int32(-1))
+        is_pad = sl.get("is_pad")
+        if is_pad is not None:
+            selected = jnp.where(is_pad, jnp.int32(-1), selected)
+
+        # --- bind: update carries --------------------------------------
+        new_carry = dict(carry)
+        new_carry["core"] = noderesources.core_bind_update(carry["core"], sl["core"], selected)
+        if "PodTopologySpread" in carry:
+            new_carry["PodTopologySpread"] = topologyspread.bind_update(
+                cw.statics["PodTopologySpread"], sl["PodTopologySpread"],
+                carry["PodTopologySpread"], selected,
+            )
+        if "InterPodAffinity" in carry:
+            new_carry["InterPodAffinity"] = interpod.bind_update(
+                cw.statics["InterPodAffinity"], sl["InterPodAffinity"],
+                carry["InterPodAffinity"], selected,
+            )
+
+        out = StepOut(
+            filter_codes=filter_codes.astype(jnp.int32),
+            score_raw=score_raw.astype(jnp.int32),
+            score_final=score_final.astype(jnp.int32),
+            selected=selected,
+            feasible_count=feasible_count,
+        )
+        return new_carry, out
+
+    return step

@@ -1,0 +1,101 @@
+"""NodeAffinity tensor kernels.
+
+Upstream v1.32 pkg/scheduler/framework/plugins/nodeaffinity.  Both the
+Filter predicate (pod.spec.nodeSelector AND
+requiredDuringSchedulingIgnoredDuringExecution) and the Score raw value
+(sum of weights of matching preferredDuringScheduling terms) depend only on
+node labels — static during a replay — so both are precompiled host-side
+into dense [P, N] arrays; the device kernels are pure gathers.
+
+Recording semantics (reference shim):
+* Filter fail message: "node(s) didn't match Pod's node affinity/selector"
+  (upstream ErrReasonPod).
+* PreFilter returns Skip when the pod has neither nodeSelector nor required
+  affinity -> its Filter is skipped by the framework (no filter-result
+  entries for this plugin on any node).
+* PreScore returns Skip when the pod has no preferred terms -> no
+  score-result entries.
+* ScoreExtensions: DefaultNormalizeScore(100, reverse=false).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import default_normalize_score
+from ..state.nodes import NodeTable
+from ..state.selectors import node_selector_matches, node_selector_term_matches, node_labels_as_strings
+
+NAME = "NodeAffinity"
+ERR_REASON = "node(s) didn't match Pod's node affinity/selector"
+
+
+class NodeAffinityXS(NamedTuple):
+    required_ok: jnp.ndarray    # [P, N] bool
+    pref_raw: jnp.ndarray       # [P, N] int32
+    filter_skip: jnp.ndarray    # [P] bool (PreFilter returned Skip)
+    score_skip: jnp.ndarray     # [P] bool (PreScore returned Skip)
+
+
+def build(table: NodeTable, pods: list[dict], vocab) -> NodeAffinityXS:
+    n, p = table.n, len(pods)
+    labels = node_labels_as_strings(table, vocab)
+    required_ok = np.ones((p, n), dtype=bool)
+    pref_raw = np.zeros((p, n), dtype=np.int32)
+    filter_skip = np.zeros(p, dtype=bool)
+    score_skip = np.zeros(p, dtype=bool)
+
+    for i, pod in enumerate(pods):
+        spec = pod.get("spec") or {}
+        node_sel = spec.get("nodeSelector") or {}
+        aff = ((spec.get("affinity") or {}).get("nodeAffinity")) or {}
+        required = aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+        preferred = aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+
+        if not node_sel and not required:
+            filter_skip[i] = True
+        else:
+            for j in range(n):
+                ok = True
+                if node_sel:
+                    ok = all(labels[j].get(k) == str(v) for k, v in node_sel.items())
+                if ok and required:
+                    ok = node_selector_matches(required, labels[j], table.names[j])
+                required_ok[i, j] = ok
+
+        if not preferred:
+            score_skip[i] = True
+        else:
+            for j in range(n):
+                s = 0
+                for term in preferred:
+                    w = int(term.get("weight", 0))
+                    if node_selector_term_matches(term.get("preference") or {}, labels[j], table.names[j]):
+                        s += w
+                pref_raw[i, j] = s
+
+    return NodeAffinityXS(
+        required_ok=jnp.asarray(required_ok),
+        pref_raw=jnp.asarray(pref_raw),
+        filter_skip=jnp.asarray(filter_skip),
+        score_skip=jnp.asarray(score_skip),
+    )
+
+
+def filter_kernel(pod_xs) -> jnp.ndarray:
+    return jnp.where(pod_xs.required_ok, 0, 1).astype(jnp.int32)
+
+
+def score_kernel(pod_xs) -> jnp.ndarray:
+    return pod_xs.pref_raw.astype(jnp.int64)
+
+
+def normalize(raw, feasible):
+    return default_normalize_score(raw, feasible, reverse=False)
+
+
+def decode_filter(code: int, node_idx: int, host_aux) -> str:
+    return ERR_REASON

@@ -1,0 +1,58 @@
+"""Plugin kernel protocol.
+
+A *plugin* in the reference is a Go object implementing some of the 12
+scheduling-framework extension points, wrapped by the recording shim
+(reference: simulator/scheduler/plugin/wrappedplugin.go:253-364).  Here a
+plugin is a module of pure tensor kernels evaluated over ALL nodes at once:
+
+    filter_kernel(static, pod_xs, carry)  -> codes  [N] int32  (0 == pass)
+    score_kernel (static, pod_xs, carry)  -> raw    [N] int64
+    normalize    (raw, feasible)          -> normed [N] int64   (ScoreExtensions)
+    bind_update  (static, pod_xs, own_carry, sel)   -> own_carry
+
+plus a host-side `build()` that precompiles the workload into the static /
+per-pod arrays, and `decode_filter()` that maps a failure code back to the
+exact status message the reference would have recorded
+(e.g. "Insufficient cpu", wrappedplugin.go:523-548 records
+status.Message(); pass records "passed", resultstore/store.go:27-28).
+
+The scheduling cycle composes these python-side at trace time, so XLA sees
+one fused program per pod step; there is no plugin dispatch on device.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100  # upstream framework.MaxNodeScore
+
+
+class CoreCarry(NamedTuple):
+    """Shared device-side mutable cluster state (the scan carry core).
+
+    Mirrors upstream NodeInfo accumulators: Requested (actual requests, the
+    Filter path), NonZeroRequested (scoring path, 100m/200Mi defaults) and
+    the pod count.
+    """
+
+    requested: jnp.ndarray   # [N, R] int64
+    nonzero: jnp.ndarray     # [N, 2] int64  (cpu milli, memory bytes)
+    num_pods: jnp.ndarray    # [N] int64
+
+
+def default_normalize_score(raw, feasible, reverse: bool):
+    """upstream helper.DefaultNormalizeScore (int64 exact), computed over
+    the feasible-node subset only (the framework only scores nodes that
+    passed all filters)."""
+    raw = raw.astype(jnp.int64)
+    masked = jnp.where(feasible, raw, 0)
+    max_count = jnp.max(masked)
+    safe_max = jnp.maximum(max_count, 1)
+    scaled = raw * MAX_NODE_SCORE // safe_max
+    if reverse:
+        scaled = MAX_NODE_SCORE - scaled
+        # maxCount == 0: all scores set to maxPriority
+        return jnp.where(max_count == 0, jnp.int64(MAX_NODE_SCORE), scaled)
+    return jnp.where(max_count == 0, raw, scaled)

@@ -1,0 +1,267 @@
+"""InterPodAffinity tensor kernels.
+
+Upstream v1.32 pkg/scheduler/framework/plugins/interpodaffinity.  The
+pod x pod cross terms are factored through *unique affinity terms*: a term
+is (topologyKey, labelSelector, namespaces); the whole workload (initial
+pods + queue) mentions a small set T of distinct terms, and every pairwise
+relation the plugin needs is a function of per-(term, domain) counts:
+
+  matched[T, D]    existing pods whose labels+ns match term t, per domain
+  have_req_anti    existing pods having t as a required anti-affinity term
+  have_req_aff     ... as a required affinity term
+  sym_pref_aff     sum of weights of existing pods having t as a preferred
+                   affinity term (symmetric score credit)
+  sym_pref_anti    ... preferred anti-affinity term
+
+These five [T, D] matrices are the scan carry; per-pod statics are
+t_matches[P, T] (does pod p match term t) and the pod's own term
+multiplicities/weights h_*[P, T].  A 10k x 5k InterPodAffinity replay that
+is O(pods^2 x nodes) pairwise in the reference becomes O(T x D) per step.
+
+Filter (required terms), in upstream check order:
+  1. pod affinity:   every t with h_req_aff>0 needs matched[t, dom(n)]>0,
+     OR the self-match escape: no pod anywhere matches any of the pod's
+     affinity terms AND the pod matches all its own terms AND the node has
+     all term topology keys.     -> "node(s) didn't match pod affinity rules"
+  2. pod anti-affinity: no t with h_req_anti>0 may have matched[t,dom]>0
+                                 -> "node(s) didn't match pod anti-affinity rules"
+  3. existing pods' anti-affinity: sum_t t_matches[p,t]*have_req_anti[t,dom]
+     must be 0       -> "node(s) didn't satisfy existing pods' anti-affinity rules"
+
+Score: raw(n) = sum_t [ (h_pref_aff_w - h_pref_anti_w)[p,t] * matched[t,dom]
+                 + t_matches[p,t] * (sym_pref_aff - sym_pref_anti
+                                     + hardWeight * have_req_aff)[t,dom] ]
+with hardWeight = args.hardPodAffinityWeight (default 1).
+NormalizeScore: fScore = 100 * (score - min) / (max - min) over feasible
+nodes, float64 then int64 truncation, 0 when max == min.
+
+Round-1 simplifications (docs/SEMANTICS.md): namespaceSelector in terms and
+matchLabelKeys are not modeled; PreFilter never returns Skip when any pod
+in the workload carries required anti-affinity terms (coarser than
+upstream's per-cycle check, applied identically in the CPU reference).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MAX_NODE_SCORE
+from ..state.nodes import NodeTable
+from ..state.selectors import label_selector_matches, node_labels_as_strings
+
+NAME = "InterPodAffinity"
+ERR_AFFINITY = "node(s) didn't match pod affinity rules"
+ERR_ANTI_AFFINITY = "node(s) didn't match pod anti-affinity rules"
+ERR_EXISTING_ANTI = "node(s) didn't satisfy existing pods' anti-affinity rules"
+
+CODE_AFFINITY, CODE_ANTI, CODE_EXISTING = 1, 2, 3
+
+DEFAULT_HARD_POD_AFFINITY_WEIGHT = 1
+
+
+class InterPodStatic(NamedTuple):
+    dom_idx: jnp.ndarray     # [T, N] int32 (-1: node lacks term's key)
+    hard_weight: jnp.ndarray  # scalar int64
+
+
+class InterPodXS(NamedTuple):
+    t_matches: jnp.ndarray     # [P, T] bool
+    h_req_aff: jnp.ndarray     # [P, T] int32
+    h_req_anti: jnp.ndarray    # [P, T] int32
+    h_pref_aff_w: jnp.ndarray  # [P, T] int64
+    h_pref_anti_w: jnp.ndarray  # [P, T] int64
+    self_ok: jnp.ndarray       # [P] bool — pod matches all its own req aff terms
+    filter_skip: jnp.ndarray   # [P] bool
+
+
+class InterPodCarry(NamedTuple):
+    matched: jnp.ndarray        # [T, D] int64
+    have_req_anti: jnp.ndarray  # [T, D] int64
+    have_req_aff: jnp.ndarray   # [T, D] int64
+    sym_pref_aff: jnp.ndarray   # [T, D] int64
+    sym_pref_anti: jnp.ndarray  # [T, D] int64
+
+
+def _terms_of(pod: dict, field: str, preferred: bool) -> list[tuple[dict, int]]:
+    aff = ((pod.get("spec") or {}).get("affinity") or {}).get(field) or {}
+    if preferred:
+        return [
+            (wt.get("podAffinityTerm") or {}, int(wt.get("weight", 0)))
+            for wt in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+        ]
+    return [(t, 1) for t in aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []]
+
+
+def build(table: NodeTable, pods: list[dict], vocab, hard_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT):
+    labels = node_labels_as_strings(table, vocab)
+    n, p = table.n, len(pods)
+
+    # --- unique term table ----------------------------------------------
+    terms: dict[tuple, int] = {}
+    term_list: list[tuple[str, dict | None, tuple[str, ...]]] = []  # (key, selector, namespaces)
+
+    def intern_term(term: dict, pod_ns: str) -> int:
+        nss = tuple(sorted(term.get("namespaces") or [pod_ns]))
+        sel = term.get("labelSelector")
+        tk = (term.get("topologyKey", ""), json.dumps(sel, sort_keys=True), nss)
+        if tk not in terms:
+            terms[tk] = len(term_list)
+            term_list.append((term.get("topologyKey", ""), sel, nss))
+        return terms[tk]
+
+    per_pod: list[dict[str, list[tuple[int, int]]]] = []
+    for pod in pods:
+        ns = (pod.get("metadata") or {}).get("namespace") or "default"
+        entry = {}
+        for kind, field, preferred in (
+            ("req_aff", "podAffinity", False),
+            ("req_anti", "podAntiAffinity", False),
+            ("pref_aff", "podAffinity", True),
+            ("pref_anti", "podAntiAffinity", True),
+        ):
+            entry[kind] = [(intern_term(t, ns), w) for t, w in _terms_of(pod, field, preferred)]
+        per_pod.append(entry)
+
+    t_count = max(len(term_list), 1)
+
+    # --- domain indexing per term key ------------------------------------
+    dom_idx = np.full((t_count, n), -1, dtype=np.int32)
+    for t_id, (key, _, _) in enumerate(term_list):
+        vals: dict[str, int] = {}
+        for j in range(n):
+            v = labels[j].get(key)
+            if v is not None:
+                dom_idx[t_id, j] = vals.setdefault(v, len(vals))
+    d_max = max(int(dom_idx.max()) + 1, 1)
+
+    # --- pod x term matches + per-pod term weights -----------------------
+    t_matches = np.zeros((p, t_count), dtype=bool)
+    h_req_aff = np.zeros((p, t_count), dtype=np.int32)
+    h_req_anti = np.zeros((p, t_count), dtype=np.int32)
+    h_pref_aff_w = np.zeros((p, t_count), dtype=np.int64)
+    h_pref_anti_w = np.zeros((p, t_count), dtype=np.int64)
+    self_ok = np.zeros(p, dtype=bool)
+    for i, pod in enumerate(pods):
+        pod_ns = (pod.get("metadata") or {}).get("namespace") or "default"
+        pod_labels = {k: str(v) for k, v in ((pod.get("metadata") or {}).get("labels") or {}).items()}
+        for t_id, (_, sel, nss) in enumerate(term_list):
+            t_matches[i, t_id] = pod_ns in nss and label_selector_matches(sel, pod_labels)
+        e = per_pod[i]
+        for t_id, _ in e["req_aff"]:
+            h_req_aff[i, t_id] += 1
+        for t_id, _ in e["req_anti"]:
+            h_req_anti[i, t_id] += 1
+        for t_id, w in e["pref_aff"]:
+            h_pref_aff_w[i, t_id] += w
+        for t_id, w in e["pref_anti"]:
+            h_pref_anti_w[i, t_id] += w
+        self_ok[i] = all(t_matches[i, t_id] for t_id, _ in e["req_aff"])
+
+    any_workload_anti = bool(h_req_anti.any())
+    filter_skip = np.array(
+        [
+            not any_workload_anti
+            and not per_pod[i]["req_aff"]
+            and not per_pod[i]["req_anti"]
+            for i in range(p)
+        ],
+        dtype=bool,
+    )
+
+    static = InterPodStatic(dom_idx=jnp.asarray(dom_idx), hard_weight=jnp.int64(hard_weight))
+    xs = InterPodXS(
+        t_matches=jnp.asarray(t_matches),
+        h_req_aff=jnp.asarray(h_req_aff),
+        h_req_anti=jnp.asarray(h_req_anti),
+        h_pref_aff_w=jnp.asarray(h_pref_aff_w),
+        h_pref_anti_w=jnp.asarray(h_pref_anti_w),
+        self_ok=jnp.asarray(self_ok),
+        filter_skip=jnp.asarray(filter_skip),
+    )
+    zeros = jnp.zeros((t_count, d_max), dtype=jnp.int64)
+    carry = InterPodCarry(zeros, zeros, zeros, zeros, zeros)
+    return static, xs, carry
+
+
+def _gather_dom(static: InterPodStatic, mat: jnp.ndarray) -> jnp.ndarray:
+    """mat[T, D] -> [T, N]: value at each node's domain, 0 where key missing."""
+    dom = static.dom_idx
+    safe = jnp.maximum(dom, 0)
+    vals = jnp.take_along_axis(mat, safe, axis=1)
+    return jnp.where(dom >= 0, vals, 0)
+
+
+def filter_kernel(static: InterPodStatic, pod, carry: InterPodCarry) -> jnp.ndarray:
+    matched_n = _gather_dom(static, carry.matched)        # [T, N]
+    has_aff = pod.h_req_aff > 0                            # [T]
+    # 1. required pod affinity
+    term_sat = matched_n > 0                               # [T, N]
+    aff_ok_all = jnp.all(jnp.where(has_aff[:, None], term_sat, True), axis=0)  # [N]
+    total_any = jnp.sum(jnp.where(has_aff, jnp.sum(carry.matched, axis=1), 0))
+    node_has_keys = jnp.all(jnp.where(has_aff[:, None], static.dom_idx >= 0, True), axis=0)
+    self_escape = (total_any == 0) & pod.self_ok & node_has_keys
+    fail_aff = jnp.any(has_aff) & ~(aff_ok_all | self_escape)
+    # 2. required pod anti-affinity
+    has_anti = pod.h_req_anti > 0
+    fail_anti = jnp.any(jnp.where(has_anti[:, None], matched_n > 0, False), axis=0)
+    # 3. existing pods' anti-affinity vs this pod
+    anti_n = _gather_dom(static, carry.have_req_anti)
+    fail_existing = jnp.sum(jnp.where(pod.t_matches[:, None], anti_n, 0), axis=0) > 0
+    code = jnp.where(fail_existing, CODE_EXISTING, 0)
+    code = jnp.where(fail_anti, CODE_ANTI, code)
+    code = jnp.where(fail_aff, CODE_AFFINITY, code)
+    return code.astype(jnp.int32)
+
+
+def score_kernel(static: InterPodStatic, pod, carry: InterPodCarry) -> jnp.ndarray:
+    matched_n = _gather_dom(static, carry.matched)
+    own = (pod.h_pref_aff_w - pod.h_pref_anti_w)[:, None] * matched_n
+    sym = _gather_dom(
+        static,
+        carry.sym_pref_aff - carry.sym_pref_anti + static.hard_weight * carry.have_req_aff,
+    )
+    sym_contrib = jnp.where(pod.t_matches[:, None], sym, 0)
+    return jnp.sum(own + sym_contrib, axis=0).astype(jnp.int64)
+
+
+def normalize(raw, feasible):
+    big = jnp.int64(1) << 40
+    mn = jnp.min(jnp.where(feasible, raw, big))
+    mx = jnp.max(jnp.where(feasible, raw, -big))
+    diff = (mx - mn).astype(jnp.float64)
+    f = jnp.where(
+        diff > 0,
+        MAX_NODE_SCORE * ((raw - mn).astype(jnp.float64) / jnp.maximum(diff, 1.0)),
+        0.0,
+    )
+    return f.astype(jnp.int64)  # Go int64() truncation
+
+
+def bind_update(static: InterPodStatic, pod, carry: InterPodCarry, sel):
+    bound = sel >= 0
+    s = jnp.maximum(sel, 0)
+    dom = static.dom_idx[:, s]                     # [T]
+    valid = bound & (dom >= 0)
+    d = carry.matched.shape[1]
+    safe_dom = jnp.where(dom >= 0, dom, d - 1)
+    rows = jnp.arange(carry.matched.shape[0])
+
+    def upd(mat, inc):
+        inc = jnp.where(valid, inc.astype(mat.dtype), 0)
+        return mat.at[rows, safe_dom].add(inc)
+
+    return InterPodCarry(
+        matched=upd(carry.matched, pod.t_matches),
+        have_req_anti=upd(carry.have_req_anti, pod.h_req_anti),
+        have_req_aff=upd(carry.have_req_aff, pod.h_req_aff),
+        sym_pref_aff=upd(carry.sym_pref_aff, pod.h_pref_aff_w),
+        sym_pref_anti=upd(carry.sym_pref_anti, pod.h_pref_anti_w),
+    )
+
+
+def decode_filter(code: int, node_idx: int, host_aux) -> str:
+    return {CODE_AFFINITY: ERR_AFFINITY, CODE_ANTI: ERR_ANTI_AFFINITY, CODE_EXISTING: ERR_EXISTING_ANTI}[code]

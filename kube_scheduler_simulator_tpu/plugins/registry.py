@@ -1,0 +1,98 @@
+"""Plugin registry: names, extension points, default order and weights.
+
+Mirrors the role of the reference's in-tree registry + config rewrite
+(reference: simulator/scheduler/plugin/plugins.go:25-85 builds a factory
+per plugin; :289-304 getScorePluginWeight collects score weights, default 1
+when unset).  Order and default weights follow upstream v1.32
+getDefaultPlugins (MultiPoint): NodeUnschedulable, NodeName,
+TaintToleration(3), NodeAffinity(2), NodeResourcesFit(1),
+PodTopologySpread(2), InterPodAffinity(2),
+NodeResourcesBalancedAllocation(1) — restricted to the plugins this
+framework tensorizes so far.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class PluginDesc:
+    name: str
+    has_prefilter: bool = False
+    has_filter: bool = False
+    has_prescore: bool = False
+    has_score: bool = False
+    has_normalize: bool = False  # ScoreExtensions != nil
+    default_weight: int = 1
+
+
+PLUGIN_REGISTRY: dict[str, PluginDesc] = {
+    d.name: d
+    for d in [
+        PluginDesc("NodeUnschedulable", has_filter=True),
+        PluginDesc("NodeName", has_filter=True),
+        PluginDesc("TaintToleration", has_filter=True, has_prescore=True, has_score=True,
+                   has_normalize=True, default_weight=3),
+        PluginDesc("NodeAffinity", has_prefilter=True, has_filter=True, has_prescore=True,
+                   has_score=True, has_normalize=True, default_weight=2),
+        PluginDesc("NodeResourcesFit", has_prefilter=True, has_filter=True, has_prescore=True,
+                   has_score=True, default_weight=1),
+        PluginDesc("PodTopologySpread", has_prefilter=True, has_filter=True, has_prescore=True,
+                   has_score=True, has_normalize=True, default_weight=2),
+        PluginDesc("InterPodAffinity", has_prefilter=True, has_filter=True, has_prescore=True,
+                   has_score=True, has_normalize=True, default_weight=2),
+        PluginDesc("NodeResourcesBalancedAllocation", has_prescore=True, has_score=True,
+                   default_weight=1),
+    ]
+}
+
+# upstream MultiPoint order (v1.32 getDefaultPlugins), restricted to the above
+DEFAULT_ORDER = [
+    "NodeUnschedulable",
+    "NodeName",
+    "TaintToleration",
+    "NodeAffinity",
+    "NodeResourcesFit",
+    "PodTopologySpread",
+    "InterPodAffinity",
+    "NodeResourcesBalancedAllocation",
+]
+
+
+def default_plugin_names() -> list[str]:
+    return list(DEFAULT_ORDER)
+
+
+@dataclass
+class PluginSetConfig:
+    """Enabled plugins (ordered as in DEFAULT_ORDER) + score weights.
+
+    Weight semantics follow the reference: a configured weight of 0 means 1
+    (plugins.go:296-300)."""
+
+    enabled: list[str] = field(default_factory=default_plugin_names)
+    weights: dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        order = {n: i for i, n in enumerate(DEFAULT_ORDER)}
+        self.enabled = sorted(self.enabled, key=lambda n: order.get(n, 99))
+        for name in self.enabled:
+            if name not in PLUGIN_REGISTRY:
+                raise ValueError(f"unknown plugin {name}")
+
+    def weight(self, name: str) -> int:
+        w = self.weights.get(name, PLUGIN_REGISTRY[name].default_weight)
+        return w if w != 0 else 1
+
+    def filters(self) -> list[str]:
+        return [n for n in self.enabled if PLUGIN_REGISTRY[n].has_filter]
+
+    def scorers(self) -> list[str]:
+        return [n for n in self.enabled if PLUGIN_REGISTRY[n].has_score]
+
+    def prefilters(self) -> list[str]:
+        return [n for n in self.enabled if PLUGIN_REGISTRY[n].has_prefilter]
+
+    def prescorers(self) -> list[str]:
+        return [n for n in self.enabled if PLUGIN_REGISTRY[n].has_prescore]

@@ -1,0 +1,131 @@
+"""TaintToleration + NodeUnschedulable + NodeName tensor kernels.
+
+All three filter predicates and the TaintToleration score depend only on
+node taints/labels/names and the pod's tolerations/nodeName — static during
+a replay — so they precompile to dense [P, N] arrays.
+
+Upstream v1.32 semantics:
+* TaintToleration Filter: first taint with effect NoSchedule/NoExecute not
+  tolerated fails the node with
+  "node(s) had untolerated taint {<key>: <value>}".  The failure code here
+  is 1 + index of that taint in the node's taint list so the decoder can
+  reproduce the exact message.
+* TaintToleration Score: count of PreferNoSchedule taints not tolerated by
+  the pod's tolerations filtered to effect in {"", PreferNoSchedule};
+  NormalizeScore = DefaultNormalizeScore(100, reverse=true).
+* NodeUnschedulable Filter: node.spec.unschedulable fails with
+  "node(s) were unschedulable" unless the pod tolerates the
+  node.kubernetes.io/unschedulable:NoSchedule taint.
+* NodeName Filter: pod.spec.nodeName set and != node name fails with
+  "node(s) didn't match the requested node name".
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import default_normalize_score
+from ..state.nodes import NodeTable, EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE, EFFECT_PREFER_NO_SCHEDULE, EFFECT_NAMES
+from ..state.selectors import tolerations_tolerate
+
+NAME_TAINT = "TaintToleration"
+NAME_UNSCHED = "NodeUnschedulable"
+NAME_NODENAME = "NodeName"
+
+ERR_UNSCHEDULABLE = "node(s) were unschedulable"
+ERR_NODE_NAME = "node(s) didn't match the requested node name"
+
+UNSCHEDULABLE_TAINT_KEY = "node.kubernetes.io/unschedulable"
+
+
+class TaintXS(NamedTuple):
+    filter_code: jnp.ndarray   # [P, N] int16; 0 pass, else 1 + taint index
+    prefer_count: jnp.ndarray  # [P, N] int16 (intolerable PreferNoSchedule taints)
+
+
+class UnschedXS(NamedTuple):
+    fail: jnp.ndarray  # [P, N] bool
+
+
+class NodeNameXS(NamedTuple):
+    fail: jnp.ndarray        # [P, N] bool
+    filter_skip: jnp.ndarray  # [P] bool (PreFilter Skip when no nodeName)
+
+
+def build_taints(table: NodeTable, pods: list[dict]) -> TaintXS:
+    n, p = table.n, len(pods)
+    code = np.zeros((p, n), dtype=np.int16)
+    prefer = np.zeros((p, n), dtype=np.int16)
+    for i, pod in enumerate(pods):
+        tols = (pod.get("spec") or {}).get("tolerations") or []
+        tols_prefer = [t for t in tols if (t.get("effect") or "") in ("", "PreferNoSchedule")]
+        for j in range(n):
+            for ti, (_, _, eff, key, value) in enumerate(table.taints[j]):
+                eff_name = EFFECT_NAMES[eff]
+                if eff in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
+                    if code[i, j] == 0 and not tolerations_tolerate(tols, key, value, eff_name):
+                        code[i, j] = 1 + ti
+                elif eff == EFFECT_PREFER_NO_SCHEDULE:
+                    if not tolerations_tolerate(tols_prefer, key, value, eff_name):
+                        prefer[i, j] += 1
+    return TaintXS(filter_code=jnp.asarray(code), prefer_count=jnp.asarray(prefer))
+
+
+def build_unschedulable(table: NodeTable, pods: list[dict]) -> UnschedXS:
+    n, p = table.n, len(pods)
+    fail = np.zeros((p, n), dtype=bool)
+    unsched_nodes = np.flatnonzero(table.unschedulable)
+    for i, pod in enumerate(pods):
+        tols = (pod.get("spec") or {}).get("tolerations") or []
+        tolerated = tolerations_tolerate(tols, UNSCHEDULABLE_TAINT_KEY, "", "NoSchedule")
+        if not tolerated:
+            fail[i, unsched_nodes] = True
+    return UnschedXS(fail=jnp.asarray(fail))
+
+
+def build_nodename(table: NodeTable, pods: list[dict]) -> NodeNameXS:
+    n, p = table.n, len(pods)
+    fail = np.zeros((p, n), dtype=bool)
+    skip = np.zeros(p, dtype=bool)
+    name_idx = {name: j for j, name in enumerate(table.names)}
+    for i, pod in enumerate(pods):
+        want = (pod.get("spec") or {}).get("nodeName") or ""
+        if not want:
+            skip[i] = True
+            continue
+        fail[i, :] = True
+        j = name_idx.get(want)
+        if j is not None:
+            fail[i, j] = False
+    return NodeNameXS(fail=jnp.asarray(fail), filter_skip=jnp.asarray(skip))
+
+
+# --- device kernels (pure gathers over the precompiled rows) ---
+
+def taint_filter(pod_xs: TaintXS) -> jnp.ndarray:
+    return pod_xs.filter_code.astype(jnp.int32)
+
+
+def taint_score(pod_xs: TaintXS) -> jnp.ndarray:
+    return pod_xs.prefer_count.astype(jnp.int64)
+
+
+def taint_normalize(raw, feasible):
+    return default_normalize_score(raw, feasible, reverse=True)
+
+
+def decode_taint_filter(code: int, node_idx: int, host_aux) -> str:
+    table: NodeTable = host_aux["node_table"]
+    _, _, _, key, value = table.taints[node_idx][code - 1]
+    return "node(s) had untolerated taint {%s: %s}" % (key, value)
+
+
+def unsched_filter(pod_xs: UnschedXS) -> jnp.ndarray:
+    return jnp.where(pod_xs.fail, 1, 0).astype(jnp.int32)
+
+
+def nodename_filter(pod_xs: NodeNameXS) -> jnp.ndarray:
+    return jnp.where(pod_xs.fail, 1, 0).astype(jnp.int32)

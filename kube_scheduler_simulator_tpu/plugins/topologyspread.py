@@ -1,0 +1,255 @@
+"""PodTopologySpread tensor kernels.
+
+Upstream v1.32 pkg/scheduler/framework/plugins/podtopologyspread.  The
+dynamic quantity is the number of already-placed pods matching each
+constraint's label selector per topology domain; it lives in the scan carry
+as a dense counts[C, D] matrix where C indexes *unique count groups*
+(namespace, topologyKey, selector) deduplicated across the whole workload
+and D indexes topology domains (distinct label values of the key).
+
+Static precompiles:
+  dom_idx[C, N]    domain index of each node for each group key (-1: node
+                   lacks the topology label)
+  pm[P, C]         does pod p's labels+namespace match group c's selector
+  per-pod constraint slots (padded to MAX_CONSTRAINTS): group id, maxSkew,
+                   whenUnsatisfiable, eligibility (node affinity match for
+                   minMatchNum domain filtering), log-normalizing weight.
+
+Filter (DoNotSchedule): skew = count(node domain) + selfMatch - min over
+domains present among nodes matching the pod's nodeSelector/affinity;
+fails with "node(s) didn't match pod topology spread constraints" (or the
+"(missing required label)" variant).  Constraints are checked in pod order
+and the first violation wins, as upstream does.
+
+Score (ScheduleAnyway): sum over constraints of count * log(#domains + 2)
+(topologyNormalizingWeight), Go math.Round'ed; nodes missing any scored
+topology key are ignored (score 0 after normalize).  NormalizeScore:
+score = 100 * (max + min - s) / max over scored feasible nodes, 100 for
+all when max == 0.
+
+Round-1 simplifications (documented in docs/SEMANTICS.md): minDomains,
+matchLabelKeys, nodeAffinityPolicy/nodeTaintsPolicy knobs and
+system-default constraints derived from service/replicaset owners are not
+yet modeled; #domains for the normalizing weight is computed over all
+nodes with the key rather than the affinity-filtered subset.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MAX_NODE_SCORE
+from ..state.nodes import NodeTable
+from ..state.selectors import (
+    label_selector_matches,
+    node_labels_as_strings,
+    node_selector_matches,
+)
+
+NAME = "PodTopologySpread"
+ERR_SKEW = "node(s) didn't match pod topology spread constraints"
+ERR_MISSING_LABEL = "node(s) didn't match pod topology spread constraints (missing required label)"
+
+MAX_CONSTRAINTS = 4
+_BIG = np.int64(1) << 40
+
+
+class SpreadStatic(NamedTuple):
+    dom_idx: jnp.ndarray   # [C, N] int32
+    n_groups: int
+
+
+class SpreadXS(NamedTuple):
+    pm: jnp.ndarray          # [P, C] bool — pod matches group selector
+    c_id: jnp.ndarray        # [P, MC] int32 (-1 pad)
+    max_skew: jnp.ndarray    # [P, MC] int32
+    is_filter: jnp.ndarray   # [P, MC] bool (DoNotSchedule)
+    is_score: jnp.ndarray    # [P, MC] bool (ScheduleAnyway)
+    weight: jnp.ndarray      # [P, MC] float64 (topologyNormalizingWeight)
+    eligible: jnp.ndarray    # [P, N] bool (node matches pod's selector/affinity)
+    filter_skip: jnp.ndarray  # [P] bool
+    score_skip: jnp.ndarray   # [P] bool
+
+
+def _pod_constraints(pod: dict) -> list[dict]:
+    return (pod.get("spec") or {}).get("topologySpreadConstraints") or []
+
+
+def _node_affinity_eligible(pod: dict, labels: list[dict], names: list[str]) -> np.ndarray:
+    """nodeAffinityPolicy: Honor — domains for minMatchNum only count nodes
+    matching the pod's nodeSelector + required node affinity."""
+    spec = pod.get("spec") or {}
+    sel = spec.get("nodeSelector") or {}
+    req = (((spec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    )
+    n = len(labels)
+    out = np.ones(n, dtype=bool)
+    if not sel and not req:
+        return out
+    for j in range(n):
+        ok = all(labels[j].get(k) == str(v) for k, v in sel.items()) if sel else True
+        if ok and req:
+            ok = node_selector_matches(req, labels[j], names[j])
+        out[j] = ok
+    return out
+
+
+def build(table: NodeTable, pods: list[dict], vocab):
+    labels = node_labels_as_strings(table, vocab)
+    n, p = table.n, len(pods)
+
+    # --- collect unique count groups over the whole workload -------------
+    groups: dict[tuple, int] = {}  # (ns, key, selector_json) -> c_id
+    group_list: list[tuple[str, str, dict]] = []
+    per_pod: list[list[tuple[int, dict]]] = []
+    for pod in pods:
+        ns = (pod.get("metadata") or {}).get("namespace") or "default"
+        slots = []
+        for c in _pod_constraints(pod)[:MAX_CONSTRAINTS]:
+            sel = c.get("labelSelector")
+            gk = (ns, c.get("topologyKey", ""), json.dumps(sel, sort_keys=True))
+            if gk not in groups:
+                groups[gk] = len(group_list)
+                group_list.append((ns, c.get("topologyKey", ""), sel))
+            slots.append((groups[gk], c))
+        per_pod.append(slots)
+
+    n_groups = max(len(group_list), 1)
+
+    # --- domain indexing per group key -----------------------------------
+    dom_idx = np.full((n_groups, n), -1, dtype=np.int32)
+    n_domains = np.zeros(n_groups, dtype=np.int64)
+    for c_id, (_, key, _) in enumerate(group_list):
+        vals: dict[str, int] = {}
+        for j in range(n):
+            v = labels[j].get(key)
+            if v is not None:
+                dom_idx[c_id, j] = vals.setdefault(v, len(vals))
+        n_domains[c_id] = len(vals)
+    d_max = max(int(dom_idx.max()) + 1, 1)
+
+    # --- pod x group selector matches ------------------------------------
+    pm = np.zeros((p, n_groups), dtype=bool)
+    for i, pod in enumerate(pods):
+        pod_ns = (pod.get("metadata") or {}).get("namespace") or "default"
+        pod_labels = {k: str(v) for k, v in ((pod.get("metadata") or {}).get("labels") or {}).items()}
+        for c_id, (ns, _, sel) in enumerate(group_list):
+            pm[i, c_id] = ns == pod_ns and label_selector_matches(sel, pod_labels)
+
+    # --- per-pod constraint slots ----------------------------------------
+    c_id_arr = np.full((p, MAX_CONSTRAINTS), -1, dtype=np.int32)
+    max_skew = np.ones((p, MAX_CONSTRAINTS), dtype=np.int32)
+    is_filter = np.zeros((p, MAX_CONSTRAINTS), dtype=bool)
+    is_score = np.zeros((p, MAX_CONSTRAINTS), dtype=bool)
+    weight = np.zeros((p, MAX_CONSTRAINTS), dtype=np.float64)
+    eligible = np.ones((p, n), dtype=bool)
+    filter_skip = np.ones(p, dtype=bool)
+    score_skip = np.ones(p, dtype=bool)
+    for i, slots in enumerate(per_pod):
+        if any(c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" for _, c in slots):
+            eligible[i] = _node_affinity_eligible(pods[i], labels, table.names)
+        for m, (cid, c) in enumerate(slots):
+            c_id_arr[i, m] = cid
+            max_skew[i, m] = int(c.get("maxSkew", 1))
+            hard = c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"
+            is_filter[i, m] = hard
+            is_score[i, m] = not hard
+            weight[i, m] = math.log(float(n_domains[cid]) + 2.0)
+        filter_skip[i] = not is_filter[i].any()
+        score_skip[i] = not is_score[i].any()
+
+    static = SpreadStatic(dom_idx=jnp.asarray(dom_idx), n_groups=n_groups)
+    xs = SpreadXS(
+        pm=jnp.asarray(pm),
+        c_id=jnp.asarray(c_id_arr),
+        max_skew=jnp.asarray(max_skew),
+        is_filter=jnp.asarray(is_filter),
+        is_score=jnp.asarray(is_score),
+        weight=jnp.asarray(weight),
+        eligible=jnp.asarray(eligible),
+        filter_skip=jnp.asarray(filter_skip),
+        score_skip=jnp.asarray(score_skip),
+    )
+    init_counts = jnp.zeros((n_groups, d_max), dtype=jnp.int64)
+    return static, xs, init_counts
+
+
+def _per_constraint(static: SpreadStatic, pod, counts, m):
+    """Gathered quantities for constraint slot m: (active, dom[N], cnt[N], min_match)."""
+    cid = pod.c_id[m]
+    active = cid >= 0
+    c = jnp.maximum(cid, 0)
+    dom = static.dom_idx[c]                      # [N]
+    has_key = dom >= 0
+    counts_row = counts[c]                       # [D]
+    cnt = jnp.where(has_key, counts_row[jnp.maximum(dom, 0)], 0)
+    # domains present among eligible nodes
+    d = counts_row.shape[0]
+    present = jnp.zeros(d, dtype=bool).at[jnp.where(has_key & pod.eligible, dom, d - 1)].max(
+        has_key & pod.eligible
+    )
+    min_match = jnp.min(jnp.where(present, counts_row, _BIG))
+    return active, has_key, cnt, min_match
+
+
+def filter_kernel(static: SpreadStatic, pod, counts) -> jnp.ndarray:
+    """[N] int32: 0 pass; 1+2m missing-label at slot m; 2+2m skew at slot m."""
+    code = jnp.zeros(static.dom_idx.shape[1], dtype=jnp.int32)
+    for m in range(MAX_CONSTRAINTS):
+        active, has_key, cnt, min_match = _per_constraint(static, pod, counts, m)
+        check = active & pod.is_filter[m]
+        self_match = pod.pm[jnp.maximum(pod.c_id[m], 0)].astype(jnp.int64)
+        skew = cnt + self_match - min_match
+        viol = jnp.where(has_key, jnp.where(skew > pod.max_skew[m], 2 + 2 * m, 0), 1 + 2 * m)
+        viol = jnp.where(check, viol, 0).astype(jnp.int32)
+        code = jnp.where((code == 0) & (viol > 0), viol, code)
+    return code
+
+
+def score_kernel(static: SpreadStatic, pod, counts) -> jnp.ndarray:
+    n = static.dom_idx.shape[1]
+    total = jnp.zeros(n, dtype=jnp.float64)
+    ignored = jnp.zeros(n, dtype=bool)
+    for m in range(MAX_CONSTRAINTS):
+        active, has_key, cnt, _ = _per_constraint(static, pod, counts, m)
+        scored = active & pod.is_score[m]
+        total = total + jnp.where(scored & has_key, cnt.astype(jnp.float64) * pod.weight[m], 0.0)
+        ignored = ignored | jnp.where(scored, ~has_key, False)
+    raw = jnp.floor(total + 0.5).astype(jnp.int64)  # Go math.Round for non-negative
+    return jnp.where(ignored, 0, raw), ignored
+
+
+def normalize(raw, ignored, feasible):
+    scored = feasible & ~ignored
+    mn = jnp.min(jnp.where(scored, raw, _BIG))
+    mx = jnp.max(jnp.where(scored, raw, 0))
+    any_scored = jnp.any(scored)
+    mn = jnp.where(any_scored, mn, 0)
+    out = jnp.where(
+        mx == 0,
+        jnp.int64(MAX_NODE_SCORE),
+        MAX_NODE_SCORE * (mx + mn - raw) // jnp.maximum(mx, 1),
+    )
+    return jnp.where(ignored, 0, out)
+
+
+def bind_update(static: SpreadStatic, pod, counts, sel):
+    """counts[c, dom_idx[c, sel]] += pm[c] for a bound pod (sel >= 0)."""
+    bound = sel >= 0
+    s = jnp.maximum(sel, 0)
+    dom = static.dom_idx[:, s]                      # [C]
+    inc = (pod.pm & bound & (dom >= 0)).astype(counts.dtype)
+    d = counts.shape[1]
+    safe_dom = jnp.where(dom >= 0, dom, d - 1)
+    inc = jnp.where(dom >= 0, inc, 0)
+    return counts.at[jnp.arange(counts.shape[0]), safe_dom].add(inc)
+
+
+def decode_filter(code: int, node_idx: int, host_aux) -> str:
+    return ERR_MISSING_LABEL if code % 2 == 1 else ERR_SKEW

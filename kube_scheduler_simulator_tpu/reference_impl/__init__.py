@@ -1,0 +1,1 @@
+from .sequential import SequentialScheduler  # noqa: F401

@@ -1,0 +1,520 @@
+"""Sequential CPU reference scheduler — the parity oracle.
+
+A deliberately *scalar* reimplementation of the scheduling cycle in the
+style of the Go reference (one pod at a time, per-node loops, per-plugin
+calls — SURVEY.md §3.2), sharing nothing with the tensor engine except the
+static selector-matching helpers.  Its annotations must be bit-identical
+to store/decode.py over framework/replay.py — that is the correctness gate
+of BASELINE.md — and its wall-clock is the CPU baseline the benchmark
+compares against.
+
+Semantics sources are the same as the tensor kernels' (upstream v1.32
+plugins; recording shim reference:
+simulator/scheduler/plugin/wrappedplugin.go); the deterministic
+lowest-index tie-break divergence is applied here identically.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..plugins.registry import PluginSetConfig
+from ..state.nodes import build_node_table, EFFECT_NAMES, EFFECT_PREFER_NO_SCHEDULE
+from ..state.resources import CPU, MEMORY, ResourceSchema, pod_resource_request
+from ..state.selectors import (
+    label_selector_matches,
+    node_labels_as_strings,
+    node_selector_matches,
+    node_selector_term_matches,
+    tolerations_tolerate,
+)
+from ..state.vocab import Vocab
+from ..store import annotations as ann
+
+MAX_NODE_SCORE = 100
+
+
+def _meta(pod):
+    return pod.get("metadata") or {}
+
+
+def _spec(pod):
+    return pod.get("spec") or {}
+
+
+class SequentialScheduler:
+    def __init__(self, nodes, pods, config: PluginSetConfig | None = None, bound_pods=None):
+        self.config = config or PluginSetConfig()
+        self.pods = pods
+        self.schema = ResourceSchema.discover(pods + [bp for bp, _ in (bound_pods or [])], nodes)
+        self.vocab = Vocab()
+        self.table = build_node_table(nodes, self.schema, self.vocab)
+        self.labels = node_labels_as_strings(self.table, self.vocab)
+        self.names = self.table.names
+        self.n = self.table.n
+        self.requested = [row.copy() for row in self.table.allocatable * 0]
+        self.nonzero = [[0, 0] for _ in range(self.n)]
+        self.num_pods = [0] * self.n
+        self.assigned: list[tuple[dict, int]] = []  # (pod manifest, node idx)
+        self._name_idx = {nm: j for j, nm in enumerate(self.names)}
+        for bp, node_name in bound_pods or []:
+            j = self._name_idx.get(node_name)
+            if j is None:
+                continue
+            r, nz = pod_resource_request(bp, self.schema)
+            self.requested[j] = self.requested[j] + r
+            self.nonzero[j][0] += int(nz[0])
+            self.nonzero[j][1] += int(nz[1])
+            self.num_pods[j] += 1
+            self.assigned.append((bp, j))
+
+    # ---------------- per-plugin filter/score ---------------------------
+
+    def _filter(self, name, pod, req, j) -> str | None:
+        """None == pass, else failure message."""
+        if name == "NodeResourcesFit":
+            reasons = []
+            if self.num_pods[j] + 1 > self.table.allowed_pods[j]:
+                reasons.append("Too many pods")
+            alloc = self.table.allocatable[j]
+            free = alloc - self.requested[j]
+            for r, col in enumerate(self.schema.columns):
+                if req[r] > free[r]:
+                    reasons.append(f"Insufficient {col}")
+            return ", ".join(reasons) if reasons else None
+        if name == "NodeAffinity":
+            spec = _spec(pod)
+            sel = spec.get("nodeSelector") or {}
+            required = (((spec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
+                "requiredDuringSchedulingIgnoredDuringExecution"
+            )
+            ok = all(self.labels[j].get(k) == str(v) for k, v in sel.items())
+            if ok and required:
+                ok = node_selector_matches(required, self.labels[j], self.names[j])
+            return None if ok else "node(s) didn't match Pod's node affinity/selector"
+        if name == "TaintToleration":
+            tols = _spec(pod).get("tolerations") or []
+            for _, _, eff, key, value in self.table.taints[j]:
+                if eff == EFFECT_PREFER_NO_SCHEDULE:
+                    continue
+                if not tolerations_tolerate(tols, key, value, EFFECT_NAMES[eff]):
+                    return "node(s) had untolerated taint {%s: %s}" % (key, value)
+            return None
+        if name == "NodeUnschedulable":
+            if not self.table.unschedulable[j]:
+                return None
+            tols = _spec(pod).get("tolerations") or []
+            if tolerations_tolerate(tols, "node.kubernetes.io/unschedulable", "", "NoSchedule"):
+                return None
+            return "node(s) were unschedulable"
+        if name == "NodeName":
+            want = _spec(pod).get("nodeName") or ""
+            return None if (not want or want == self.names[j]) else "node(s) didn't match the requested node name"
+        if name == "PodTopologySpread":
+            return self._spread_filter(pod, j)
+        if name == "InterPodAffinity":
+            return self._interpod_filter(pod, j)
+        raise ValueError(name)
+
+    def _filter_skip(self, name, pod) -> bool:
+        if name == "NodeAffinity":
+            spec = _spec(pod)
+            req = (((spec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
+                "requiredDuringSchedulingIgnoredDuringExecution"
+            )
+            return not spec.get("nodeSelector") and not req
+        if name == "NodeName":
+            return not (_spec(pod).get("nodeName") or "")
+        if name == "PodTopologySpread":
+            cs = _spec(pod).get("topologySpreadConstraints") or []
+            return not any(c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" for c in cs)
+        if name == "InterPodAffinity":
+            return self._interpod_filter_skip(pod)
+        return False
+
+    def _score_skip(self, name, pod) -> bool:
+        if name == "NodeAffinity":
+            pref = (((_spec(pod).get("affinity") or {}).get("nodeAffinity")) or {}).get(
+                "preferredDuringSchedulingIgnoredDuringExecution"
+            )
+            return not pref
+        if name == "PodTopologySpread":
+            cs = _spec(pod).get("topologySpreadConstraints") or []
+            return not any(c.get("whenUnsatisfiable", "DoNotSchedule") == "ScheduleAnyway" for c in cs)
+        return False
+
+    def _score(self, name, pod, req, nz, j) -> int:
+        if name == "NodeResourcesFit":
+            total = 0
+            for c, col in ((CPU, 0), (MEMORY, 1)):
+                alloc = int(self.table.allocatable[j][c])
+                r = self.nonzero[j][col] + int(nz[col])
+                if alloc <= 0 or r > alloc:
+                    s = 0
+                else:
+                    s = (alloc - r) * MAX_NODE_SCORE // alloc
+                total += s
+            return total // 2
+        if name == "NodeResourcesBalancedAllocation":
+            fracs = []
+            for c, col in ((CPU, 0), (MEMORY, 1)):
+                alloc = int(self.table.allocatable[j][c])
+                if alloc <= 0:
+                    return 0
+                fracs.append(min(float(self.nonzero[j][col] + int(nz[col])) / float(alloc), 1.0))
+            std = abs(fracs[0] - fracs[1]) / 2.0
+            return int((1.0 - std) * MAX_NODE_SCORE)
+        if name == "NodeAffinity":
+            pref = (((_spec(pod).get("affinity") or {}).get("nodeAffinity")) or {}).get(
+                "preferredDuringSchedulingIgnoredDuringExecution"
+            ) or []
+            s = 0
+            for term in pref:
+                if node_selector_term_matches(term.get("preference") or {}, self.labels[j], self.names[j]):
+                    s += int(term.get("weight", 0))
+            return s
+        if name == "TaintToleration":
+            tols = [
+                t
+                for t in (_spec(pod).get("tolerations") or [])
+                if (t.get("effect") or "") in ("", "PreferNoSchedule")
+            ]
+            cnt = 0
+            for _, _, eff, key, value in self.table.taints[j]:
+                if eff == EFFECT_PREFER_NO_SCHEDULE and not tolerations_tolerate(
+                    tols, key, value, "PreferNoSchedule"
+                ):
+                    cnt += 1
+            return cnt
+        if name == "PodTopologySpread":
+            return self._spread_score(pod, j)
+        if name == "InterPodAffinity":
+            return self._interpod_score(pod, j)
+        raise ValueError(name)
+
+    def _normalize(self, name, scores: dict[int, int], pod) -> dict[int, int]:
+        if name in ("NodeResourcesFit", "NodeResourcesBalancedAllocation"):
+            return dict(scores)
+        if name in ("NodeAffinity", "TaintToleration"):
+            reverse = name == "TaintToleration"
+            mx = max(scores.values(), default=0)
+            if mx == 0:
+                if reverse:
+                    return {j: MAX_NODE_SCORE for j in scores}
+                return dict(scores)
+            out = {}
+            for j, s in scores.items():
+                v = s * MAX_NODE_SCORE // mx
+                out[j] = MAX_NODE_SCORE - v if reverse else v
+            return out
+        if name == "PodTopologySpread":
+            return self._spread_normalize(scores, pod)
+        if name == "InterPodAffinity":
+            mn = min(scores.values(), default=0)
+            mx = max(scores.values(), default=0)
+            diff = mx - mn
+            out = {}
+            for j, s in scores.items():
+                out[j] = int(MAX_NODE_SCORE * (float(s - mn) / float(diff))) if diff > 0 else 0
+            return out
+        raise ValueError(name)
+
+    # ---------------- PodTopologySpread helpers -------------------------
+
+    def _spread_constraints(self, pod, hard: bool):
+        out = []
+        for c in (_spec(pod).get("topologySpreadConstraints") or [])[:4]:
+            is_hard = c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"
+            if is_hard == hard:
+                out.append(c)
+        return out
+
+    def _count_matching(self, ns: str, selector, key: str, value: str) -> int:
+        cnt = 0
+        for ap, aj in self.assigned:
+            if (_meta(ap).get("namespace") or "default") != ns:
+                continue
+            if self.labels[aj].get(key) != value:
+                continue
+            lab = {k: str(v) for k, v in (_meta(ap).get("labels") or {}).items()}
+            if label_selector_matches(selector, lab):
+                cnt += 1
+        return cnt
+
+    def _eligible_nodes(self, pod):
+        spec = _spec(pod)
+        sel = spec.get("nodeSelector") or {}
+        req = (((spec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        )
+        out = []
+        for j in range(self.n):
+            ok = all(self.labels[j].get(k) == str(v) for k, v in sel.items()) if sel else True
+            if ok and req:
+                ok = node_selector_matches(req, self.labels[j], self.names[j])
+            out.append(ok)
+        return out
+
+    def _spread_filter(self, pod, j) -> str | None:
+        ns = _meta(pod).get("namespace") or "default"
+        pod_labels = {k: str(v) for k, v in (_meta(pod).get("labels") or {}).items()}
+        eligible = self._eligible_nodes(pod)
+        for c in self._spread_constraints(pod, hard=True):
+            key = c.get("topologyKey", "")
+            if key not in self.labels[j]:
+                return "node(s) didn't match pod topology spread constraints (missing required label)"
+            sel = c.get("labelSelector")
+            self_match = 1 if label_selector_matches(sel, pod_labels) else 0
+            cnt = self._count_matching(ns, sel, key, self.labels[j][key])
+            domains = {self.labels[k].get(key) for k in range(self.n) if eligible[k] and key in self.labels[k]}
+            if not domains:
+                # upstream minMatchNum stays MaxInt when no eligible domain
+                # exists -> skew is negative -> the constraint passes
+                continue
+            min_match = min(self._count_matching(ns, sel, key, d) for d in domains)
+            if cnt + self_match - min_match > int(c.get("maxSkew", 1)):
+                return "node(s) didn't match pod topology spread constraints"
+        return None
+
+    def _spread_score(self, pod, j) -> int:
+        ns = _meta(pod).get("namespace") or "default"
+        total = 0.0
+        for c in self._spread_constraints(pod, hard=False):
+            key = c.get("topologyKey", "")
+            if key not in self.labels[j]:
+                return 0  # ignored node
+            sel = c.get("labelSelector")
+            n_domains = len({self.labels[k].get(key) for k in range(self.n) if key in self.labels[k]})
+            cnt = self._count_matching(ns, sel, key, self.labels[j][key])
+            total += float(cnt) * math.log(float(n_domains) + 2.0)
+        return int(math.floor(total + 0.5))
+
+    def _spread_ignored(self, pod, j) -> bool:
+        return any(
+            c.get("topologyKey", "") not in self.labels[j]
+            for c in self._spread_constraints(pod, hard=False)
+        )
+
+    def _spread_normalize(self, scores: dict[int, int], pod) -> dict[int, int]:
+        scored = {j: s for j, s in scores.items() if not self._spread_ignored(pod, j)}
+        mx = max(scored.values(), default=0)
+        mn = min(scored.values(), default=0)
+        out = {}
+        for j, s in scores.items():
+            if self._spread_ignored(pod, j):
+                out[j] = 0
+            elif mx == 0:
+                out[j] = MAX_NODE_SCORE
+            else:
+                out[j] = MAX_NODE_SCORE * (mx + mn - s) // mx
+        return out
+
+    # ---------------- InterPodAffinity helpers --------------------------
+
+    @staticmethod
+    def _pod_terms(pod, field, preferred):
+        aff = (_spec(pod).get("affinity") or {}).get(field) or {}
+        if preferred:
+            return [
+                (wt.get("podAffinityTerm") or {}, int(wt.get("weight", 0)))
+                for wt in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+            ]
+        return [(t, 1) for t in aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []]
+
+    def _term_matches_pod(self, term, owner_ns, target_pod) -> bool:
+        nss = term.get("namespaces") or [owner_ns]
+        tns = _meta(target_pod).get("namespace") or "default"
+        if tns not in nss:
+            return False
+        lab = {k: str(v) for k, v in (_meta(target_pod).get("labels") or {}).items()}
+        return label_selector_matches(term.get("labelSelector"), lab)
+
+    def _interpod_filter_skip(self, pod) -> bool:
+        if self._pod_terms(pod, "podAffinity", False) or self._pod_terms(pod, "podAntiAffinity", False):
+            return False
+        # coarse workload-level check, mirrored by the tensor engine: no
+        # pod anywhere in the workload carries required anti-affinity
+        for p in self.pods + [ap for ap, _ in self.assigned]:
+            if self._pod_terms(p, "podAntiAffinity", False):
+                return False
+        return True
+
+    def _interpod_filter(self, pod, j) -> str | None:
+        ns = _meta(pod).get("namespace") or "default"
+        aff_terms = self._pod_terms(pod, "podAffinity", False)
+        # 1. required affinity
+        if aff_terms:
+            all_ok = True
+            for term, _ in aff_terms:
+                key = term.get("topologyKey", "")
+                val = self.labels[j].get(key)
+                ok = val is not None and any(
+                    self.labels[aj].get(key) == val and self._term_matches_pod(term, ns, ap)
+                    for ap, aj in self.assigned
+                )
+                if not ok:
+                    all_ok = False
+                    break
+            if not all_ok:
+                any_match_anywhere = any(
+                    self._term_matches_pod(term, ns, ap)
+                    for term, _ in aff_terms
+                    for ap, _ in self.assigned
+                )
+                pod_self = {"metadata": _meta(pod)}
+                self_ok = all(self._term_matches_pod(t, ns, pod_self) for t, _ in aff_terms)
+                node_has_keys = all(t.get("topologyKey", "") in self.labels[j] for t, _ in aff_terms)
+                if not (not any_match_anywhere and self_ok and node_has_keys):
+                    return "node(s) didn't match pod affinity rules"
+        # 2. required anti-affinity
+        for term, _ in self._pod_terms(pod, "podAntiAffinity", False):
+            key = term.get("topologyKey", "")
+            val = self.labels[j].get(key)
+            if val is None:
+                continue
+            if any(
+                self.labels[aj].get(key) == val and self._term_matches_pod(term, ns, ap)
+                for ap, aj in self.assigned
+            ):
+                return "node(s) didn't match pod anti-affinity rules"
+        # 3. existing pods' required anti-affinity vs this pod
+        for ap, aj in self.assigned:
+            ans = _meta(ap).get("namespace") or "default"
+            for term, _ in self._pod_terms(ap, "podAntiAffinity", False):
+                key = term.get("topologyKey", "")
+                val = self.labels[aj].get(key)
+                if val is None or self.labels[j].get(key) != val:
+                    continue
+                if self._term_matches_pod(term, ans, pod):
+                    return "node(s) didn't satisfy existing pods' anti-affinity rules"
+        return None
+
+    def _interpod_score(self, pod, j) -> int:
+        ns = _meta(pod).get("namespace") or "default"
+        score = 0
+        for term, w in self._pod_terms(pod, "podAffinity", True):
+            key = term.get("topologyKey", "")
+            val = self.labels[j].get(key)
+            if val is None:
+                continue
+            score += w * sum(
+                1
+                for ap, aj in self.assigned
+                if self.labels[aj].get(key) == val and self._term_matches_pod(term, ns, ap)
+            )
+        for term, w in self._pod_terms(pod, "podAntiAffinity", True):
+            key = term.get("topologyKey", "")
+            val = self.labels[j].get(key)
+            if val is None:
+                continue
+            score -= w * sum(
+                1
+                for ap, aj in self.assigned
+                if self.labels[aj].get(key) == val and self._term_matches_pod(term, ns, ap)
+            )
+        hard_w = 1  # args.hardPodAffinityWeight default
+        for ap, aj in self.assigned:
+            ans = _meta(ap).get("namespace") or "default"
+            for term, w in self._pod_terms(ap, "podAffinity", True):
+                key = term.get("topologyKey", "")
+                if self.labels[aj].get(key) is not None and self.labels[j].get(key) == self.labels[aj].get(key):
+                    if self._term_matches_pod(term, ans, pod):
+                        score += w
+            for term, w in self._pod_terms(ap, "podAntiAffinity", True):
+                key = term.get("topologyKey", "")
+                if self.labels[aj].get(key) is not None and self.labels[j].get(key) == self.labels[aj].get(key):
+                    if self._term_matches_pod(term, ans, pod):
+                        score -= w
+            for term, _ in self._pod_terms(ap, "podAffinity", False):
+                key = term.get("topologyKey", "")
+                if self.labels[aj].get(key) is not None and self.labels[j].get(key) == self.labels[aj].get(key):
+                    if self._term_matches_pod(term, ans, pod):
+                        score += hard_w
+        return score
+
+    # ---------------- the cycle -----------------------------------------
+
+    def schedule_one(self, pod) -> tuple[dict[str, str], int]:
+        """-> (annotations, selected node idx or -1); binds on success."""
+        cfg = self.config
+        req, nz = pod_resource_request(pod, self.schema)
+
+        prefilter_status = {
+            name: ("" if self._filter_skip(name, pod) else ann.SUCCESS_MESSAGE)
+            for name in cfg.prefilters()
+        }
+
+        active = [n for n in cfg.filters() if not self._filter_skip(n, pod)]
+        filter_map: dict[str, dict[str, str]] = {}
+        feasible: list[int] = []
+        for j in range(self.n):
+            entry = {}
+            ok = True
+            for name in active:
+                msg = self._filter(name, pod, req, j)
+                if msg is None:
+                    entry[name] = ann.PASSED_FILTER_MESSAGE
+                else:
+                    entry[name] = msg
+                    ok = False
+                    break
+            if entry:
+                filter_map[self.names[j]] = entry
+            if ok:
+                feasible.append(j)
+
+        prescore: dict[str, str] = {}
+        score_map: dict[str, dict[str, str]] = {}
+        final_map: dict[str, dict[str, str]] = {}
+        selected = -1
+        if len(feasible) == 1:
+            selected = feasible[0]
+        elif len(feasible) > 1:
+            for name in cfg.prescorers():
+                prescore[name] = "" if self._score_skip(name, pod) else ann.SUCCESS_MESSAGE
+            totals = {j: 0 for j in feasible}
+            for name in cfg.scorers():
+                if self._score_skip(name, pod):
+                    continue
+                raw = {j: self._score(name, pod, req, nz, j) for j in feasible}
+                normed = self._normalize(name, raw, pod)
+                w = cfg.weight(name)
+                for j in feasible:
+                    score_map.setdefault(self.names[j], {})[name] = str(raw[j])
+                    final = normed[j] * w
+                    final_map.setdefault(self.names[j], {})[name] = str(final)
+                    totals[j] += final
+            best = max(totals.values())
+            selected = min(j for j, t in totals.items() if t == best)
+
+        if selected >= 0:
+            self.requested[selected] = self.requested[selected] + req
+            self.nonzero[selected][0] += int(nz[0])
+            self.nonzero[selected][1] += int(nz[1])
+            self.num_pods[selected] += 1
+            self.assigned.append((pod, selected))
+
+        annotations = {
+            ann.PRE_FILTER_STATUS_RESULT: ann.marshal(prefilter_status),
+            ann.PRE_FILTER_RESULT: ann.marshal({}),
+            ann.FILTER_RESULT: ann.marshal(filter_map),
+            ann.POST_FILTER_RESULT: ann.marshal({}),
+            ann.PRE_SCORE_RESULT: ann.marshal(prescore),
+            ann.SCORE_RESULT: ann.marshal(score_map),
+            ann.FINAL_SCORE_RESULT: ann.marshal(final_map),
+            ann.RESERVE_RESULT: ann.marshal({}),
+            ann.PERMIT_STATUS_RESULT: ann.marshal({}),
+            ann.PERMIT_TIMEOUT_RESULT: ann.marshal({}),
+            ann.PRE_BIND_RESULT: ann.marshal({}),
+            ann.BIND_RESULT: ann.marshal(
+                {"DefaultBinder": ann.SUCCESS_MESSAGE} if selected >= 0 else {}
+            ),
+            ann.SELECTED_NODE: self.names[selected] if selected >= 0 else "",
+        }
+        return annotations, selected
+
+    def schedule_all(self):
+        results = []
+        for pod in self.pods:
+            results.append(self.schedule_one(pod))
+        return results

@@ -1,0 +1,245 @@
+"""Workload compiler: manifests -> device tensors.
+
+This is the TPU-native replacement for the reference's per-cycle object
+traversal: where the Go scheduler re-derives matches from Pod/Node objects
+inside every Filter/Score call (reference:
+simulator/scheduler/plugin/wrappedplugin.go:523-548), we compile the whole
+workload ONCE into:
+
+  * static per-node tensors (allocatable, allowed pods, domain indices),
+  * per-pod tensors with leading axis P (requests, precompiled match rows)
+    — these are the xs of the scheduling lax.scan,
+  * the initial dynamic carry (resource accumulators, per-domain counts).
+
+Already-bound pods (spec.nodeName set + status phase Running, or listed in
+`bound`) are folded into the initial carry exactly like client-go informers
+prime the scheduler's NodeInfo snapshots.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import resources as res
+from .nodes import NodeTable, build_node_table
+from .resources import ResourceSchema, pod_resource_request
+from .vocab import Vocab
+from ..plugins import registry as reg
+from ..plugins import affinity, interpod, noderesources, taints, topologyspread
+
+
+@dataclass
+class CompiledWorkload:
+    schema: ResourceSchema
+    vocab: Vocab
+    node_table: NodeTable
+    pods: list[dict]
+    pod_keys: list[str]                 # "namespace/name"
+    config: reg.PluginSetConfig
+    statics: dict[str, Any]             # plugin name -> static pytree
+    xs: dict[str, Any]                  # plugin name -> per-pod pytree (leading axis P)
+    init_carry: dict[str, Any]          # carry component name -> pytree
+    host: dict[str, Any] = field(default_factory=dict)  # numpy skip flags etc.
+
+    @property
+    def n_pods(self) -> int:
+        return len(self.pods)
+
+    @property
+    def n_nodes(self) -> int:
+        return self.node_table.n
+
+
+def _pod_key(pod: dict) -> str:
+    meta = pod.get("metadata") or {}
+    return f"{meta.get('namespace') or 'default'}/{meta.get('name', '')}"
+
+
+def compile_workload(
+    nodes: list[dict],
+    pods: list[dict],
+    config: reg.PluginSetConfig | None = None,
+    bound_pods: list[tuple[dict, str]] | None = None,
+) -> CompiledWorkload:
+    """Compile (nodes, queue pods, already-bound pods) into device tensors.
+
+    bound_pods: (pod manifest, node name) pairs folded into the initial
+    carry; they also contribute to topology/affinity counts, like the
+    existing cluster pods the reference scheduler sees via informers.
+    """
+    config = config or reg.PluginSetConfig()
+    bound_pods = bound_pods or []
+    vocab = Vocab()
+    schema = ResourceSchema.discover(pods + [bp for bp, _ in bound_pods], nodes)
+    table = build_node_table(nodes, schema, vocab)
+
+    p = len(pods)
+    requests = np.zeros((p, schema.n), dtype=np.int64)
+    nonzero = np.zeros((p, 2), dtype=np.int64)
+    for i, pod in enumerate(pods):
+        requests[i], nonzero[i] = pod_resource_request(pod, schema)
+
+    statics: dict[str, Any] = {}
+    xs: dict[str, Any] = {}
+    init_carry: dict[str, Any] = {}
+    host: dict[str, Any] = {"node_table": table, "schema": schema}
+
+    # core resource carry, primed with bound pods
+    name_idx = {name: j for j, name in enumerate(table.names)}
+    req0 = table.initial_requested.copy()
+    nz0 = table.initial_nonzero.copy()
+    np0 = table.initial_num_pods.copy()
+    for bp, node_name in bound_pods:
+        j = name_idx.get(node_name)
+        if j is None:
+            continue
+        r, nz = pod_resource_request(bp, schema)
+        req0[j] += r
+        nz0[j] += nz
+        np0[j] += 1
+
+    enabled = set(config.enabled)
+    # Fit static/xs double as the core resource tensors even when the Fit
+    # plugin itself is disabled (bind updates always need pod requests).
+    fit_static, fit_xs = noderesources.build_fit(table, schema, requests, nonzero)
+    statics["core"] = fit_static
+    xs["core"] = fit_xs
+    from ..plugins.base import CoreCarry
+
+    init_carry["core"] = CoreCarry(
+        requested=jnp.asarray(req0),
+        nonzero=jnp.asarray(nz0),
+        num_pods=jnp.asarray(np0),
+    )
+
+    if "NodeAffinity" in enabled:
+        xs["NodeAffinity"] = affinity.build(table, pods, vocab)
+    if "TaintToleration" in enabled:
+        xs["TaintToleration"] = taints.build_taints(table, pods)
+    if "NodeUnschedulable" in enabled:
+        xs["NodeUnschedulable"] = taints.build_unschedulable(table, pods)
+    if "NodeName" in enabled:
+        xs["NodeName"] = taints.build_nodename(table, pods)
+    if "PodTopologySpread" in enabled:
+        st, x, counts = topologyspread.build(table, pods, vocab)
+        statics["PodTopologySpread"] = st
+        xs["PodTopologySpread"] = x
+        counts = _prime_spread_counts(counts, st, x, pods, bound_pods, table, vocab, name_idx)
+        init_carry["PodTopologySpread"] = counts
+    if "InterPodAffinity" in enabled:
+        # Build the term table over queue + bound pods together so the bound
+        # pods' terms (which matter for the symmetric existing-pod checks)
+        # share the same term ids; then slice the per-pod xs back to the
+        # queue and fold the bound rows into the initial carry.
+        bound_manifests = [bp for bp, _ in bound_pods]
+        st, x_all, carry = interpod.build(table, pods + bound_manifests, vocab)
+        statics["InterPodAffinity"] = st
+        xs["InterPodAffinity"] = interpod.InterPodXS(
+            *[v[:p] for v in x_all]
+        )
+        carry = _prime_interpod_counts(carry, st, x_all, len(pods), bound_pods, name_idx)
+        init_carry["InterPodAffinity"] = carry
+
+    cw = CompiledWorkload(
+        schema=schema,
+        vocab=vocab,
+        node_table=table,
+        pods=pods,
+        pod_keys=[_pod_key(pod) for pod in pods],
+        config=config,
+        statics=statics,
+        xs=xs,
+        init_carry=init_carry,
+        host=host,
+    )
+    _collect_host_flags(cw)
+    return cw
+
+
+def _prime_spread_counts(counts, st, x, pods, bound_pods, table, vocab, name_idx):
+    """Fold already-bound pods into the per-domain match counts."""
+    if not bound_pods:
+        return counts
+    from ..state.selectors import label_selector_matches
+
+    counts = np.asarray(counts).copy()
+    dom_idx = np.asarray(st.dom_idx)
+    # group selectors were interned during build; recompute matches for the
+    # bound pods (they are not part of the queue, so not in x.pm)
+    groups = _spread_groups(pods)
+    for bp, node_name in bound_pods:
+        j = name_idx.get(node_name)
+        if j is None:
+            continue
+        ns = (bp.get("metadata") or {}).get("namespace") or "default"
+        labels = {k: str(v) for k, v in ((bp.get("metadata") or {}).get("labels") or {}).items()}
+        for c_id, (gns, _, sel) in enumerate(groups):
+            if gns == ns and label_selector_matches(sel, labels) and dom_idx[c_id, j] >= 0:
+                counts[c_id, dom_idx[c_id, j]] += 1
+    return jnp.asarray(counts)
+
+
+def _spread_groups(pods):
+    import json
+
+    seen, out = set(), []
+    for pod in pods:
+        ns = (pod.get("metadata") or {}).get("namespace") or "default"
+        for c in ((pod.get("spec") or {}).get("topologySpreadConstraints") or [])[
+            : topologyspread.MAX_CONSTRAINTS
+        ]:
+            sel = c.get("labelSelector")
+            gk = (ns, c.get("topologyKey", ""), json.dumps(sel, sort_keys=True))
+            if gk not in seen:
+                seen.add(gk)
+                out.append((ns, c.get("topologyKey", ""), sel))
+    return out
+
+
+def _prime_interpod_counts(carry, st, x_all, n_queue, bound_pods, name_idx):
+    """Fold bound pods (rows n_queue.. of x_all) into the interpod carry."""
+    if not bound_pods:
+        return carry
+    mats = {k: np.asarray(v).copy() for k, v in carry._asdict().items()}
+    dom_idx = np.asarray(st.dom_idx)
+    t_matches = np.asarray(x_all.t_matches)
+    h_req_anti = np.asarray(x_all.h_req_anti)
+    h_req_aff = np.asarray(x_all.h_req_aff)
+    h_pref_aff_w = np.asarray(x_all.h_pref_aff_w)
+    h_pref_anti_w = np.asarray(x_all.h_pref_anti_w)
+    for bi, (_, node_name) in enumerate(bound_pods):
+        j = name_idx.get(node_name)
+        if j is None:
+            continue
+        i = n_queue + bi
+        for t_id in range(dom_idx.shape[0]):
+            dm = dom_idx[t_id, j]
+            if dm < 0:
+                continue
+            mats["matched"][t_id, dm] += bool(t_matches[i, t_id])
+            mats["have_req_anti"][t_id, dm] += int(h_req_anti[i, t_id])
+            mats["have_req_aff"][t_id, dm] += int(h_req_aff[i, t_id])
+            mats["sym_pref_aff"][t_id, dm] += int(h_pref_aff_w[i, t_id])
+            mats["sym_pref_anti"][t_id, dm] += int(h_pref_anti_w[i, t_id])
+    return interpod.InterPodCarry(**{k: jnp.asarray(v) for k, v in mats.items()})
+
+
+def _collect_host_flags(cw: CompiledWorkload):
+    """numpy copies of the per-pod skip flags for the annotation decoder."""
+    skips_filter: dict[str, np.ndarray] = {}
+    skips_score: dict[str, np.ndarray] = {}
+    p = cw.n_pods
+    for name in cw.config.enabled:
+        x = cw.xs.get(name)
+        skips_filter[name] = (
+            np.asarray(x.filter_skip) if x is not None and hasattr(x, "filter_skip") else np.zeros(p, bool)
+        )
+        skips_score[name] = (
+            np.asarray(x.score_skip) if x is not None and hasattr(x, "score_skip") else np.zeros(p, bool)
+        )
+    cw.host["filter_skip"] = skips_filter
+    cw.host["score_skip"] = skips_score

@@ -1,0 +1,133 @@
+"""Label-selector / node-affinity / toleration matching (host-side).
+
+Everything in this module is *static* for the duration of a replay: node
+labels and taints never change while pods schedule, and pod selectors are
+fixed at admission.  So all of it is evaluated once, on the host, into
+dense numpy arrays that the device-side kernels consume — matching is never
+done on-device.  This is the key TPU-first restructuring of the reference's
+hot loop (reference: simulator/scheduler/plugin/wrappedplugin.go:523-548
+runs these matches per pod x node x plugin inside the Go scheduler).
+
+Semantics follow upstream k8s.io/kubernetes v1.32 (pin:
+/root/reference/simulator/go.mod:59):
+
+* v1.NodeSelector: OR over terms; term = AND over matchExpressions and
+  matchFields; operators In, NotIn, Exists, DoesNotExist, Gt, Lt.
+* metav1.LabelSelector: AND over matchLabels and matchExpressions
+  (In, NotIn, Exists, DoesNotExist).
+* Toleration.ToleratesTaint: key match (empty key + Exists tolerates all),
+  operator Exists/Equal, effect match (empty effect matches all).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .nodes import NodeTable
+
+
+def _expr_matches_labels(expr: dict, labels: dict[str, str]) -> bool:
+    key = expr.get("key", "")
+    op = expr.get("operator", "")
+    values = expr.get("values") or []
+    has = key in labels
+    if op == "In":
+        return has and labels[key] in values
+    if op == "NotIn":
+        return has and labels[key] not in values
+    if op == "Exists":
+        return has
+    if op == "DoesNotExist":
+        return not has
+    if op in ("Gt", "Lt"):
+        if not has:
+            return False
+        try:
+            lab = int(labels[key])
+            val = int(values[0]) if values else 0
+        except (ValueError, IndexError):
+            return False
+        return lab > val if op == "Gt" else lab < val
+    return False
+
+
+def node_selector_term_matches(term: dict, labels: dict[str, str], node_name: str) -> bool:
+    """One v1.NodeSelectorTerm vs one node. Empty term matches nothing
+    (upstream nodeaffinity.NewNodeSelector drops nil/empty terms)."""
+    exprs = term.get("matchExpressions") or []
+    fields = term.get("matchFields") or []
+    if not exprs and not fields:
+        return False
+    for e in exprs:
+        if not _expr_matches_labels(e, labels):
+            return False
+    for f in fields:
+        # only metadata.name is a valid field selector on nodes
+        if f.get("key") != "metadata.name":
+            return False
+        if not _expr_matches_labels(dict(f, key="metadata.name"), {"metadata.name": node_name}):
+            return False
+    return True
+
+
+def node_selector_matches(selector: dict, labels: dict[str, str], node_name: str) -> bool:
+    """v1.NodeSelector (OR over terms)."""
+    terms = selector.get("nodeSelectorTerms") or []
+    return any(node_selector_term_matches(t, labels, node_name) for t in terms)
+
+
+def label_selector_matches(selector: dict | None, labels: dict[str, str]) -> bool:
+    """metav1.LabelSelector. A nil selector matches nothing; an empty
+    selector ({}) matches everything (apimachinery semantics)."""
+    if selector is None:
+        return False
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != str(v):
+            return False
+    for e in selector.get("matchExpressions") or []:
+        if not _expr_matches_labels(e, labels):
+            return False
+    return True
+
+
+def toleration_tolerates(tol: dict, taint_key: str, taint_value: str, taint_effect: str) -> bool:
+    """upstream v1.Toleration.ToleratesTaint."""
+    if tol.get("effect") and tol["effect"] != taint_effect:
+        return False
+    key = tol.get("key") or ""
+    op = tol.get("operator") or "Equal"
+    if key:
+        if key != taint_key:
+            return False
+    elif op != "Exists":
+        # empty key with operator Equal never matches
+        return False
+    if op == "Exists":
+        return True
+    if op == "Equal":
+        return (tol.get("value") or "") == taint_value
+    return False
+
+
+def tolerations_tolerate(tolerations: list[dict], taint_key, taint_value, taint_effect) -> bool:
+    return any(toleration_tolerates(t, taint_key, taint_value, taint_effect) for t in tolerations)
+
+
+# ---------------------------------------------------------------------------
+# dense pod x node precompilation helpers
+# ---------------------------------------------------------------------------
+
+def node_labels_as_strings(table: NodeTable, vocab) -> list[dict[str, str]]:
+    return [
+        {vocab.string(k): vocab.string(v) for k, v in lab.items()}
+        for lab in table.labels
+    ]
+
+
+def pods_match_label_selector(selector: dict | None, pods: list[dict]) -> np.ndarray:
+    """[P] bool: which pods' labels match the selector."""
+    out = np.zeros(len(pods), dtype=bool)
+    for i, pod in enumerate(pods):
+        labels = {k: str(v) for k, v in ((pod.get("metadata") or {}).get("labels") or {}).items()}
+        out[i] = label_selector_matches(selector, labels)
+    return out

@@ -1,0 +1,35 @@
+"""String interning for the columnar cluster encoding.
+
+Label keys, label values, taint keys, namespaces, topology values etc. are
+interned to dense int32 ids so that all matching becomes integer compares /
+gathers on device. id 0 is reserved as "absent" (ABSENT), so freshly
+zero-initialised arrays mean "no label".
+"""
+
+from __future__ import annotations
+
+ABSENT = 0
+
+
+class Vocab:
+    def __init__(self):
+        self._to_id: dict[str, int] = {}
+        self._to_str: list[str] = ["\x00<absent>"]
+
+    def intern(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def get(self, s: str) -> int:
+        """Return the id for s, or ABSENT if never interned."""
+        return self._to_id.get(s, ABSENT)
+
+    def string(self, i: int) -> str:
+        return self._to_str[i]
+
+    def __len__(self) -> int:
+        return len(self._to_str)

@@ -1,0 +1,120 @@
+"""Device arrays -> per-pod result annotations.
+
+Reconstructs exactly what the reference's result store would serialize for
+each pod (reference: simulator/scheduler/plugin/resultstore/store.go:133-198
+GetStoredResult -> 13 JSON blobs), from the ReplayResult tensors:
+
+  * stop-at-first-fail truncation of the filter map (the framework stops
+    running Filter plugins for a node at the first failure);
+  * scoring recorded only when >1 node was feasible (upstream schedulePod
+    early-returns on a single feasible node, skipping PreScore/Score);
+  * score map covers only feasible nodes (only they are scored);
+  * PreFilter/PreScore Skip recorded as "" (Skip status has an empty
+    message; wrappedplugin.go:507-516 records status.Message());
+  * finalscore = normalized score x plugin weight
+    (resultstore/store.go:488-507).
+"""
+
+from __future__ import annotations
+
+from . import annotations as ann
+from ..framework.replay import ReplayResult
+from ..plugins import affinity, interpod, noderesources, taints, topologyspread
+from ..plugins.registry import PLUGIN_REGISTRY
+
+_DECODERS = {
+    "NodeResourcesFit": lambda code, node, aux: noderesources.decode_fit_filter(code, aux["schema"]),
+    "NodeAffinity": affinity.decode_filter,
+    "TaintToleration": taints.decode_taint_filter,
+    "NodeUnschedulable": lambda code, node, aux: taints.ERR_UNSCHEDULABLE,
+    "NodeName": lambda code, node, aux: taints.ERR_NODE_NAME,
+    "PodTopologySpread": topologyspread.decode_filter,
+    "InterPodAffinity": interpod.decode_filter,
+}
+
+
+def decode_filter_message(name: str, code: int, node_idx: int, host_aux) -> str:
+    return _DECODERS[name](code, node_idx, host_aux)
+
+
+def decode_pod_result(rr: ReplayResult, i: int) -> dict[str, str]:
+    """The 13 plugin annotations for pod i, values JSON-encoded as Go would."""
+    cw = rr.cw
+    cfg = cw.config
+    names = cw.node_table.names
+    filter_names = cfg.filters()
+    score_names = cfg.scorers()
+    fskip = cw.host["filter_skip"]
+    sskip = cw.host["score_skip"]
+
+    # --- prefilter ------------------------------------------------------
+    prefilter_status = {}
+    for name in cfg.prefilters():
+        prefilter_status[name] = "" if fskip[name][i] else ann.SUCCESS_MESSAGE
+
+    # --- filter (stop at first fail per node) ---------------------------
+    active = [
+        (f, name) for f, name in enumerate(filter_names) if not fskip[name][i]
+    ]
+    codes = rr.filter_codes[i]  # [F, N]
+    filter_map: dict[str, dict[str, str]] = {}
+    for n, node in enumerate(names):
+        entry = {}
+        for f, name in active:
+            c = int(codes[f, n])
+            if c == 0:
+                entry[name] = ann.PASSED_FILTER_MESSAGE
+            else:
+                entry[name] = decode_filter_message(name, c, n, cw.host)
+                break
+        if entry:
+            filter_map[node] = entry
+
+    # --- score (only when >1 feasible node) -----------------------------
+    feasible_count = int(rr.feasible_count[i])
+    prescore: dict[str, str] = {}
+    score_map: dict[str, dict[str, str]] = {}
+    final_map: dict[str, dict[str, str]] = {}
+    if feasible_count > 1:
+        for name in cfg.prescorers():
+            prescore[name] = "" if sskip[name][i] else ann.SUCCESS_MESSAGE
+        feasible = (codes[[f for f, _ in active], :] == 0).all(axis=0) if active else None
+        raw = rr.score_raw[i]
+        fin = rr.score_final[i]
+        for n, node in enumerate(names):
+            if feasible is not None and not feasible[n]:
+                continue
+            se, fe = {}, {}
+            for s, name in enumerate(score_names):
+                if sskip[name][i]:
+                    continue
+                se[name] = str(int(raw[s, n]))
+                fe[name] = str(int(fin[s, n]))
+            if se:
+                score_map[node] = se
+                final_map[node] = fe
+
+    # --- bind phase -----------------------------------------------------
+    sel = int(rr.selected[i])
+    scheduled = sel >= 0
+    bind = {"DefaultBinder": ann.SUCCESS_MESSAGE} if scheduled else {}
+
+    return {
+        ann.PRE_FILTER_STATUS_RESULT: ann.marshal(prefilter_status),
+        ann.PRE_FILTER_RESULT: ann.marshal({}),
+        ann.FILTER_RESULT: ann.marshal(filter_map),
+        ann.POST_FILTER_RESULT: ann.marshal({}),
+        ann.PRE_SCORE_RESULT: ann.marshal(prescore),
+        ann.SCORE_RESULT: ann.marshal(score_map),
+        ann.FINAL_SCORE_RESULT: ann.marshal(final_map),
+        ann.RESERVE_RESULT: ann.marshal({}),
+        ann.PERMIT_STATUS_RESULT: ann.marshal({}),
+        ann.PERMIT_TIMEOUT_RESULT: ann.marshal({}),
+        ann.PRE_BIND_RESULT: ann.marshal({}),
+        ann.BIND_RESULT: ann.marshal(bind),
+        ann.SELECTED_NODE: names[sel] if scheduled else "",
+    }
+
+
+def decode_all(rr: ReplayResult) -> list[dict[str, str]]:
+    return [decode_pod_result(rr, i) for i in range(rr.cw.n_pods)]

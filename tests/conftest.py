@@ -1,0 +1,12 @@
+"""Test env: force CPU with 8 virtual devices BEFORE jax initialises.
+
+Multi-chip sharding tests run on a virtual 8-device CPU mesh (the driver
+separately dry-runs the multi-chip path; real TPU is reserved for bench).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,51 @@
+"""CPU-sequential vs TPU-tensor bit-parity over the BASELINE configs.
+
+The correctness gate of BASELINE.md: every result annotation — most
+importantly finalscore-result — must be byte-identical between the scalar
+sequential reference (reference_impl/sequential.py) and the scan engine
+(framework/replay.py + store/decode.py), on every pod of the queue.
+"""
+
+import pytest
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.models.workloads import baseline_config
+from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+
+def run_both(idx: int, scale: float, seed: int = 0):
+    nodes, pods, cfg = baseline_config(idx, scale=scale, seed=seed)
+    seq = SequentialScheduler(nodes, pods, cfg)
+    seq_results = seq.schedule_all()
+
+    cw = compile_workload(nodes, pods, cfg)
+    rr = replay(cw, chunk=64)
+    return seq_results, rr
+
+
+def assert_parity(seq_results, rr):
+    for i, (seq_ann, seq_sel) in enumerate(seq_results):
+        dev_ann = decode_pod_result(rr, i)
+        dev_sel = int(rr.selected[i])
+        assert dev_sel == seq_sel, (
+            f"pod {i}: selected node mismatch device={dev_sel} seq={seq_sel}"
+        )
+        for key in seq_ann:
+            assert dev_ann[key] == seq_ann[key], (
+                f"pod {i}: annotation {key} mismatch\n device={dev_ann[key][:500]}\n"
+                f"    seq={seq_ann[key][:500]}"
+            )
+
+
+@pytest.mark.parametrize("idx,scale", [(1, 1.0), (2, 0.1), (3, 0.02), (4, 0.01), (5, 0.01)])
+def test_baseline_config_parity(idx, scale):
+    seq_results, rr = run_both(idx, scale)
+    assert_parity(seq_results, rr)
+
+
+def test_some_pods_schedule():
+    seq_results, rr = run_both(1, 1.0)
+    assert rr.scheduled > 0
+    assert (rr.selected >= 0).sum() == sum(1 for _, s in seq_results if s >= 0)

@@ -1,0 +1,28 @@
+from kube_scheduler_simulator_tpu.utils.quantity import (
+    parse_cpu_milli,
+    parse_memory_bytes,
+    parse_quantity,
+)
+
+
+def test_cpu_milli():
+    assert parse_cpu_milli("100m") == 100
+    assert parse_cpu_milli("1") == 1000
+    assert parse_cpu_milli("1.5") == 1500
+    assert parse_cpu_milli("0.1") == 100
+    assert parse_cpu_milli(2) == 2000
+    assert parse_cpu_milli("2500u") == 3  # ceil of 2.5m
+
+
+def test_memory_bytes():
+    assert parse_memory_bytes("1Ki") == 1024
+    assert parse_memory_bytes("1Mi") == 1 << 20
+    assert parse_memory_bytes("1.5Gi") == 3 << 29
+    assert parse_memory_bytes("100M") == 100_000_000
+    assert parse_memory_bytes("128974848") == 128974848
+    assert parse_memory_bytes("1k") == 1000
+
+
+def test_exponent_and_suffix():
+    assert parse_quantity("1Gi") == 1 << 30
+    assert parse_quantity("500m") * 2 == 1
