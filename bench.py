@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Benchmark: scheduling-cycles/sec on the BASELINE configs.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol (BASELINE.md): replay a pod queue; a completed scheduling cycle =
+a pod through Filter -> Score -> Normalize -> select -> bind (the
+reference counts Reserve reached).  The TPU number is the warm steady-state
+replay of the full config (default: config 4, 10k pods x 5k nodes) with
+all per-plugin filter/score/finalscore result tensors materialised on
+device; host transfer of the result tensors (the reference does annotation
+write-back asynchronously in its reflector) is reported separately on
+stderr.
+
+The CPU baseline is this repo's sequential reference scheduler (same
+semantics, scalar per-pod/per-node loops — the reference's execution
+style) measured at --cpu-scale of the workload.  Per-cycle CPU cost GROWS
+with node count and queue length, so the reduced-scale CPU cycles/sec
+OVERESTIMATES full-scale CPU throughput, making vs_baseline conservative.
+A small-scale bit-parity check of all annotations gates the result.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def run_parity_gate(idx: int, seed: int) -> bool:
+    from kube_scheduler_simulator_tpu.framework.replay import replay
+    from kube_scheduler_simulator_tpu.models.workloads import baseline_config
+    from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+    from kube_scheduler_simulator_tpu.state.compile import compile_workload
+    from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+    nodes, pods, cfg = baseline_config(idx, scale=0.01, seed=seed)
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=64)
+    for i, (sa, _) in enumerate(seq):
+        da = decode_pod_result(rr, i)
+        for k, v in sa.items():
+            if da[k] != v:
+                log(f"PARITY MISMATCH pod {i} key {k}\n  dev={da[k][:200]}\n  seq={v[:200]}")
+                return False
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=4, choices=[1, 2, 3, 4, 5])
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--cpu-scale", type=float, default=0.05)
+    ap.add_argument("--chunk", type=int, default=1024)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true", help="tiny sizes, fast")
+    ap.add_argument("--skip-parity", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        args.scale, args.cpu_scale, args.chunk = 0.02, 0.02, 64
+
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        from kube_scheduler_simulator_tpu.utils.platform import force_cpu
+
+        force_cpu()
+
+    import jax
+
+    from kube_scheduler_simulator_tpu.framework.replay import replay
+    from kube_scheduler_simulator_tpu.models.workloads import BASELINE_CONFIGS, baseline_config
+    from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+    from kube_scheduler_simulator_tpu.state.compile import compile_workload
+
+    log(f"devices: {jax.devices()}")
+
+    # --- parity gate ----------------------------------------------------
+    if not args.skip_parity:
+        t0 = time.time()
+        ok = run_parity_gate(args.config, args.seed)
+        log(f"parity gate (config {args.config} @0.01): {'OK' if ok else 'FAILED'} "
+            f"({time.time()-t0:.1f}s)")
+        if not ok:
+            print(json.dumps({
+                "metric": f"scheduling_cycles_per_sec_config{args.config}",
+                "value": 0.0, "unit": "cycles/s", "vs_baseline": 0.0,
+            }))
+            return
+
+    # --- TPU measurement ------------------------------------------------
+    nodes, pods, cfg = baseline_config(args.config, scale=args.scale, seed=args.seed)
+    log(f"TPU workload: {len(pods)} pods x {len(nodes)} nodes, plugins={cfg.enabled}")
+    t0 = time.time()
+    cw = compile_workload(nodes, pods, cfg)
+    log(f"compile_workload (host precompile): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    rr = replay(cw, chunk=args.chunk, collect=False)  # warm-up: XLA compile + run
+    log(f"warm-up replay: {time.time()-t0:.1f}s, scheduled {rr.scheduled}/{len(pods)}")
+
+    t0 = time.time()
+    rr = replay(cw, chunk=args.chunk, collect=False)
+    tpu_s = time.time() - t0
+    tpu_cps = len(pods) / tpu_s
+    log(f"timed replay (results on device): {tpu_s:.2f}s -> {tpu_cps:,.0f} cycles/s")
+
+    t0 = time.time()
+    replay(cw, chunk=args.chunk, collect=True)
+    log(f"replay incl. host transfer of result tensors: {time.time()-t0:.2f}s "
+        f"-> {len(pods)/(time.time()-t0):,.0f} cycles/s")
+
+    # --- CPU baseline ---------------------------------------------------
+    cache_path = Path(__file__).parent / ".bench_cpu_cache.json"
+    cache = json.loads(cache_path.read_text()) if cache_path.exists() else {}
+    # key includes the git revision so a code change invalidates the
+    # cached baseline instead of silently skewing vs_baseline
+    try:
+        import subprocess
+
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=Path(__file__).parent,
+        ).stdout.strip() or "norev"
+    except OSError:
+        rev = "norev"
+    key = f"c{args.config}-s{args.cpu_scale}-seed{args.seed}-{rev}"
+    if key in cache:
+        cpu_cps = cache[key]
+        log(f"CPU baseline (cached): {cpu_cps:,.1f} cycles/s")
+    else:
+        cn, cp, ccfg = baseline_config(args.config, scale=args.cpu_scale, seed=args.seed)
+        log(f"CPU baseline workload: {len(cp)} pods x {len(cn)} nodes (sequential reference)")
+        seq = SequentialScheduler(cn, cp, ccfg)
+        t0 = time.time()
+        seq.schedule_all()
+        cpu_s = time.time() - t0
+        cpu_cps = len(cp) / cpu_s
+        log(f"CPU sequential: {cpu_s:.2f}s -> {cpu_cps:,.1f} cycles/s "
+            f"(at {args.cpu_scale}x scale; full-scale CPU would be slower per cycle)")
+        cache[key] = cpu_cps
+        try:
+            cache_path.write_text(json.dumps(cache))
+        except OSError:
+            pass
+
+    full = BASELINE_CONFIGS[args.config]
+    print(json.dumps({
+        "metric": f"scheduling_cycles_per_sec_config{args.config}_{full['pods']}pods_{full['nodes']}nodes"
+                  if args.scale == 1.0 else
+                  f"scheduling_cycles_per_sec_config{args.config}_scale{args.scale}",
+        "value": round(tpu_cps, 1),
+        "unit": "cycles/s",
+        "vs_baseline": round(tpu_cps / cpu_cps, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

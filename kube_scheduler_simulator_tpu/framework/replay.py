@@ -64,15 +64,24 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True) -> Repl
     collect=False skips device->host transfer of the per-node tensors
     (keeps selected/feasible only) — the benchmark's pure-throughput mode.
     """
-    step = build_step(cw)
-
-    def scan_chunk(carry, xs_chunk):
-        return jax.lax.scan(step, carry, xs_chunk)
-
-    scan_jit = jax.jit(scan_chunk, donate_argnums=(0,))
-
     p = cw.n_pods
     chunk = min(chunk, max(p, 1))
+    # cache the jitted scan on the workload: jax.jit keys on function
+    # identity, so rebuilding it per replay() would retrace/recompile on
+    # every call (first TPU compile is tens of seconds).  Keyed on the
+    # post-clamp chunk so different requested chunks that resolve to the
+    # same shape share one compilation.
+    cache = cw.host.setdefault("_scan_cache", {})
+    scan_jit = cache.get(chunk)
+    if scan_jit is None:
+        step = build_step(cw)
+
+        def scan_chunk(carry, xs_chunk):
+            return jax.lax.scan(step, carry, xs_chunk)
+
+        scan_jit = jax.jit(scan_chunk, donate_argnums=(0,))
+        cache[chunk] = scan_jit
+
     # copy: the scan donates its carry argument, and cw.init_carry must
     # survive for subsequent replays of the same compiled workload
     carry = jax.tree.map(jnp.array, cw.init_carry)
@@ -92,16 +101,21 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True) -> Repl
             )
         outs.append(out)
 
-    def cat(field: str, keep: int | None = None) -> np.ndarray:
+    n = cw.n_nodes
+    n_f = len(cw.config.filters())
+    n_s = len(cw.config.scorers())
+
+    def cat(field: str, empty_shape: tuple) -> np.ndarray:
         pieces = [np.asarray(getattr(o, field)) for o in outs]
-        full = np.concatenate(pieces, axis=0) if pieces else np.zeros((0,))
-        return full[:p]
+        if not pieces:
+            return np.zeros(empty_shape, dtype=np.int32)
+        return np.concatenate(pieces, axis=0)[:p]
 
     return ReplayResult(
         cw=cw,
-        filter_codes=cat("filter_codes"),
-        score_raw=cat("score_raw"),
-        score_final=cat("score_final"),
-        selected=cat("selected"),
-        feasible_count=cat("feasible_count"),
+        filter_codes=cat("filter_codes", (0, n_f, n)),
+        score_raw=cat("score_raw", (0, n_s, n)),
+        score_final=cat("score_final", (0, n_s, n)),
+        selected=cat("selected", (0,)),
+        feasible_count=cat("feasible_count", (0,)),
     )

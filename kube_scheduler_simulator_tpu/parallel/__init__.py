@@ -1,0 +1,1 @@
+from .mesh import make_mesh, shard_workload, sharded_step, speculative_scores  # noqa: F401

@@ -1,0 +1,130 @@
+"""Multi-chip scale-out: shard the node axis over a device mesh.
+
+The reference scales Filter/Score across nodes with 16 goroutines inside
+one process (SURVEY.md §2.6); there is no distributed backend to mirror.
+The TPU-native scale-out instead follows the scaling-book recipe: pick a
+mesh, annotate shardings, let XLA insert the collectives.
+
+Axes:
+  "nodes" — the cluster-node axis (the domain's sequence length; SURVEY.md
+            §5 long-context note).  All [N]-shaped and [.., N] tensors are
+            sharded over it; per-node filter/score math is embarrassingly
+            parallel, and the only cross-shard traffic XLA must insert is
+            the argmax/max/min reductions of host selection and score
+            normalization (all-reduce over ICI).
+  "dp"    — speculative pod-batch axis.  Scheduling is sequential across
+            pods (each bind mutates state), but scoring a *batch* of queued
+            pods against the same frozen state is pure fan-out; vmap over
+            the batch, shard it over "dp".
+
+Domain-count carries (counts[C, D], interpod [T, D]) are small and stay
+replicated; their scatter updates are cheap everywhere.
+
+This module is exercised single-host with N virtual CPU devices
+(--xla_force_host_platform_device_count) and by the driver's
+dryrun_multichip; on real multi-chip hardware the same code lays the node
+axis over ICI unchanged — that is the point of jax.sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.pipeline import build_step
+from ..state.compile import CompiledWorkload
+
+
+def make_mesh(n_devices: int | None = None, dp: int = 1) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"asked for {n} devices, only {len(devices)} present")
+    nodes = n // dp
+    arr = np.array(devices[:n]).reshape(dp, nodes)
+    return Mesh(arr, axis_names=("dp", "nodes"))
+
+
+def _node_axis_spec(x, n_nodes: int, skip_leading: bool):
+    """PartitionSpec sharding the node axis over "nodes".
+
+    skip_leading: xs tensors carry the pod axis first — it must never be
+    mistaken for the node axis even when n_pods == n_nodes.  Domain axes D
+    equal to N only happen for hostname topology keys, where domains ARE
+    nodes, so sharding them is correct.
+    """
+    if not hasattr(x, "ndim"):
+        return P()
+    spec: list[Any] = [None] * x.ndim
+    for d in range(1 if skip_leading else 0, x.ndim):
+        if x.shape[d] == n_nodes:
+            spec[d] = "nodes"
+            break  # shard one axis only
+    return P(*spec)
+
+
+def shard_workload(cw: CompiledWorkload, mesh: Mesh) -> CompiledWorkload:
+    """Place statics/xs/carry with the node axis sharded over the mesh."""
+    n = cw.n_nodes
+
+    def place(skip_leading):
+        def f(x):
+            if not hasattr(x, "ndim"):
+                return x
+            return jax.device_put(x, NamedSharding(mesh, _node_axis_spec(x, n, skip_leading)))
+
+        return f
+
+    cw.statics = jax.tree.map(place(False), cw.statics)
+    cw.xs = jax.tree.map(place(True), cw.xs)
+    cw.init_carry = jax.tree.map(place(False), cw.init_carry)
+    return cw
+
+
+def sharded_step(cw: CompiledWorkload, mesh: Mesh | None = None):
+    """jit the fused scheduling step with node-sharded inputs.
+
+    GSPMD propagates the input shardings laid down by shard_workload:
+    elementwise/gather work stays local to each node shard; the
+    feasible-count sum, normalize max/min and select argmax lower to
+    all-reduces over the "nodes" axis.  (mesh is accepted for symmetry
+    with shard_workload; placement travels with the arrays.)
+    """
+    step = build_step(cw)
+    return jax.jit(step)
+
+
+def speculative_scores(cw: CompiledWorkload, mesh: Mesh | None = None):
+    """Batched speculative evaluation: score a pod minibatch against one
+    frozen state.  Returns f(carry, xs_batch) -> StepOut batch; used for
+    lookahead/what-if APIs and the dp shard of the dryrun.
+
+    With a mesh, the minibatch axis is explicitly placed over "dp" (and
+    inner node axes over "nodes") before the call, so each dp slice of the
+    mesh evaluates its own pods against the replicated-carry state.
+    """
+    step = build_step(cw)
+    n = cw.n_nodes
+
+    def eval_only(carry, sl):
+        _, out = step(carry, sl)
+        return out
+
+    batched = jax.jit(jax.vmap(eval_only, in_axes=(None, 0)))
+    if mesh is None:
+        return batched
+
+    def place_batch(x):
+        if not hasattr(x, "ndim") or x.ndim == 0:
+            return x
+        inner = _node_axis_spec(x[0], n, skip_leading=False)
+        return jax.device_put(x, NamedSharding(mesh, P("dp", *inner)))
+
+    def run(carry, xs_batch):
+        return batched(carry, jax.tree.map(place_batch, xs_batch))
+
+    return run

@@ -1,0 +1,33 @@
+"""Platform forcing for CPU-only runs (tests, multi-chip dryrun).
+
+This image's TPU is an out-of-tree PJRT plugin ("axon") registered by a
+sitecustomize hook in every interpreter; its register() overrides
+jax_platforms, so JAX_PLATFORMS=cpu in the environment is NOT sufficient —
+jax.devices() still tries to initialise the TPU client and blocks on the
+tunnel when no chip grant is available.  force_cpu() makes CPU-only runs
+hermetic: pin jax_platforms back to cpu and drop the plugin's backend
+factory before any backend is initialised.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_virtual_devices: int | None = None) -> None:
+    """Call before any jax computation (and ideally before backends init)."""
+    if n_virtual_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n_virtual_devices}".strip()
+            )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass  # jax internals moved; env var path may still suffice

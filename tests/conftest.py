@@ -5,8 +5,11 @@ separately dry-runs the multi-chip path; real TPU is reserved for bench).
 """
 
 import os
+import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kube_scheduler_simulator_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(n_virtual_devices=8)
