@@ -1,0 +1,1 @@
+from .store import ObjectStore, ApiError, RESOURCES, Conflict, NotFound, AlreadyExists  # noqa: F401

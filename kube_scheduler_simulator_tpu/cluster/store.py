@@ -1,0 +1,248 @@
+"""In-memory cluster object store — the KWOK/etcd analogue.
+
+The reference runs against a KWOK fake cluster (etcd + kube-apiserver with
+no kubelets, reference: compose.yml:53-66, kwok.yaml:1-12) and talks to it
+via client-go.  This store replaces that whole external dependency with an
+in-process structure offering the same contract the simulator's services
+rely on:
+
+  * objects are unstructured dicts keyed by (resource, namespace/name);
+  * a single monotonically increasing resourceVersion (etcd revision
+    analogue) stamped on every write;
+  * optimistic concurrency: update with a stale metadata.resourceVersion
+    fails with Conflict — required for the reflector's conflict-retry path
+    (reference: storereflector.go:136-151);
+  * list + watch: watch(resource, since_rv) replays buffered events after
+    since_rv then streams live ones (RetryWatcher analogue, reference:
+    resourcewatcher/resourcewatcher.go:106-134);
+  * dump()/restore() of the full keyspace — the etcd snapshot/restore the
+    reset service uses (reference: reset/reset.go:32-85).
+
+Thread-safe; watch queues are unbounded stdlib queues.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+import time
+import uuid
+
+# resource name -> (kind, namespaced) — the 7 kinds the simulator handles
+# (reference: recorder/recorder.go:45-53 DefaultGVRs)
+RESOURCES: dict[str, tuple[str, bool]] = {
+    "namespaces": ("Namespace", False),
+    "priorityclasses": ("PriorityClass", False),
+    "storageclasses": ("StorageClass", False),
+    "persistentvolumeclaims": ("PersistentVolumeClaim", True),
+    "nodes": ("Node", False),
+    "persistentvolumes": ("PersistentVolume", False),
+    "pods": ("Pod", True),
+}
+
+API_VERSIONS = {
+    "priorityclasses": "scheduling.k8s.io/v1",
+    "storageclasses": "storage.k8s.io/v1",
+}
+
+ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"
+
+_EVENT_BUFFER = 4096  # per-resource ring buffer for watch replay
+
+
+class ApiError(Exception):
+    status = 500
+    reason = "InternalError"
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        self.message = msg
+
+
+class NotFound(ApiError):
+    status = 404
+    reason = "NotFound"
+
+
+class AlreadyExists(ApiError):
+    status = 409
+    reason = "AlreadyExists"
+
+
+class Conflict(ApiError):
+    status = 409
+    reason = "Conflict"
+
+
+def obj_key(obj: dict, namespaced: bool) -> str:
+    meta = obj.get("metadata") or {}
+    name = meta.get("name", "")
+    if namespaced:
+        return f"{meta.get('namespace') or 'default'}/{name}"
+    return name
+
+
+class ObjectStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: dict[str, dict[str, dict]] = {r: {} for r in RESOURCES}
+        self._rv = itertools.count(1)
+        self._last_rv = 0
+        self._events: dict[str, list[tuple[int, str, dict]]] = {r: [] for r in RESOURCES}
+        self._watchers: dict[str, list[queue.Queue]] = {r: [] for r in RESOURCES}
+
+    # ----------------------------------------------------------- helpers
+
+    def _next_rv(self) -> int:
+        self._last_rv = next(self._rv)
+        return self._last_rv
+
+    def _notify(self, resource: str, event_type: str, obj: dict, rv: int):
+        ev = (rv, event_type, obj)
+        buf = self._events[resource]
+        buf.append(ev)
+        if len(buf) > _EVENT_BUFFER:
+            del buf[: len(buf) - _EVENT_BUFFER]
+        for q in self._watchers[resource]:
+            q.put(ev)
+
+    @staticmethod
+    def _stamp_kind(resource: str, obj: dict):
+        kind, _ = RESOURCES[resource]
+        obj.setdefault("kind", kind)
+        obj.setdefault("apiVersion", API_VERSIONS.get(resource, "v1"))
+
+    # ----------------------------------------------------------- CRUD
+
+    def create(self, resource: str, obj: dict) -> dict:
+        if resource not in RESOURCES:
+            raise NotFound(f"unknown resource {resource}")
+        _, namespaced = RESOURCES[resource]
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        if namespaced:
+            meta.setdefault("namespace", "default")
+        key = obj_key(obj, namespaced)
+        with self._lock:
+            if key in self._objects[resource]:
+                raise AlreadyExists(f"{resource} \"{key}\" already exists")
+            rv = self._next_rv()
+            meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+            meta["resourceVersion"] = str(rv)
+            meta.setdefault(
+                "creationTimestamp",
+                time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            )
+            self._stamp_kind(resource, obj)
+            self._objects[resource][key] = obj
+            self._notify(resource, ADDED, copy.deepcopy(obj), rv)
+            return copy.deepcopy(obj)
+
+    def update(self, resource: str, obj: dict) -> dict:
+        _, namespaced = RESOURCES[resource]
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        if namespaced:
+            meta.setdefault("namespace", "default")
+        key = obj_key(obj, namespaced)
+        with self._lock:
+            cur = self._objects[resource].get(key)
+            if cur is None:
+                raise NotFound(f"{resource} \"{key}\" not found")
+            sent_rv = meta.get("resourceVersion")
+            if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                raise Conflict(
+                    f"Operation cannot be fulfilled on {resource} \"{key}\": "
+                    "the object has been modified"
+                )
+            rv = self._next_rv()
+            meta["uid"] = cur["metadata"]["uid"]
+            meta["resourceVersion"] = str(rv)
+            meta.setdefault("creationTimestamp", cur["metadata"].get("creationTimestamp"))
+            self._stamp_kind(resource, obj)
+            self._objects[resource][key] = obj
+            self._notify(resource, MODIFIED, copy.deepcopy(obj), rv)
+            return copy.deepcopy(obj)
+
+    def delete(self, resource: str, name: str, namespace: str | None = None) -> None:
+        _, namespaced = RESOURCES[resource]
+        key = f"{namespace or 'default'}/{name}" if namespaced else name
+        with self._lock:
+            cur = self._objects[resource].pop(key, None)
+            if cur is None:
+                raise NotFound(f"{resource} \"{key}\" not found")
+            rv = self._next_rv()
+            self._notify(resource, DELETED, copy.deepcopy(cur), rv)
+
+    def get(self, resource: str, name: str, namespace: str | None = None) -> dict:
+        _, namespaced = RESOURCES[resource]
+        key = f"{namespace or 'default'}/{name}" if namespaced else name
+        with self._lock:
+            cur = self._objects[resource].get(key)
+            if cur is None:
+                raise NotFound(f"{resource} \"{key}\" not found")
+            return copy.deepcopy(cur)
+
+    def list(self, resource: str, namespace: str | None = None,
+             label_selector: dict | None = None) -> tuple[list[dict], int]:
+        """-> (items, list resourceVersion)."""
+        from ..state.selectors import label_selector_matches
+
+        with self._lock:
+            items = []
+            for key, obj in sorted(self._objects[resource].items()):
+                if namespace and (obj["metadata"].get("namespace") or "default") != namespace:
+                    continue
+                if label_selector is not None:
+                    labels = {
+                        k: str(v)
+                        for k, v in (obj["metadata"].get("labels") or {}).items()
+                    }
+                    if not label_selector_matches(label_selector, labels):
+                        continue
+                items.append(copy.deepcopy(obj))
+            return items, self._last_rv
+
+    # ----------------------------------------------------------- watch
+
+    def watch(self, resource: str, since_rv: int = 0) -> queue.Queue:
+        """Queue of (rv, event_type, object); buffered events newer than
+        since_rv are replayed first.  Call unwatch() when done."""
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            for ev in self._events[resource]:
+                if ev[0] > since_rv:
+                    q.put(ev)
+            self._watchers[resource].append(q)
+        return q
+
+    def unwatch(self, resource: str, q: queue.Queue) -> None:
+        with self._lock:
+            try:
+                self._watchers[resource].remove(q)
+            except ValueError:
+                pass
+
+    # ----------------------------------------------------------- etcd analogue
+
+    def dump(self) -> dict:
+        """Full keyspace snapshot (the etcd-prefix dump reset takes at boot,
+        reference: reset/reset.go:32-55)."""
+        with self._lock:
+            return copy.deepcopy(self._objects)
+
+    def restore(self, kvs: dict) -> None:
+        """Delete-prefix + re-put (reference: reset/reset.go:57-78).  Watch
+        subscribers receive DELETED/ADDED events for the transition."""
+        with self._lock:
+            for resource in RESOURCES:
+                for key in list(self._objects[resource]):
+                    cur = self._objects[resource].pop(key)
+                    self._notify(resource, DELETED, copy.deepcopy(cur), self._next_rv())
+            for resource, objs in kvs.items():
+                for key, obj in objs.items():
+                    obj = copy.deepcopy(obj)
+                    self._objects[resource][key] = obj
+                    self._notify(resource, ADDED, copy.deepcopy(obj), self._next_rv())

@@ -1,0 +1,1 @@
+from .config import SimulatorConfiguration, load_config  # noqa: F401

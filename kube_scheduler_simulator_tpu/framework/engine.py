@@ -1,0 +1,131 @@
+"""Scheduling engine: drives the tensor pipeline against the cluster store.
+
+This is the in-process equivalent of the reference's debuggable-scheduler
+process (SURVEY.md §3.2): it takes pending pods from the cluster, runs the
+batched Filter/Score program, binds the chosen nodes, deposits the decoded
+result annotations in the result store, and triggers the reflector —
+replacing the informer round-trip of the reference (storereflector
+registers a Pod-update handler; binding IS the update that triggers it).
+
+Queue order follows the PrioritySort queue-sort plugin: descending
+.spec.priority, FIFO within equal priority (upstream
+pkg/scheduler/framework/plugins/queuesort).  Unschedulable pods get the
+PodScheduled=False/Unschedulable condition, like the scheduler's status
+update, which also carries their result annotations out.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .replay import replay
+from ..cluster.store import Conflict, NotFound, ObjectStore
+from ..plugins.registry import PluginSetConfig
+from ..state.compile import compile_workload
+from ..store.decode import decode_pod_result
+from ..store.reflector import StoreReflector
+from ..store.resultstore import ResultStore
+
+RESULT_STORE_KEY = "PluginResultStoreKey"  # reference: plugins.go:23
+
+
+class SchedulerEngine:
+    def __init__(self, store: ObjectStore, reflector: StoreReflector | None = None,
+                 result_store: ResultStore | None = None,
+                 plugin_config: PluginSetConfig | None = None,
+                 chunk: int = 512):
+        self.store = store
+        self.result_store = result_store or ResultStore()
+        self.reflector = reflector or StoreReflector(store)
+        if RESULT_STORE_KEY not in self.reflector.result_stores:
+            self.reflector.add_result_store(self.result_store, RESULT_STORE_KEY)
+        self.plugin_config = plugin_config or PluginSetConfig()
+        self.chunk = chunk
+
+    def set_plugin_config(self, cfg: PluginSetConfig) -> None:
+        # validates by constructing; the service uses this for rollback
+        self.plugin_config = PluginSetConfig(enabled=list(cfg.enabled), weights=dict(cfg.weights))
+
+    # ------------------------------------------------------------ run
+
+    def pending_pods(self) -> list[dict]:
+        pods, _ = self.store.list("pods")
+        pending = [p for p in pods if not ((p.get("spec") or {}).get("nodeName"))]
+        # PrioritySort: priority desc, FIFO (creation resourceVersion) within
+        pending.sort(
+            key=lambda p: (
+                -int((p.get("spec") or {}).get("priority") or 0),
+                int((p.get("metadata") or {}).get("resourceVersion") or 0),
+            )
+        )
+        return pending
+
+    def schedule_pending(self, collect: bool = True) -> int:
+        """One scheduling wave over all pending pods. Returns #bound."""
+        pending = self.pending_pods()
+        if not pending:
+            return 0
+        nodes, _ = self.store.list("nodes")
+        pods_all, _ = self.store.list("pods")
+        bound = [
+            (p, p["spec"]["nodeName"]) for p in pods_all
+            if (p.get("spec") or {}).get("nodeName")
+        ]
+        cw = compile_workload(nodes, pending, self.plugin_config, bound_pods=bound)
+        rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)))
+
+        n_bound = 0
+        for i, pod in enumerate(pending):
+            meta = pod.get("metadata") or {}
+            ns, name = meta.get("namespace") or "default", meta.get("name", "")
+            annotations = decode_pod_result(rr, i)
+            self.result_store.put_decoded(ns, name, annotations)
+            sel = int(rr.selected[i])
+            if sel >= 0:
+                self._bind(ns, name, cw.node_table.names[sel])
+                n_bound += 1
+            else:
+                self._mark_unschedulable(ns, name)
+            self.reflector.reflect(ns, name)
+        return n_bound
+
+    # ------------------------------------------------------------ writes
+
+    def _bind(self, ns: str, name: str, node_name: str) -> None:
+        for _ in range(5):
+            try:
+                pod = self.store.get("pods", name, ns)
+            except NotFound:
+                return
+            pod.setdefault("spec", {})["nodeName"] = node_name
+            status = pod.setdefault("status", {})
+            status["phase"] = "Running"  # KWOK-style: no kubelet, fake-run
+            conds = [c for c in status.get("conditions") or [] if c.get("type") != "PodScheduled"]
+            conds.append({"type": "PodScheduled", "status": "True"})
+            status["conditions"] = conds
+            try:
+                self.store.update("pods", pod)
+                return
+            except Conflict:
+                time.sleep(0.001)
+
+    def _mark_unschedulable(self, ns: str, name: str) -> None:
+        for _ in range(5):
+            try:
+                pod = self.store.get("pods", name, ns)
+            except NotFound:
+                return
+            status = pod.setdefault("status", {})
+            status["phase"] = "Pending"
+            conds = [c for c in status.get("conditions") or [] if c.get("type") != "PodScheduled"]
+            conds.append({
+                "type": "PodScheduled", "status": "False",
+                "reason": "Unschedulable",
+                "message": "0/%d nodes are available" % len(self.store.list("nodes")[0]),
+            })
+            status["conditions"] = conds
+            try:
+                self.store.update("pods", pod)
+                return
+            except Conflict:
+                time.sleep(0.001)

@@ -27,7 +27,7 @@ import numpy as np
 
 from .base import default_normalize_score
 from ..state.nodes import NodeTable
-from ..state.selectors import node_selector_matches, node_selector_term_matches, node_labels_as_strings
+from ..state.selectors import node_selector_matches, node_selector_term_matches
 
 NAME = "NodeAffinity"
 ERR_REASON = "node(s) didn't match Pod's node affinity/selector"
@@ -40,9 +40,9 @@ class NodeAffinityXS(NamedTuple):
     score_skip: jnp.ndarray     # [P] bool (PreScore returned Skip)
 
 
-def build(table: NodeTable, pods: list[dict], vocab) -> NodeAffinityXS:
+def build(table: NodeTable, pods: list[dict]) -> NodeAffinityXS:
     n, p = table.n, len(pods)
-    labels = node_labels_as_strings(table, vocab)
+    labels = table.labels
     required_ok = np.ones((p, n), dtype=bool)
     pref_raw = np.zeros((p, n), dtype=np.int32)
     filter_skip = np.zeros(p, dtype=bool)

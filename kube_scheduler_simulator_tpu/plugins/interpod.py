@@ -51,7 +51,7 @@ import numpy as np
 
 from .base import MAX_NODE_SCORE
 from ..state.nodes import NodeTable
-from ..state.selectors import label_selector_matches, node_labels_as_strings
+from ..state.selectors import label_selector_matches
 
 NAME = "InterPodAffinity"
 ERR_AFFINITY = "node(s) didn't match pod affinity rules"
@@ -96,8 +96,9 @@ def _terms_of(pod: dict, field: str, preferred: bool) -> list[tuple[dict, int]]:
     return [(t, 1) for t in aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []]
 
 
-def build(table: NodeTable, pods: list[dict], vocab, hard_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT):
-    labels = node_labels_as_strings(table, vocab)
+def build(table: NodeTable, pods: list[dict],
+          hard_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT):
+    labels = table.labels
     n, p = table.n, len(pods)
 
     # --- unique term table ----------------------------------------------

@@ -64,8 +64,12 @@ def fit_filter(static: FitStatic, pod: FitPodXS, carry) -> jnp.ndarray:
     insufficient = pod.requests[None, :] > free           # [N, R]
     too_many = (carry.num_pods + 1) > static.allowed_pods  # [N]
     bits = jnp.where(insufficient, jnp.int32(2) << jnp.arange(insufficient.shape[1], dtype=jnp.int32), 0)
-    code = jnp.sum(bits, axis=1, dtype=jnp.int32) + jnp.where(too_many, 1, 0).astype(jnp.int32)
-    return code
+    res_code = jnp.sum(bits, axis=1, dtype=jnp.int32)
+    # upstream fitsRequest early-returns after the pod-count check when the
+    # pod requests nothing — an overcommitted node (free < 0) still fits a
+    # zero-request pod
+    res_code = jnp.where(jnp.all(pod.requests == 0), 0, res_code)
+    return res_code + jnp.where(too_many, 1, 0).astype(jnp.int32)
 
 
 def decode_fit_filter(code: int, schema: ResourceSchema) -> str:

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import default_normalize_score
-from ..state.nodes import NodeTable, EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE, EFFECT_PREFER_NO_SCHEDULE, EFFECT_NAMES
+from ..state.nodes import NodeTable, NO_EXECUTE, NO_SCHEDULE, PREFER_NO_SCHEDULE
 from ..state.selectors import tolerations_tolerate
 
 NAME_TAINT = "TaintToleration"
@@ -51,8 +51,7 @@ class UnschedXS(NamedTuple):
 
 
 class NodeNameXS(NamedTuple):
-    fail: jnp.ndarray        # [P, N] bool
-    filter_skip: jnp.ndarray  # [P] bool (PreFilter Skip when no nodeName)
+    fail: jnp.ndarray  # [P, N] bool
 
 
 def build_taints(table: NodeTable, pods: list[dict]) -> TaintXS:
@@ -61,15 +60,14 @@ def build_taints(table: NodeTable, pods: list[dict]) -> TaintXS:
     prefer = np.zeros((p, n), dtype=np.int16)
     for i, pod in enumerate(pods):
         tols = (pod.get("spec") or {}).get("tolerations") or []
-        tols_prefer = [t for t in tols if (t.get("effect") or "") in ("", "PreferNoSchedule")]
+        tols_prefer = [t for t in tols if (t.get("effect") or "") in ("", PREFER_NO_SCHEDULE)]
         for j in range(n):
-            for ti, (_, _, eff, key, value) in enumerate(table.taints[j]):
-                eff_name = EFFECT_NAMES[eff]
-                if eff in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
-                    if code[i, j] == 0 and not tolerations_tolerate(tols, key, value, eff_name):
+            for ti, (key, value, eff) in enumerate(table.taints[j]):
+                if eff in (NO_SCHEDULE, NO_EXECUTE):
+                    if code[i, j] == 0 and not tolerations_tolerate(tols, key, value, eff):
                         code[i, j] = 1 + ti
-                elif eff == EFFECT_PREFER_NO_SCHEDULE:
-                    if not tolerations_tolerate(tols_prefer, key, value, eff_name):
+                elif eff == PREFER_NO_SCHEDULE:
+                    if not tolerations_tolerate(tols_prefer, key, value, eff):
                         prefer[i, j] += 1
     return TaintXS(filter_code=jnp.asarray(code), prefer_count=jnp.asarray(prefer))
 
@@ -87,20 +85,20 @@ def build_unschedulable(table: NodeTable, pods: list[dict]) -> UnschedXS:
 
 
 def build_nodename(table: NodeTable, pods: list[dict]) -> NodeNameXS:
+    """Upstream NodeName has NO PreFilter: its Filter runs (and records
+    "passed") for every pod, empty nodeName matching every node."""
     n, p = table.n, len(pods)
     fail = np.zeros((p, n), dtype=bool)
-    skip = np.zeros(p, dtype=bool)
     name_idx = {name: j for j, name in enumerate(table.names)}
     for i, pod in enumerate(pods):
         want = (pod.get("spec") or {}).get("nodeName") or ""
         if not want:
-            skip[i] = True
             continue
         fail[i, :] = True
         j = name_idx.get(want)
         if j is not None:
             fail[i, j] = False
-    return NodeNameXS(fail=jnp.asarray(fail), filter_skip=jnp.asarray(skip))
+    return NodeNameXS(fail=jnp.asarray(fail))
 
 
 # --- device kernels (pure gathers over the precompiled rows) ---
@@ -119,7 +117,7 @@ def taint_normalize(raw, feasible):
 
 def decode_taint_filter(code: int, node_idx: int, host_aux) -> str:
     table: NodeTable = host_aux["node_table"]
-    _, _, _, key, value = table.taints[node_idx][code - 1]
+    key, value, _ = table.taints[node_idx][code - 1]
     return "node(s) had untolerated taint {%s: %s}" % (key, value)
 
 

@@ -47,7 +47,6 @@ from .base import MAX_NODE_SCORE
 from ..state.nodes import NodeTable
 from ..state.selectors import (
     label_selector_matches,
-    node_labels_as_strings,
     node_selector_matches,
 )
 
@@ -100,8 +99,8 @@ def _node_affinity_eligible(pod: dict, labels: list[dict], names: list[str]) -> 
     return out
 
 
-def build(table: NodeTable, pods: list[dict], vocab):
-    labels = node_labels_as_strings(table, vocab)
+def build(table: NodeTable, pods: list[dict]):
+    labels = table.labels
     n, p = table.n, len(pods)
 
     # --- collect unique count groups over the whole workload -------------

@@ -19,16 +19,14 @@ from __future__ import annotations
 import math
 
 from ..plugins.registry import PluginSetConfig
-from ..state.nodes import build_node_table, EFFECT_NAMES, EFFECT_PREFER_NO_SCHEDULE
+from ..state.nodes import build_node_table, PREFER_NO_SCHEDULE
 from ..state.resources import CPU, MEMORY, ResourceSchema, pod_resource_request
 from ..state.selectors import (
     label_selector_matches,
-    node_labels_as_strings,
     node_selector_matches,
     node_selector_term_matches,
     tolerations_tolerate,
 )
-from ..state.vocab import Vocab
 from ..store import annotations as ann
 
 MAX_NODE_SCORE = 100
@@ -47,9 +45,8 @@ class SequentialScheduler:
         self.config = config or PluginSetConfig()
         self.pods = pods
         self.schema = ResourceSchema.discover(pods + [bp for bp, _ in (bound_pods or [])], nodes)
-        self.vocab = Vocab()
-        self.table = build_node_table(nodes, self.schema, self.vocab)
-        self.labels = node_labels_as_strings(self.table, self.vocab)
+        self.table = build_node_table(nodes, self.schema)
+        self.labels = self.table.labels
         self.names = self.table.names
         self.n = self.table.n
         self.requested = [row.copy() for row in self.table.allocatable * 0]
@@ -76,11 +73,12 @@ class SequentialScheduler:
             reasons = []
             if self.num_pods[j] + 1 > self.table.allowed_pods[j]:
                 reasons.append("Too many pods")
-            alloc = self.table.allocatable[j]
-            free = alloc - self.requested[j]
-            for r, col in enumerate(self.schema.columns):
-                if req[r] > free[r]:
-                    reasons.append(f"Insufficient {col}")
+            if any(req):  # zero-request pods only face the pod-count check
+                alloc = self.table.allocatable[j]
+                free = alloc - self.requested[j]
+                for r, col in enumerate(self.schema.columns):
+                    if req[r] > free[r]:
+                        reasons.append(f"Insufficient {col}")
             return ", ".join(reasons) if reasons else None
         if name == "NodeAffinity":
             spec = _spec(pod)
@@ -94,10 +92,10 @@ class SequentialScheduler:
             return None if ok else "node(s) didn't match Pod's node affinity/selector"
         if name == "TaintToleration":
             tols = _spec(pod).get("tolerations") or []
-            for _, _, eff, key, value in self.table.taints[j]:
-                if eff == EFFECT_PREFER_NO_SCHEDULE:
+            for key, value, eff in self.table.taints[j]:
+                if eff == PREFER_NO_SCHEDULE:
                     continue
-                if not tolerations_tolerate(tols, key, value, EFFECT_NAMES[eff]):
+                if not tolerations_tolerate(tols, key, value, eff):
                     return "node(s) had untolerated taint {%s: %s}" % (key, value)
             return None
         if name == "NodeUnschedulable":
@@ -123,8 +121,6 @@ class SequentialScheduler:
                 "requiredDuringSchedulingIgnoredDuringExecution"
             )
             return not spec.get("nodeSelector") and not req
-        if name == "NodeName":
-            return not (_spec(pod).get("nodeName") or "")
         if name == "PodTopologySpread":
             cs = _spec(pod).get("topologySpreadConstraints") or []
             return not any(c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" for c in cs)
@@ -177,12 +173,12 @@ class SequentialScheduler:
             tols = [
                 t
                 for t in (_spec(pod).get("tolerations") or [])
-                if (t.get("effect") or "") in ("", "PreferNoSchedule")
+                if (t.get("effect") or "") in ("", PREFER_NO_SCHEDULE)
             ]
             cnt = 0
-            for _, _, eff, key, value in self.table.taints[j]:
-                if eff == EFFECT_PREFER_NO_SCHEDULE and not tolerations_tolerate(
-                    tols, key, value, "PreferNoSchedule"
+            for key, value, eff in self.table.taints[j]:
+                if eff == PREFER_NO_SCHEDULE and not tolerations_tolerate(
+                    tols, key, value, PREFER_NO_SCHEDULE
                 ):
                     cnt += 1
             return cnt
@@ -229,17 +225,21 @@ class SequentialScheduler:
                 out.append(c)
         return out
 
-    def _count_matching(self, ns: str, selector, key: str, value: str) -> int:
-        cnt = 0
+    def _count_by_domain(self, ns: str, selector, key: str) -> dict[str, int]:
+        """Existing pods matching (ns, selector) per domain value of key —
+        computed ONCE per scheduling cycle, like upstream's PreFilter
+        building TpPairToMatchNum before the per-node Filter calls."""
+        counts: dict[str, int] = {}
         for ap, aj in self.assigned:
             if (_meta(ap).get("namespace") or "default") != ns:
                 continue
-            if self.labels[aj].get(key) != value:
+            val = self.labels[aj].get(key)
+            if val is None:
                 continue
             lab = {k: str(v) for k, v in (_meta(ap).get("labels") or {}).items()}
             if label_selector_matches(selector, lab):
-                cnt += 1
-        return cnt
+                counts[val] = counts.get(val, 0) + 1
+        return counts
 
     def _eligible_nodes(self, pod):
         spec = _spec(pod)
@@ -255,44 +255,81 @@ class SequentialScheduler:
             out.append(ok)
         return out
 
-    def _spread_filter(self, pod, j) -> str | None:
+    def _spread_prefilter_state(self, pod) -> list[dict]:
+        """Per-cycle state for the DoNotSchedule constraints (upstream
+        preFilterState: counts per domain + critical-path min)."""
+        if "spread_filter" in self._cycle:
+            return self._cycle["spread_filter"]
         ns = _meta(pod).get("namespace") or "default"
         pod_labels = {k: str(v) for k, v in (_meta(pod).get("labels") or {}).items()}
-        eligible = self._eligible_nodes(pod)
+        eligible = None
+        state = []
         for c in self._spread_constraints(pod, hard=True):
+            if eligible is None:
+                eligible = self._eligible_nodes(pod)
             key = c.get("topologyKey", "")
-            if key not in self.labels[j]:
-                return "node(s) didn't match pod topology spread constraints (missing required label)"
             sel = c.get("labelSelector")
-            self_match = 1 if label_selector_matches(sel, pod_labels) else 0
-            cnt = self._count_matching(ns, sel, key, self.labels[j][key])
-            domains = {self.labels[k].get(key) for k in range(self.n) if eligible[k] and key in self.labels[k]}
-            if not domains:
+            counts = self._count_by_domain(ns, sel, key)
+            domains = {
+                self.labels[k].get(key)
+                for k in range(self.n)
+                if eligible[k] and key in self.labels[k]
+            }
+            min_match = min((counts.get(d, 0) for d in domains), default=None)
+            state.append({
+                "key": key,
+                "max_skew": int(c.get("maxSkew", 1)),
+                "self_match": 1 if label_selector_matches(sel, pod_labels) else 0,
+                "counts": counts,
+                "min_match": min_match,  # None: no eligible domain -> pass
+            })
+        self._cycle["spread_filter"] = state
+        return state
+
+    def _spread_prescore_state(self, pod) -> list[dict]:
+        if "spread_score" in self._cycle:
+            return self._cycle["spread_score"]
+        ns = _meta(pod).get("namespace") or "default"
+        state = []
+        for c in self._spread_constraints(pod, hard=False):
+            key = c.get("topologyKey", "")
+            n_domains = len({
+                self.labels[k].get(key) for k in range(self.n) if key in self.labels[k]
+            })
+            state.append({
+                "key": key,
+                "counts": self._count_by_domain(ns, c.get("labelSelector"), key),
+                "weight": math.log(float(n_domains) + 2.0),
+            })
+        self._cycle["spread_score"] = state
+        return state
+
+    def _spread_filter(self, pod, j) -> str | None:
+        for c in self._spread_prefilter_state(pod):
+            val = self.labels[j].get(c["key"])
+            if val is None:
+                return "node(s) didn't match pod topology spread constraints (missing required label)"
+            if c["min_match"] is None:
                 # upstream minMatchNum stays MaxInt when no eligible domain
                 # exists -> skew is negative -> the constraint passes
                 continue
-            min_match = min(self._count_matching(ns, sel, key, d) for d in domains)
-            if cnt + self_match - min_match > int(c.get("maxSkew", 1)):
+            skew = c["counts"].get(val, 0) + c["self_match"] - c["min_match"]
+            if skew > c["max_skew"]:
                 return "node(s) didn't match pod topology spread constraints"
         return None
 
     def _spread_score(self, pod, j) -> int:
-        ns = _meta(pod).get("namespace") or "default"
         total = 0.0
-        for c in self._spread_constraints(pod, hard=False):
-            key = c.get("topologyKey", "")
-            if key not in self.labels[j]:
+        for c in self._spread_prescore_state(pod):
+            val = self.labels[j].get(c["key"])
+            if val is None:
                 return 0  # ignored node
-            sel = c.get("labelSelector")
-            n_domains = len({self.labels[k].get(key) for k in range(self.n) if key in self.labels[k]})
-            cnt = self._count_matching(ns, sel, key, self.labels[j][key])
-            total += float(cnt) * math.log(float(n_domains) + 2.0)
+            total += float(c["counts"].get(val, 0)) * c["weight"]
         return int(math.floor(total + 0.5))
 
     def _spread_ignored(self, pod, j) -> bool:
         return any(
-            c.get("topologyKey", "") not in self.labels[j]
-            for c in self._spread_constraints(pod, hard=False)
+            c["key"] not in self.labels[j] for c in self._spread_prescore_state(pod)
         )
 
     def _spread_normalize(self, scores: dict[int, int], pod) -> dict[int, int]:
@@ -339,97 +376,119 @@ class SequentialScheduler:
                 return False
         return True
 
-    def _interpod_filter(self, pod, j) -> str | None:
-        ns = _meta(pod).get("namespace") or "default"
-        aff_terms = self._pod_terms(pod, "podAffinity", False)
-        # 1. required affinity
-        if aff_terms:
-            all_ok = True
-            for term, _ in aff_terms:
-                key = term.get("topologyKey", "")
-                val = self.labels[j].get(key)
-                ok = val is not None and any(
-                    self.labels[aj].get(key) == val and self._term_matches_pod(term, ns, ap)
-                    for ap, aj in self.assigned
-                )
-                if not ok:
-                    all_ok = False
-                    break
-            if not all_ok:
-                any_match_anywhere = any(
-                    self._term_matches_pod(term, ns, ap)
-                    for term, _ in aff_terms
-                    for ap, _ in self.assigned
-                )
-                pod_self = {"metadata": _meta(pod)}
-                self_ok = all(self._term_matches_pod(t, ns, pod_self) for t, _ in aff_terms)
-                node_has_keys = all(t.get("topologyKey", "") in self.labels[j] for t, _ in aff_terms)
-                if not (not any_match_anywhere and self_ok and node_has_keys):
-                    return "node(s) didn't match pod affinity rules"
-        # 2. required anti-affinity
-        for term, _ in self._pod_terms(pod, "podAntiAffinity", False):
-            key = term.get("topologyKey", "")
-            val = self.labels[j].get(key)
+    def _term_counts_by_domain(self, term, owner_ns) -> tuple[dict[str, int], int]:
+        """(matching existing pods per domain value of the term's key,
+        total over keyed nodes) — per-cycle PreFilter-style precompute."""
+        key = term.get("topologyKey", "")
+        counts: dict[str, int] = {}
+        total = 0
+        for ap, aj in self.assigned:
+            val = self.labels[aj].get(key)
             if val is None:
                 continue
-            if any(
-                self.labels[aj].get(key) == val and self._term_matches_pod(term, ns, ap)
-                for ap, aj in self.assigned
-            ):
-                return "node(s) didn't match pod anti-affinity rules"
-        # 3. existing pods' required anti-affinity vs this pod
+            if self._term_matches_pod(term, owner_ns, ap):
+                counts[val] = counts.get(val, 0) + 1
+                total += 1
+        return counts, total
+
+    def _interpod_filter_state(self, pod) -> dict:
+        """Per-cycle state (upstream preFilterState: affinityCounts,
+        antiAffinityCounts, existingAntiAffinityCounts)."""
+        if "interpod_filter" in self._cycle:
+            return self._cycle["interpod_filter"]
+        ns = _meta(pod).get("namespace") or "default"
+        aff_terms = self._pod_terms(pod, "podAffinity", False)
+        anti_terms = self._pod_terms(pod, "podAntiAffinity", False)
+        aff = [(t, *self._term_counts_by_domain(t, ns)) for t, _ in aff_terms]
+        anti = [(t, self._term_counts_by_domain(t, ns)[0]) for t, _ in anti_terms]
+        existing_anti: dict[tuple[str, str], int] = {}
         for ap, aj in self.assigned:
             ans = _meta(ap).get("namespace") or "default"
             for term, _ in self._pod_terms(ap, "podAntiAffinity", False):
                 key = term.get("topologyKey", "")
                 val = self.labels[aj].get(key)
-                if val is None or self.labels[j].get(key) != val:
+                if val is None or not self._term_matches_pod(term, ans, pod):
                     continue
-                if self._term_matches_pod(term, ans, pod):
-                    return "node(s) didn't satisfy existing pods' anti-affinity rules"
+                existing_anti[(key, val)] = existing_anti.get((key, val), 0) + 1
+        pod_self = {"metadata": _meta(pod)}
+        state = {
+            "aff": aff,
+            "anti": anti,
+            "existing_anti": existing_anti,
+            "self_ok": all(self._term_matches_pod(t, ns, pod_self) for t, _ in aff_terms),
+        }
+        self._cycle["interpod_filter"] = state
+        return state
+
+    def _interpod_filter(self, pod, j) -> str | None:
+        st = self._interpod_filter_state(pod)
+        # 1. required affinity
+        if st["aff"]:
+            all_ok = all(
+                (val := self.labels[j].get(term.get("topologyKey", ""))) is not None
+                and counts.get(val, 0) > 0
+                for term, counts, _ in st["aff"]
+            )
+            if not all_ok:
+                # first-pod-in-series escape: no existing pod (on a keyed
+                # node) matches any term, the pod matches its own terms,
+                # and the node has all term keys
+                any_match_anywhere = any(total > 0 for _, _, total in st["aff"])
+                node_has_keys = all(
+                    term.get("topologyKey", "") in self.labels[j] for term, _, _ in st["aff"]
+                )
+                if not (not any_match_anywhere and st["self_ok"] and node_has_keys):
+                    return "node(s) didn't match pod affinity rules"
+        # 2. required anti-affinity
+        for term, counts in st["anti"]:
+            val = self.labels[j].get(term.get("topologyKey", ""))
+            if val is not None and counts.get(val, 0) > 0:
+                return "node(s) didn't match pod anti-affinity rules"
+        # 3. existing pods' required anti-affinity vs this pod
+        for (key, val), cnt in st["existing_anti"].items():
+            if cnt > 0 and self.labels[j].get(key) == val:
+                return "node(s) didn't satisfy existing pods' anti-affinity rules"
         return None
 
-    def _interpod_score(self, pod, j) -> int:
+    def _interpod_score_state(self, pod) -> dict:
+        if "interpod_score" in self._cycle:
+            return self._cycle["interpod_score"]
         ns = _meta(pod).get("namespace") or "default"
-        score = 0
+        own = []
         for term, w in self._pod_terms(pod, "podAffinity", True):
-            key = term.get("topologyKey", "")
-            val = self.labels[j].get(key)
-            if val is None:
-                continue
-            score += w * sum(
-                1
-                for ap, aj in self.assigned
-                if self.labels[aj].get(key) == val and self._term_matches_pod(term, ns, ap)
-            )
+            counts, _ = self._term_counts_by_domain(term, ns)
+            own.append((term.get("topologyKey", ""), counts, w))
         for term, w in self._pod_terms(pod, "podAntiAffinity", True):
-            key = term.get("topologyKey", "")
-            val = self.labels[j].get(key)
-            if val is None:
-                continue
-            score -= w * sum(
-                1
-                for ap, aj in self.assigned
-                if self.labels[aj].get(key) == val and self._term_matches_pod(term, ns, ap)
-            )
+            counts, _ = self._term_counts_by_domain(term, ns)
+            own.append((term.get("topologyKey", ""), counts, -w))
         hard_w = 1  # args.hardPodAffinityWeight default
+        sym: dict[tuple[str, str], int] = {}
         for ap, aj in self.assigned:
             ans = _meta(ap).get("namespace") or "default"
-            for term, w in self._pod_terms(ap, "podAffinity", True):
+            for term, w, sign in (
+                [(t, w, 1) for t, w in self._pod_terms(ap, "podAffinity", True)]
+                + [(t, w, -1) for t, w in self._pod_terms(ap, "podAntiAffinity", True)]
+                + [(t, hard_w, 1) for t, _ in self._pod_terms(ap, "podAffinity", False)]
+            ):
                 key = term.get("topologyKey", "")
-                if self.labels[aj].get(key) is not None and self.labels[j].get(key) == self.labels[aj].get(key):
-                    if self._term_matches_pod(term, ans, pod):
-                        score += w
-            for term, w in self._pod_terms(ap, "podAntiAffinity", True):
-                key = term.get("topologyKey", "")
-                if self.labels[aj].get(key) is not None and self.labels[j].get(key) == self.labels[aj].get(key):
-                    if self._term_matches_pod(term, ans, pod):
-                        score -= w
-            for term, _ in self._pod_terms(ap, "podAffinity", False):
-                key = term.get("topologyKey", "")
-                if self.labels[aj].get(key) is not None and self.labels[j].get(key) == self.labels[aj].get(key):
-                    if self._term_matches_pod(term, ans, pod):
-                        score += hard_w
+                val = self.labels[aj].get(key)
+                if val is None or not self._term_matches_pod(term, ans, pod):
+                    continue
+                sym[(key, val)] = sym.get((key, val), 0) + sign * w
+        state = {"own": own, "sym": sym}
+        self._cycle["interpod_score"] = state
+        return state
+
+    def _interpod_score(self, pod, j) -> int:
+        st = self._interpod_score_state(pod)
+        score = 0
+        for key, counts, w in st["own"]:
+            val = self.labels[j].get(key)
+            if val is not None:
+                score += w * counts.get(val, 0)
+        for (key, val), delta in st["sym"].items():
+            if self.labels[j].get(key) == val:
+                score += delta
         return score
 
     # ---------------- the cycle -----------------------------------------
@@ -437,6 +496,7 @@ class SequentialScheduler:
     def schedule_one(self, pod) -> tuple[dict[str, str], int]:
         """-> (annotations, selected node idx or -1); binds on success."""
         cfg = self.config
+        self._cycle = {}  # per-cycle PreFilter/PreScore state cache
         req, nz = pod_resource_request(pod, self.schema)
 
         prefilter_status = {

@@ -1,0 +1,6 @@
+from .service import SchedulerService  # noqa: F401
+from .convert import (  # noqa: F401
+    convert_configuration_for_simulator,
+    default_scheduler_config,
+    parse_plugin_set,
+)

@@ -1,0 +1,154 @@
+"""KubeSchedulerConfiguration handling: defaults, simulator conversion,
+and the mapping onto the tensor pipeline.
+
+Capability parity with the reference's config rewrite machinery:
+
+  * default_scheduler_config — scheme-defaulted default configuration
+    (reference: simulator/scheduler/config/config.go:20-26);
+  * convert_configuration_for_simulator — ensures a default profile,
+    renames every enabled plugin "<Name>Wrapped", merges the default
+    MultiPoint set, disables "*" so the scheduler only runs the wrapped
+    factories (reference: scheduler.go:141-173, plugin/plugins.go:174-226
+    applyPluginSet/disableAllPluginSet, :230-285 mergePluginSet);
+  * parse_plugin_set — derives the tensor pipeline's PluginSetConfig
+    (enabled plugins + score weights) from a user config, the analogue of
+    getScorePluginWeight (plugins.go:289-304: weight 0 means 1).
+
+Configs are plain dicts in the kubescheduler.config.k8s.io/v1 wire shape.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..plugins.registry import DEFAULT_ORDER, PLUGIN_REGISTRY, PluginSetConfig
+
+WRAPPED_SUFFIX = "Wrapped"
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+
+def default_scheduler_config() -> dict:
+    return {
+        "apiVersion": "kubescheduler.config.k8s.io/v1",
+        "kind": "KubeSchedulerConfiguration",
+        "parallelism": 16,
+        "profiles": [
+            {
+                "schedulerName": DEFAULT_SCHEDULER_NAME,
+                "plugins": {"multiPoint": {"enabled": [
+                    {"name": n, "weight": PLUGIN_REGISTRY[n].default_weight}
+                    if PLUGIN_REGISTRY[n].has_score else {"name": n}
+                    for n in DEFAULT_ORDER
+                ]}},
+                "pluginConfig": [],
+            }
+        ],
+        "extenders": [],
+    }
+
+
+def _wrapped(name: str) -> str:
+    return name if name == "*" else name + WRAPPED_SUFFIX
+
+
+def _merge_plugin_set(default_set: dict, custom_set: dict) -> dict:
+    """upstream mergePluginSet semantics (copied into the reference at
+    plugins.go:230-285): custom disables (incl. "*") suppress defaults;
+    custom enables replace same-named defaults in place, else append."""
+    disabled = [{"name": d.get("name", "")} for d in custom_set.get("disabled") or []]
+    disabled += [{"name": d.get("name", "")} for d in default_set.get("disabled") or []]
+    disabled_names = {d["name"] for d in disabled}
+
+    custom_enabled = {p.get("name"): (i, p) for i, p in enumerate(custom_set.get("enabled") or [])}
+    replaced = set()
+    enabled = []
+    if "*" not in disabled_names:
+        for p in default_set.get("enabled") or []:
+            if p.get("name") in disabled_names:
+                continue
+            if p.get("name") in custom_enabled:
+                i, cp = custom_enabled[p["name"]]
+                replaced.add(i)
+                p = cp
+            enabled.append(copy.deepcopy(p))
+    for i, p in enumerate(custom_set.get("enabled") or []):
+        if i not in replaced:
+            enabled.append(copy.deepcopy(p))
+    return {"enabled": enabled, "disabled": disabled}
+
+
+_EXTENSION_POINTS = [
+    "preEnqueue", "queueSort", "preFilter", "filter", "postFilter",
+    "preScore", "score", "reserve", "permit", "preBind", "bind", "postBind",
+]
+
+
+def convert_configuration_for_simulator(cfg: dict) -> dict:
+    """reference: scheduler.go:141-173 ConvertConfigurationForSimulator."""
+    cfg = copy.deepcopy(cfg or {})
+    cfg.setdefault("apiVersion", "kubescheduler.config.k8s.io/v1")
+    cfg.setdefault("kind", "KubeSchedulerConfiguration")
+    if not cfg.get("profiles"):
+        cfg["profiles"] = [{"schedulerName": DEFAULT_SCHEDULER_NAME, "plugins": {}}]
+
+    default_multipoint = default_scheduler_config()["profiles"][0]["plugins"]["multiPoint"]
+
+    for profile in cfg["profiles"]:
+        plugins = profile.setdefault("plugins", {}) or {}
+        profile["plugins"] = plugins
+        for point in _EXTENSION_POINTS:
+            ps = plugins.get(point) or {}
+            merged = _merge_plugin_set({}, ps)
+            plugins[point] = {
+                "enabled": [
+                    {k: v for k, v in dict(p, name=_wrapped(p.get("name", ""))).items()}
+                    for p in merged["enabled"]
+                ],
+                "disabled": [{"name": _wrapped(d["name"])} for d in merged["disabled"]],
+            }
+        mp = _merge_plugin_set(default_multipoint | {"disabled": []}, plugins.get("multiPoint") or {})
+        plugins["multiPoint"] = {
+            "enabled": [
+                dict(p, name=_wrapped(p.get("name", ""))) for p in mp["enabled"]
+            ],
+            # the default MultiPoint set must be disabled to "*" so the
+            # scheduler doesn't also enable unwrapped default plugins
+            "disabled": [{"name": "*"}],
+        }
+    return cfg
+
+
+def parse_plugin_set(cfg: dict | None) -> PluginSetConfig:
+    """User config -> tensor pipeline plugin set.
+
+    Unknown (not-yet-tensorized) plugins are ignored; weights follow
+    getScorePluginWeight: explicit weight, else 1 when configured enabled
+    with weight 0, else the upstream default weight."""
+    cfg = cfg or {}
+    profiles = cfg.get("profiles") or []
+    plugins = (profiles[0].get("plugins") or {}) if profiles else {}
+    mp = plugins.get("multiPoint") or {}
+    score = plugins.get("score") or {}
+
+    default_multipoint = default_scheduler_config()["profiles"][0]["plugins"]["multiPoint"]
+    merged = _merge_plugin_set(default_multipoint | {"disabled": []}, mp)
+
+    enabled, weights = [], {}
+    for p in merged["enabled"]:
+        name = (p.get("name") or "").removesuffix(WRAPPED_SUFFIX)
+        if name not in PLUGIN_REGISTRY:
+            continue
+        enabled.append(name)
+        if PLUGIN_REGISTRY[name].has_score:
+            w = int(p.get("weight") or 0)
+            weights[name] = w if w != 0 else 1
+    for p in score.get("enabled") or []:
+        name = (p.get("name") or "").removesuffix(WRAPPED_SUFFIX)
+        if name in PLUGIN_REGISTRY:
+            if name not in enabled:
+                enabled.append(name)
+            w = int(p.get("weight") or 0)
+            weights[name] = w if w != 0 else 1
+    for d in score.get("disabled") or []:
+        weights.pop((d.get("name") or "").removesuffix(WRAPPED_SUFFIX), None)
+    return PluginSetConfig(enabled=enabled, weights=weights)

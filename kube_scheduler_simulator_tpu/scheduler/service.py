@@ -1,0 +1,49 @@
+"""Scheduler service: config lifecycle + engine restart.
+
+Capability parity with the reference scheduler service (reference:
+simulator/scheduler/scheduler.go): holds current + initial
+KubeSchedulerConfiguration (:27-38); RestartScheduler applies a new
+config and ROLLS BACK to the old one if the restart fails (:90-111 —
+there, a Docker container restart; here, rebuilding the tensor pipeline
+configuration); ResetScheduler restores the initial config (:113-115).
+GetSchedulerConfig returns the user-shape config, not the converted one,
+exactly as the reference stores the unconverted cfg in
+currentSchedulerCfg (:124-130).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from .convert import default_scheduler_config, parse_plugin_set
+
+
+class SchedulerService:
+    def __init__(self, engine=None, initial_config: dict | None = None):
+        self.engine = engine
+        self._initial = copy.deepcopy(initial_config) if initial_config else default_scheduler_config()
+        self._current = copy.deepcopy(self._initial)
+        if engine is not None:
+            engine.set_plugin_config(parse_plugin_set(self._current))
+
+    def get_config(self) -> dict:
+        return copy.deepcopy(self._current)
+
+    def restart_scheduler(self, cfg: dict | None) -> None:
+        """Apply cfg; on failure restore the previous config (reference:
+        scheduler.go:102-108 rollback)."""
+        if cfg is None:
+            cfg = default_scheduler_config()
+        old = self._current
+        try:
+            plugin_set = parse_plugin_set(cfg)
+            if self.engine is not None:
+                self.engine.set_plugin_config(plugin_set)
+            self._current = copy.deepcopy(cfg)
+        except Exception:
+            if self.engine is not None:
+                self.engine.set_plugin_config(parse_plugin_set(old))
+            raise
+
+    def reset_scheduler(self) -> None:
+        self.restart_scheduler(copy.deepcopy(self._initial))

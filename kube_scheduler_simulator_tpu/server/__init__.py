@@ -1,0 +1,2 @@
+from .di import DIContainer  # noqa: F401
+from .server import SimulatorServer  # noqa: F401

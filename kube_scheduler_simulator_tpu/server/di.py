@@ -1,0 +1,130 @@
+"""DI container: construct all services once, wire dependencies.
+
+Capability parity with the reference DI container (reference:
+simulator/server/di/di.go:39-78): scheduler service, snapshot, reset,
+resource watcher, resource applier, and — conditionally on config flags —
+the one-shot importer, syncer, and replayer.  Extra here: the scheduling
+loop thread, which replaces the reference's separate debuggable-scheduler
+container by running the tensor engine in-process whenever pods await
+scheduling.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..cluster.store import ADDED, MODIFIED, ObjectStore
+from ..config.config import SimulatorConfiguration
+from ..framework.engine import SchedulerEngine
+from ..scheduler.service import SchedulerService
+from ..services.importer import OneShotImporter
+from ..services.recorder import RecorderService
+from ..services.replayer import ReplayerService
+from ..services.reset import ResetService
+from ..services.resourceapplier import ResourceApplier
+from ..services.resourcewatcher import ResourceWatcherService
+from ..services.snapshot import SnapshotService
+from ..services.syncer import SyncerService
+from ..store.reflector import StoreReflector
+
+
+class SchedulingLoop:
+    """Watches pod events and runs scheduling waves for pending pods —
+    the in-process analogue of the always-running debuggable-scheduler
+    container.  Debounces so a burst of creates compiles as ONE batched
+    tensor workload instead of one compile per pod."""
+
+    def __init__(self, store: ObjectStore, engine: SchedulerEngine,
+                 debounce: float = 0.05):
+        self.store = store
+        self.engine = engine
+        self.debounce = debounce
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._q = None
+
+    def start(self):
+        self._q = self.store.watch("pods")
+        threading.Thread(target=self._watch, daemon=True).start()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._q is not None:
+            self.store.unwatch("pods", self._q)
+            self._q.put(None)
+        self._wake.set()
+
+    def kick(self):
+        self._wake.set()
+
+    def _watch(self):
+        while not self._stop.is_set():
+            ev = self._q.get()
+            if ev is None:
+                return
+            _, event_type, obj = ev
+            if event_type == ADDED and not ((obj.get("spec") or {}).get("nodeName")):
+                self._wake.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._wake.wait()
+            if self._stop.is_set():
+                return
+            self._wake.clear()
+            self._stop.wait(self.debounce)  # batch bursts
+            try:
+                self.engine.schedule_pending()
+            except Exception:  # keep the loop alive like a crashed-and-restarted pod
+                import traceback
+
+                traceback.print_exc()
+
+
+class DIContainer:
+    def __init__(self, cfg: SimulatorConfiguration | None = None,
+                 source_store: ObjectStore | None = None,
+                 start_scheduler: bool = True):
+        self.cfg = cfg or SimulatorConfiguration()
+        self.store = ObjectStore()
+        self.applier = ResourceApplier(self.store)
+        self.reflector = StoreReflector(self.store)
+        self.engine = SchedulerEngine(self.store, reflector=self.reflector)
+        initial_scheduler_cfg = self.cfg.initial_scheduler_config()
+        self.scheduler_service = SchedulerService(self.engine, initial_scheduler_cfg)
+        self.snapshot_service = SnapshotService(self.store, self.scheduler_service)
+        self.reset_service = ResetService(self.store, self.scheduler_service)
+        self.watcher_service = ResourceWatcherService(self.store)
+
+        self.importer = None
+        self.syncer = None
+        self.replayer = None
+        self.recorder = None
+        if self.cfg.external_import_enabled:
+            if source_store is None:
+                raise ValueError("externalImportEnabled requires a source cluster")
+            self.importer = OneShotImporter(source_store, self.applier)
+        if self.cfg.resource_sync_enabled:
+            if source_store is None:
+                raise ValueError("resourceSyncEnabled requires a source cluster")
+            self.syncer = SyncerService(source_store, self.applier)
+        if self.cfg.replayer_enabled:
+            self.replayer = ReplayerService(self.applier, self.cfg.record_file_path)
+
+        self.scheduling_loop = SchedulingLoop(self.store, self.engine)
+        if start_scheduler:
+            self.scheduling_loop.start()
+
+    def new_recorder(self, path: str, flush_interval: float = 5.0) -> RecorderService:
+        self.recorder = RecorderService(self.store, path, flush_interval)
+        return self.recorder
+
+    def shutdown(self):
+        self.scheduling_loop.stop()
+        if self.syncer:
+            self.syncer.stop()
+        if self.recorder:
+            self.recorder.stop()

@@ -1,0 +1,269 @@
+"""HTTP API server — route-compatible with the reference.
+
+Reference routes (simulator/server/server.go:42-61,88-93), same paths and
+status codes:
+
+  GET  /api/v1/schedulerconfiguration      -> 200 JSON config
+  POST /api/v1/schedulerconfiguration      -> 202 (applies Profiles+Extenders
+       only, then restarts the scheduler — handler/schedulerconfig.go:41-63)
+  PUT  /api/v1/reset                       -> 202
+  GET  /api/v1/export                      -> 200 snapshot JSON
+  POST /api/v1/import                      -> 200 (snapshot load)
+  GET  /api/v1/listwatchresources          -> 200 streamed watch events
+       (?<kind>LastResourceVersion= params, handler/watcher.go:23-45)
+  POST /api/v1/extender/{filter|prioritize|preempt|bind}/:id
+                                           -> 200 extender passthrough
+
+Additions over the reference (documented divergence): the reference's web
+UI does resource CRUD directly against the KWOK kube-apiserver; this
+framework embeds the cluster, so the same CRUD is exposed at
+  /api/v1/namespaces | nodes | pods | ... (GET list, POST create)
+  /api/v1/<resource>/<ns>/<name> or /api/v1/<resource>/<name>
+  (GET, PUT update, DELETE)
+Middleware: request logging + CORS (reference: server.go:27-37).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..cluster.store import ApiError, RESOURCES
+from ..services.resourcewatcher import StreamWriter
+from ..services.snapshot import SnapshotOptions
+from .di import DIContainer
+
+# query-param names per kind (reference: handler/watcher.go:26-34 — note
+# "namespaceLastResourceVersion" is singular in the reference)
+_WATCH_PARAMS = {
+    "pods": "podsLastResourceVersion",
+    "nodes": "nodesLastResourceVersion",
+    "persistentvolumes": "pvsLastResourceVersion",
+    "persistentvolumeclaims": "pvcsLastResourceVersion",
+    "storageclasses": "scsLastResourceVersion",
+    "priorityclasses": "pcsLastResourceVersion",
+    "namespaces": "namespaceLastResourceVersion",
+}
+
+
+class SimulatorServer:
+    def __init__(self, di: DIContainer, port: int | None = None):
+        self.di = di
+        self.port = port if port is not None else di.cfg.port
+        self.httpd: ThreadingHTTPServer | None = None
+
+    def start(self, block: bool = True):
+        handler = _make_handler(self.di)
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self.port = self.httpd.server_address[1]
+        if block:
+            self.httpd.serve_forever()
+        else:
+            threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def shutdown(self):
+        if self.httpd:
+            self.httpd.shutdown()
+        self.di.shutdown()
+
+
+def _make_handler(di: DIContainer):
+    cors_origins = di.cfg.cors_allowed_origin_list
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # --------------------------------------------------- plumbing
+
+        def log_message(self, fmt, *args):  # echo-Logger analogue, quiet-able
+            pass
+
+        def _cors(self):
+            origin = self.headers.get("Origin")
+            if origin and (not cors_origins or origin in cors_origins):
+                self.send_header("Access-Control-Allow-Origin", origin)
+                self.send_header("Access-Control-Allow-Methods",
+                                 "GET, POST, PUT, DELETE, OPTIONS")
+                self.send_header("Access-Control-Allow-Headers", "Content-Type")
+
+        def _json(self, code: int, obj=None):
+            body = b"" if obj is None else json.dumps(obj).encode()
+            self.send_response(code)
+            self._cors()
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _body(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return None
+            return json.loads(self.rfile.read(length) or b"null")
+
+        def _error(self, e: Exception):
+            if isinstance(e, ApiError):
+                self._json(e.status, {"reason": e.reason, "message": e.message})
+            else:
+                self._json(500, {"reason": "InternalError", "message": str(e)})
+
+        # --------------------------------------------------- routing
+
+        def do_OPTIONS(self):
+            self.send_response(204)
+            self._cors()
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_POST(self):
+            self._route("POST")
+
+        def do_PUT(self):
+            self._route("PUT")
+
+        def do_DELETE(self):
+            self._route("DELETE")
+
+        def _route(self, method: str):
+            url = urlparse(self.path)
+            path = url.path.rstrip("/")
+            try:
+                if path == "/api/v1/schedulerconfiguration":
+                    if method == "GET":
+                        return self._json(200, di.scheduler_service.get_config())
+                    if method == "POST":
+                        return self._apply_scheduler_config()
+                elif path == "/api/v1/reset" and method == "PUT":
+                    di.reset_service.reset()
+                    return self._json(202)
+                elif path == "/api/v1/export" and method == "GET":
+                    return self._json(200, di.snapshot_service.snap())
+                elif path == "/api/v1/import" and method == "POST":
+                    opts = SnapshotOptions(
+                        ignore_err="ignoreErr" in parse_qs(url.query),
+                        ignore_scheduler_configuration="ignoreSchedulerConfiguration"
+                        in parse_qs(url.query),
+                    )
+                    di.snapshot_service.load(self._body() or {}, opts)
+                    return self._json(200)
+                elif path == "/api/v1/listwatchresources" and method == "GET":
+                    return self._list_watch(url)
+                elif path.startswith("/api/v1/extender/") and method == "POST":
+                    return self._extender(path)
+                else:
+                    m = re.fullmatch(r"/api/v1/([a-z]+)(?:/([^/]+))?(?:/([^/]+))?", path)
+                    if m and m.group(1) in RESOURCES:
+                        return self._resource_crud(method, m, url)
+                self._json(404, {"message": f"route not found: {method} {path}"})
+            except ApiError as e:
+                self._error(e)
+            except json.JSONDecodeError as e:
+                self._json(400, {"reason": "BadRequest", "message": f"invalid JSON body: {e}"})
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # handler-level 500, server stays up
+                self._error(e)
+
+        # --------------------------------------------------- handlers
+
+        def _apply_scheduler_config(self):
+            body = self._body() or {}
+            # only Profiles and Extenders are honored
+            # (reference: handler/schedulerconfig.go:41-63)
+            cfg = di.scheduler_service.get_config()
+            cfg["profiles"] = body.get("profiles") or []
+            cfg["extenders"] = body.get("extenders") or []
+            di.scheduler_service.restart_scheduler(cfg)
+            self._json(202)
+
+        def _list_watch(self, url):
+            params = parse_qs(url.query)
+            lrv = {}
+            for resource, param in _WATCH_PARAMS.items():
+                v = params.get(param, [""])[0]
+                if v:
+                    lrv[resource] = int(v)
+            self.send_response(200)
+            self._cors()
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: bytes):
+                self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+            stream = StreamWriter(write_chunk, self.wfile.flush)
+            stop = threading.Event()
+            try:
+                di.watcher_service.list_watch(stream, lrv, stop)
+            finally:
+                stop.set()
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
+        def _extender(self, path: str):
+            m = re.fullmatch(r"/api/v1/extender/(filter|prioritize|preempt|bind)/(\d+)", path)
+            if not m:
+                return self._json(404, {"message": "unknown extender route"})
+            verb, idx = m.group(1), int(m.group(2))
+            svc = getattr(di, "extender_service", None)
+            if svc is None:
+                return self._json(400, {"message": "no extenders configured"})
+            result = svc.handle(verb, idx, self._body() or {})
+            return self._json(200, result)
+
+        def _resource_crud(self, method: str, m, url):
+            resource = m.group(1)
+            _, namespaced = RESOURCES[resource]
+            g2, g3 = m.group(2), m.group(3)
+            if method == "GET" and g2 is None:
+                params = parse_qs(url.query)
+                ns = params.get("namespace", [None])[0]
+                items, rv = di.store.list(resource, namespace=ns)
+                return self._json(200, {"items": items, "resourceVersion": str(rv)})
+            if method == "POST" and g2 is None:
+                return self._json(201, di.store.create(resource, self._body() or {}))
+            if namespaced and g3 is None and g2 is not None and method != "GET":
+                pass  # fallthrough: namespaced updates need ns+name
+            ns, name = (g2, g3) if (namespaced and g3) else (None, g2)
+            if name is None:
+                return self._json(404, {"message": "name required"})
+            if method == "GET":
+                return self._json(200, di.store.get(resource, name, ns))
+            if method == "PUT":
+                return self._json(200, di.store.update(resource, self._body() or {}))
+            if method == "DELETE":
+                di.store.delete(resource, name, ns)
+                return self._json(200)
+            return self._json(405, {"message": "method not allowed"})
+
+    return Handler
+
+
+def main():
+    from ..config.config import load_config
+
+    cfg = load_config()
+    di = DIContainer(cfg)
+    if di.importer:
+        di.importer.import_cluster_resources(cfg.resource_import_label_selector or None)
+    if di.replayer:
+        di.replayer.replay()
+    if di.syncer:
+        di.syncer.run()
+    server = SimulatorServer(di)
+    print(f"kube-scheduler-simulator (TPU) listening on :{server.port}")
+    server.start(block=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,6 @@ import numpy as np
 from . import resources as res
 from .nodes import NodeTable, build_node_table
 from .resources import ResourceSchema, pod_resource_request
-from .vocab import Vocab
 from ..plugins import registry as reg
 from ..plugins import affinity, interpod, noderesources, taints, topologyspread
 
@@ -35,7 +34,6 @@ from ..plugins import affinity, interpod, noderesources, taints, topologyspread
 @dataclass
 class CompiledWorkload:
     schema: ResourceSchema
-    vocab: Vocab
     node_table: NodeTable
     pods: list[dict]
     pod_keys: list[str]                 # "namespace/name"
@@ -73,9 +71,8 @@ def compile_workload(
     """
     config = config or reg.PluginSetConfig()
     bound_pods = bound_pods or []
-    vocab = Vocab()
     schema = ResourceSchema.discover(pods + [bp for bp, _ in bound_pods], nodes)
-    table = build_node_table(nodes, schema, vocab)
+    table = build_node_table(nodes, schema)
 
     p = len(pods)
     requests = np.zeros((p, schema.n), dtype=np.int64)
@@ -117,7 +114,7 @@ def compile_workload(
     )
 
     if "NodeAffinity" in enabled:
-        xs["NodeAffinity"] = affinity.build(table, pods, vocab)
+        xs["NodeAffinity"] = affinity.build(table, pods)
     if "TaintToleration" in enabled:
         xs["TaintToleration"] = taints.build_taints(table, pods)
     if "NodeUnschedulable" in enabled:
@@ -125,10 +122,10 @@ def compile_workload(
     if "NodeName" in enabled:
         xs["NodeName"] = taints.build_nodename(table, pods)
     if "PodTopologySpread" in enabled:
-        st, x, counts = topologyspread.build(table, pods, vocab)
+        st, x, counts = topologyspread.build(table, pods)
         statics["PodTopologySpread"] = st
         xs["PodTopologySpread"] = x
-        counts = _prime_spread_counts(counts, st, x, pods, bound_pods, table, vocab, name_idx)
+        counts = _prime_spread_counts(counts, st, pods, bound_pods, name_idx)
         init_carry["PodTopologySpread"] = counts
     if "InterPodAffinity" in enabled:
         # Build the term table over queue + bound pods together so the bound
@@ -136,7 +133,7 @@ def compile_workload(
         # share the same term ids; then slice the per-pod xs back to the
         # queue and fold the bound rows into the initial carry.
         bound_manifests = [bp for bp, _ in bound_pods]
-        st, x_all, carry = interpod.build(table, pods + bound_manifests, vocab)
+        st, x_all, carry = interpod.build(table, pods + bound_manifests)
         statics["InterPodAffinity"] = st
         xs["InterPodAffinity"] = interpod.InterPodXS(
             *[v[:p] for v in x_all]
@@ -146,7 +143,6 @@ def compile_workload(
 
     cw = CompiledWorkload(
         schema=schema,
-        vocab=vocab,
         node_table=table,
         pods=pods,
         pod_keys=[_pod_key(pod) for pod in pods],
@@ -160,7 +156,7 @@ def compile_workload(
     return cw
 
 
-def _prime_spread_counts(counts, st, x, pods, bound_pods, table, vocab, name_idx):
+def _prime_spread_counts(counts, st, pods, bound_pods, name_idx):
     """Fold already-bound pods into the per-domain match counts."""
     if not bound_pods:
         return counts

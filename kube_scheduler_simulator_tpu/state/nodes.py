@@ -1,10 +1,11 @@
 """Columnar node table.
 
 Parses a list of Node manifests (plain dicts, same shape the reference
-handles as unstructured objects via client-go) into dense numpy arrays.
-This is the host-side half of the state split: label/taint *structure* is
-static during a replay, so it lives here and gets baked into match arrays
-by compile.py; the *resource accumulators* become the device-side carry.
+handles as unstructured objects via client-go) into dense numpy arrays +
+per-node label/taint structures.  This is the host-side half of the state
+split: label/taint *structure* is static during a replay, so it lives here
+and gets baked into dense match arrays by compile.py; the *resource
+accumulators* become the device-side carry.
 
 Reference behavior mirrored: the scheduler sees allocatable via
 NodeInfo.Allocatable; pods-per-node via AllowedPodNumber; unschedulable
@@ -19,18 +20,10 @@ from dataclasses import dataclass
 import numpy as np
 
 from .resources import ResourceSchema
-from .vocab import Vocab
 
-# taint effects, encoded
-EFFECT_NO_SCHEDULE = 0
-EFFECT_PREFER_NO_SCHEDULE = 1
-EFFECT_NO_EXECUTE = 2
-_EFFECTS = {
-    "NoSchedule": EFFECT_NO_SCHEDULE,
-    "PreferNoSchedule": EFFECT_PREFER_NO_SCHEDULE,
-    "NoExecute": EFFECT_NO_EXECUTE,
-}
-EFFECT_NAMES = {v: k for k, v in _EFFECTS.items()}
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
 
 
 @dataclass
@@ -41,8 +34,8 @@ class NodeTable:
     initial_requested: np.ndarray  # [N, R] int64 (from already-bound pods)
     initial_nonzero: np.ndarray    # [N, 2] int64
     initial_num_pods: np.ndarray   # [N]    int64
-    labels: list[dict[int, int]]   # per node: label key-id -> value-id
-    taints: list[list[tuple[int, int, int, str, str]]]  # (key_id, value_id, effect, key, value)
+    labels: list[dict[str, str]]   # per node
+    taints: list[list[tuple[str, str, str]]]  # (key, value, effect)
     unschedulable: np.ndarray      # [N] bool
 
     @property
@@ -50,13 +43,13 @@ class NodeTable:
         return len(self.names)
 
 
-def build_node_table(nodes: list[dict], schema: ResourceSchema, vocab: Vocab) -> NodeTable:
+def build_node_table(nodes: list[dict], schema: ResourceSchema) -> NodeTable:
     n = len(nodes)
     names: list[str] = []
     allocatable = np.zeros((n, schema.n), dtype=np.int64)
     allowed = np.full(n, 110, dtype=np.int64)  # kubelet default max-pods
-    labels: list[dict[int, int]] = []
-    taints: list[list[tuple[int, int, int, str, str]]] = []
+    labels: list[dict[str, str]] = []
+    taints: list[list[tuple[str, str, str]]] = []
     unsched = np.zeros(n, dtype=bool)
 
     for i, node in enumerate(nodes):
@@ -68,17 +61,15 @@ def build_node_table(nodes: list[dict], schema: ResourceSchema, vocab: Vocab) ->
         allocatable[i] = schema.parse_map(alloc)
         if "pods" in alloc:
             allowed[i] = int(float(alloc["pods"]))
-        lab = dict(meta.get("labels") or {})
+        lab = {k: str(v) for k, v in (meta.get("labels") or {}).items()}
         # kubernetes.io/hostname is implicit on real nodes; KWOK sets it too.
         lab.setdefault("kubernetes.io/hostname", name)
-        labels.append({vocab.intern(k): vocab.intern(str(v)) for k, v in lab.items()})
+        labels.append(lab)
         spec = node.get("spec") or {}
-        tlist = []
-        for t in spec.get("taints") or []:
-            key, value = t.get("key", ""), str(t.get("value", ""))
-            eff = _EFFECTS.get(t.get("effect", "NoSchedule"), EFFECT_NO_SCHEDULE)
-            tlist.append((vocab.intern(key), vocab.intern(value), eff, key, value))
-        taints.append(tlist)
+        taints.append([
+            (t.get("key", ""), str(t.get("value", "")), t.get("effect", NO_SCHEDULE))
+            for t in spec.get("taints") or []
+        ])
         unsched[i] = bool(spec.get("unschedulable", False))
 
     return NodeTable(

@@ -40,12 +40,14 @@ def _expr_matches_labels(expr: dict, labels: dict[str, str]) -> bool:
     if op == "DoesNotExist":
         return not has
     if op in ("Gt", "Lt"):
-        if not has:
+        # upstream requires exactly one integer value; an invalid
+        # expression never matches
+        if not has or len(values) != 1:
             return False
         try:
             lab = int(labels[key])
-            val = int(values[0]) if values else 0
-        except (ValueError, IndexError):
+            val = int(values[0])
+        except ValueError:
             return False
         return lab > val if op == "Gt" else lab < val
     return False
@@ -116,13 +118,6 @@ def tolerations_tolerate(tolerations: list[dict], taint_key, taint_value, taint_
 # ---------------------------------------------------------------------------
 # dense pod x node precompilation helpers
 # ---------------------------------------------------------------------------
-
-def node_labels_as_strings(table: NodeTable, vocab) -> list[dict[str, str]]:
-    return [
-        {vocab.string(k): vocab.string(v) for k, v in lab.items()}
-        for lab in table.labels
-    ]
-
 
 def pods_match_label_selector(selector: dict | None, pods: list[dict]) -> np.ndarray:
     """[P] bool: which pods' labels match the selector."""

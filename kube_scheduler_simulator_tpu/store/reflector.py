@@ -1,0 +1,100 @@
+"""Store reflector: write scheduling results back onto Pod annotations.
+
+Capability parity with the reference reflector (reference:
+simulator/scheduler/storereflector/storereflector.go):
+
+  * merges the stored result maps of all registered result stores into the
+    pod's annotations (:113-129);
+  * appends the merged result set to the `result-history` annotation,
+    dropping entries from the OLDEST side until the encoded array fits the
+    256KiB apiserver annotation limit (:163-190);
+  * updates the pod with re-fetch + conflict retry under exponential
+    backoff (100ms x3, 6 steps — :136-151, util/retry.go:10-27), deletes
+    the store entry only after a successful write (:156-159).
+
+The reference triggers this from a Pod-informer Update handler; here the
+scheduling engine calls reflect() after binding (same effect, no informer
+round-trip needed in-process), and an optional watch-driven mode mirrors
+the informer wiring for externally-bound pods.
+"""
+
+from __future__ import annotations
+
+import json
+
+from . import annotations as ann
+from ..cluster.store import Conflict, NotFound, ObjectStore
+from ..utils.retry import retry_with_exponential_backoff
+
+RESULT_HISTORY_LIMIT = ann.TOTAL_ANNOTATION_SIZE_LIMIT
+
+
+def update_result_history(pod: dict, result_set: dict[str, str]) -> None:
+    """Append result_set to the result-history annotation, trimming oldest
+    entries until the encoded JSON fits the 256KiB limit."""
+    annotations = pod.setdefault("metadata", {}).setdefault("annotations", {})
+    raw = annotations.get(ann.RESULT_HISTORY, "[]")
+    try:
+        results = json.loads(raw)
+    except json.JSONDecodeError:
+        results = []
+    results.append(result_set)
+    while results:
+        encoded = ann.marshal(results)
+        if len(encoded) <= RESULT_HISTORY_LIMIT:
+            annotations[ann.RESULT_HISTORY] = encoded
+            return
+        results = results[1:]
+    raise ValueError(
+        "result history still exceeds annotation limit even after removing several histories"
+    )
+
+
+class StoreReflector:
+    def __init__(self, store: ObjectStore, sleep=None):
+        self.store = store
+        self.result_stores: dict[str, object] = {}
+        self._sleep = sleep  # injectable for tests
+
+    def add_result_store(self, result_store, key: str) -> None:
+        """reference: storereflector.go AddResultStore."""
+        self.result_stores[key] = result_store
+
+    def reflect(self, namespace: str, name: str) -> None:
+        """Merge all result stores' data for the pod into its annotations
+        (with history), conflict-retrying; delete store data on success."""
+
+        last_pod: dict = {}
+
+        def attempt() -> tuple[bool, Exception | None]:
+            try:
+                pod = self.store.get("pods", name, namespace)
+            except NotFound:
+                return True, None
+            result_set: dict[str, str] = {}
+            for rs in self.result_stores.values():
+                m = rs.get_stored_result(pod) or {}
+                result_set.update(m)
+            if not result_set:
+                return True, None
+            annotations = pod.setdefault("metadata", {}).setdefault("annotations", {})
+            annotations.update(result_set)
+            try:
+                update_result_history(pod, result_set)
+            except ValueError:
+                pass  # log-and-continue, as the reference does
+            try:
+                self.store.update("pods", pod)
+            except NotFound:
+                return True, None
+            except Conflict:
+                return False, None  # re-fetch and retry
+            last_pod.clear()
+            last_pod.update(pod)
+            return True, None
+
+        kwargs = {"sleep": self._sleep} if self._sleep else {}
+        retry_with_exponential_backoff(attempt, **kwargs)
+        if last_pod:
+            for rs in self.result_stores.values():
+                rs.delete_data(last_pod)

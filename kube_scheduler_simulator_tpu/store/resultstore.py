@@ -1,0 +1,192 @@
+"""Result store: per-pod scheduling results, serialized to annotations.
+
+API parity with the reference result store (reference:
+simulator/scheduler/plugin/resultstore/store.go): granular Add* methods
+per extension point keyed by namespace/pod, get_stored_result() producing
+the 13 annotation JSON blobs (:133-198), finalscore = normalized score x
+plugin weight (:488-507, weight map semantics of plugins.go:289-304),
+delete_data() (:509-520), and AddCustomResult for plugin-extender
+debugging payloads (:617-626).
+
+The tensor engine deposits whole decoded result maps via put_decoded()
+(its per-pod output already IS the 13 encoded blobs); the granular
+methods serve host-side escape hatches (extenders, plugin extenders) and
+API compatibility.  Granular adds and decoded deposits merge: granular
+values overwrite the decoded blob for the touched keys.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import annotations as ann
+
+PASSED = ann.PASSED_FILTER_MESSAGE
+SUCCESS = ann.SUCCESS_MESSAGE
+
+
+def _key(namespace: str, pod_name: str) -> str:
+    return f"{namespace}/{pod_name}"
+
+
+class _Result:
+    __slots__ = (
+        "selected_node", "pre_score", "score", "final_score",
+        "pre_filter_status", "pre_filter_result", "filter", "post_filter",
+        "permit", "permit_timeout", "reserve", "prebind", "bind",
+        "custom", "decoded",
+    )
+
+    def __init__(self):
+        self.selected_node = ""
+        self.pre_score: dict[str, str] = {}
+        self.score: dict[str, dict[str, str]] = {}
+        self.final_score: dict[str, dict[str, str]] = {}
+        self.pre_filter_status: dict[str, str] = {}
+        self.pre_filter_result: dict[str, list[str]] = {}
+        self.filter: dict[str, dict[str, str]] = {}
+        self.post_filter: dict[str, dict[str, str]] = {}
+        self.permit: dict[str, str] = {}
+        self.permit_timeout: dict[str, str] = {}
+        self.reserve: dict[str, str] = {}
+        self.prebind: dict[str, str] = {}
+        self.bind: dict[str, str] = {}
+        self.custom: dict[str, str] = {}
+        self.decoded: dict[str, str] = {}
+
+
+class ResultStore:
+    def __init__(self, score_plugin_weight: dict[str, int] | None = None):
+        self._mu = threading.Lock()
+        self._results: dict[str, _Result] = {}
+        self.score_plugin_weight = score_plugin_weight or {}
+
+    def _get(self, namespace: str, pod_name: str) -> _Result:
+        k = _key(namespace, pod_name)
+        if k not in self._results:
+            self._results[k] = _Result()
+        return self._results[k]
+
+    # ------------------------------------------------------------ adds
+
+    def put_decoded(self, namespace: str, pod_name: str, annotations: dict[str, str]):
+        with self._mu:
+            self._get(namespace, pod_name).decoded.update(annotations)
+
+    def add_filter_result(self, namespace, pod_name, node_name, plugin_name, reason):
+        with self._mu:
+            r = self._get(namespace, pod_name)
+            r.filter.setdefault(node_name, {})[plugin_name] = reason
+
+    def add_post_filter_result(self, namespace, pod_name, nominated_node_name,
+                               plugin_name, node_names):
+        with self._mu:
+            r = self._get(namespace, pod_name)
+            for node_name in node_names:
+                r.post_filter.setdefault(node_name, {})
+                if node_name == nominated_node_name:
+                    r.post_filter[node_name][plugin_name] = ann.POST_FILTER_NOMINATED_MESSAGE
+
+    def add_score_result(self, namespace, pod_name, node_name, plugin_name, score: int):
+        with self._mu:
+            r = self._get(namespace, pod_name)
+            r.score.setdefault(node_name, {})[plugin_name] = str(int(score))
+            self._add_normalized_locked(r, node_name, plugin_name, score)
+
+    def add_normalized_score_result(self, namespace, pod_name, node_name,
+                                    plugin_name, normalized_score: int):
+        with self._mu:
+            r = self._get(namespace, pod_name)
+            self._add_normalized_locked(r, node_name, plugin_name, normalized_score)
+
+    def _add_normalized_locked(self, r: _Result, node_name, plugin_name, score: int):
+        weight = self.score_plugin_weight.get(plugin_name, 0)
+        r.final_score.setdefault(node_name, {})[plugin_name] = str(int(score) * int(weight))
+
+    def add_pre_filter_result(self, namespace, pod_name, plugin_name, reason,
+                              pre_filter_result=None):
+        with self._mu:
+            r = self._get(namespace, pod_name)
+            r.pre_filter_status[plugin_name] = reason
+            if pre_filter_result is not None:
+                r.pre_filter_result[plugin_name] = list(pre_filter_result)
+
+    def add_pre_score_result(self, namespace, pod_name, plugin_name, reason):
+        with self._mu:
+            self._get(namespace, pod_name).pre_score[plugin_name] = reason
+
+    def add_permit_result(self, namespace, pod_name, plugin_name, status, timeout: str):
+        with self._mu:
+            r = self._get(namespace, pod_name)
+            r.permit[plugin_name] = status
+            r.permit_timeout[plugin_name] = timeout
+
+    def add_selected_node(self, namespace, pod_name, node_name):
+        with self._mu:
+            self._get(namespace, pod_name).selected_node = node_name
+
+    def add_reserve_result(self, namespace, pod_name, plugin_name, status):
+        with self._mu:
+            self._get(namespace, pod_name).reserve[plugin_name] = status
+
+    def add_bind_result(self, namespace, pod_name, plugin_name, status):
+        with self._mu:
+            self._get(namespace, pod_name).bind[plugin_name] = status
+
+    def add_pre_bind_result(self, namespace, pod_name, plugin_name, status):
+        with self._mu:
+            self._get(namespace, pod_name).prebind[plugin_name] = status
+
+    def add_custom_result(self, namespace, pod_name, annotation_key, result):
+        with self._mu:
+            self._get(namespace, pod_name).custom[annotation_key] = result
+
+    # ------------------------------------------------------------ read/delete
+
+    def get_stored_result(self, pod: dict) -> dict[str, str] | None:
+        meta = pod.get("metadata") or {}
+        k = _key(meta.get("namespace") or "default", meta.get("name", ""))
+        with self._mu:
+            r = self._results.get(k)
+            if r is None:
+                return None
+            out = dict(r.decoded)
+
+            def put(key, value):
+                # granular adds overwrite the decoded blob for their key
+                # only if any granular data exists for it
+                out[key] = value
+
+            if r.pre_filter_result or ann.PRE_FILTER_RESULT not in out:
+                put(ann.PRE_FILTER_RESULT, ann.marshal(r.pre_filter_result))
+            if r.pre_filter_status or ann.PRE_FILTER_STATUS_RESULT not in out:
+                put(ann.PRE_FILTER_STATUS_RESULT, ann.marshal(r.pre_filter_status))
+            if r.filter or ann.FILTER_RESULT not in out:
+                put(ann.FILTER_RESULT, ann.marshal(r.filter))
+            if r.post_filter or ann.POST_FILTER_RESULT not in out:
+                put(ann.POST_FILTER_RESULT, ann.marshal(r.post_filter))
+            if r.pre_score or ann.PRE_SCORE_RESULT not in out:
+                put(ann.PRE_SCORE_RESULT, ann.marshal(r.pre_score))
+            if r.score or ann.SCORE_RESULT not in out:
+                put(ann.SCORE_RESULT, ann.marshal(r.score))
+            if r.final_score or ann.FINAL_SCORE_RESULT not in out:
+                put(ann.FINAL_SCORE_RESULT, ann.marshal(r.final_score))
+            if r.reserve or ann.RESERVE_RESULT not in out:
+                put(ann.RESERVE_RESULT, ann.marshal(r.reserve))
+            if r.permit or ann.PERMIT_STATUS_RESULT not in out:
+                put(ann.PERMIT_STATUS_RESULT, ann.marshal(r.permit))
+            if r.permit_timeout or ann.PERMIT_TIMEOUT_RESULT not in out:
+                put(ann.PERMIT_TIMEOUT_RESULT, ann.marshal(r.permit_timeout))
+            if r.prebind or ann.PRE_BIND_RESULT not in out:
+                put(ann.PRE_BIND_RESULT, ann.marshal(r.prebind))
+            if r.bind or ann.BIND_RESULT not in out:
+                put(ann.BIND_RESULT, ann.marshal(r.bind))
+            if r.selected_node or ann.SELECTED_NODE not in out:
+                put(ann.SELECTED_NODE, r.selected_node)
+            out.update(r.custom)
+            return out
+
+    def delete_data(self, pod: dict) -> None:
+        meta = pod.get("metadata") or {}
+        with self._mu:
+            self._results.pop(_key(meta.get("namespace") or "default", meta.get("name", "")), None)

@@ -1,0 +1,35 @@
+"""Retry with exponential backoff.
+
+Same schedule as the reference's util.RetryWithExponentialBackOff
+(reference: simulator/util/retry.go:10-27): initial 100ms, factor 3.0,
+6 steps.  fn returns (done, error): done=True stops; an error aborts;
+(False, None) retries after the next backoff.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+INITIAL_DURATION = 0.1
+FACTOR = 3.0
+STEPS = 6
+
+
+class RetryTimeout(Exception):
+    pass
+
+
+def retry_with_exponential_backoff(fn: Callable[[], tuple[bool, Exception | None]],
+                                   sleep=time.sleep) -> None:
+    delay = INITIAL_DURATION
+    for step in range(STEPS):
+        done, err = fn()
+        if err is not None:
+            raise err
+        if done:
+            return
+        if step < STEPS - 1:
+            sleep(delay)
+            delay *= FACTOR
+    raise RetryTimeout("timed out waiting for the condition")

@@ -1,0 +1,396 @@
+"""Control-plane tests: store, applier, snapshot/reset, recorder/replayer,
+importer/syncer, reflector, engine, scheduler service.
+
+Modeled on the reference's table-driven service tests (SURVEY.md §4):
+fake-clientset-style scenarios become direct ObjectStore manipulation.
+"""
+
+import json
+import time
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import (
+    ADDED, AlreadyExists, Conflict, DELETED, MODIFIED, NotFound, ObjectStore,
+)
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.scheduler.convert import (
+    convert_configuration_for_simulator,
+    default_scheduler_config,
+    parse_plugin_set,
+)
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.services.importer import FileSource, OneShotImporter
+from kube_scheduler_simulator_tpu.services.recorder import RecorderService
+from kube_scheduler_simulator_tpu.services.replayer import ReplayerService
+from kube_scheduler_simulator_tpu.services.reset import ResetService
+from kube_scheduler_simulator_tpu.services.resourceapplier import ResourceApplier
+from kube_scheduler_simulator_tpu.services.snapshot import SnapshotService
+from kube_scheduler_simulator_tpu.services.syncer import SyncerService
+from kube_scheduler_simulator_tpu.store import annotations as ann
+from kube_scheduler_simulator_tpu.store.reflector import StoreReflector, update_result_history
+from kube_scheduler_simulator_tpu.store.resultstore import ResultStore
+
+
+def pod(name, ns="default", node=None, labels=None):
+    p = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]},
+    }
+    if node:
+        p["spec"]["nodeName"] = node
+    return p
+
+
+def node(name):
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name},
+        "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}},
+    }
+
+
+# ---------------------------------------------------------------- store
+
+class TestObjectStore:
+    def test_crud_and_rv(self):
+        s = ObjectStore()
+        created = s.create("pods", pod("a"))
+        assert created["metadata"]["uid"]
+        rv1 = int(created["metadata"]["resourceVersion"])
+        got = s.get("pods", "a")
+        assert got["metadata"]["name"] == "a"
+        got["spec"]["nodeName"] = "n1"
+        updated = s.update("pods", got)
+        assert int(updated["metadata"]["resourceVersion"]) > rv1
+        with pytest.raises(AlreadyExists):
+            s.create("pods", pod("a"))
+        s.delete("pods", "a")
+        with pytest.raises(NotFound):
+            s.get("pods", "a")
+
+    def test_conflict_on_stale_rv(self):
+        s = ObjectStore()
+        s.create("pods", pod("a"))
+        p1 = s.get("pods", "a")
+        p2 = s.get("pods", "a")
+        s.update("pods", p1)
+        with pytest.raises(Conflict):
+            s.update("pods", p2)
+
+    def test_watch_replay_and_live(self):
+        s = ObjectStore()
+        s.create("pods", pod("a"))
+        q = s.watch("pods", since_rv=0)
+        rv, et, obj = q.get(timeout=1)
+        assert et == ADDED and obj["metadata"]["name"] == "a"
+        s.create("pods", pod("b"))
+        rv, et, obj = q.get(timeout=1)
+        assert et == ADDED and obj["metadata"]["name"] == "b"
+        p = s.get("pods", "a")
+        s.update("pods", p)
+        assert q.get(timeout=1)[1] == MODIFIED
+        s.delete("pods", "b")
+        assert q.get(timeout=1)[1] == DELETED
+
+    def test_dump_restore(self):
+        s = ObjectStore()
+        s.create("pods", pod("a"))
+        snap = s.dump()
+        s.create("pods", pod("b"))
+        s.restore(snap)
+        items, _ = s.list("pods")
+        assert [i["metadata"]["name"] for i in items] == ["a"]
+
+
+# ---------------------------------------------------------------- applier
+
+class TestResourceApplier:
+    def test_strips_immutable_and_drops_owner(self):
+        s = ObjectStore()
+        a = ResourceApplier(s)
+        p = pod("a")
+        p["metadata"]["uid"] = "stale-uid"
+        p["metadata"]["resourceVersion"] = "999"
+        p["metadata"]["ownerReferences"] = [{"kind": "ReplicaSet"}]
+        p["spec"]["serviceAccountName"] = "sa"
+        created = a.create("pods", p)
+        assert created["metadata"]["uid"] != "stale-uid"
+        assert "ownerReferences" not in created["metadata"]
+        assert "serviceAccountName" not in created["spec"]
+
+    def test_scheduled_pod_update_filtered(self):
+        s = ObjectStore()
+        a = ResourceApplier(s)
+        a.create("pods", pod("a", node="n1"))
+        changed = pod("a", node="n1")
+        changed["metadata"]["labels"] = {"x": "y"}
+        assert a.update("pods", changed) is None  # skipped
+        assert s.get("pods", "a")["metadata"]["labels"] == {}
+
+    def test_pv_claimref_uid_resolution(self):
+        s = ObjectStore()
+        a = ResourceApplier(s)
+        pvc = {"metadata": {"name": "claim", "namespace": "default"}, "spec": {}}
+        created_pvc = s.create("persistentvolumeclaims", pvc)
+        pv = {
+            "metadata": {"name": "pv1"},
+            "spec": {"claimRef": {"name": "claim", "namespace": "default", "uid": "old"}},
+        }
+        created = a.create("persistentvolumes", pv)
+        assert created["spec"]["claimRef"]["uid"] == created_pvc["metadata"]["uid"]
+
+
+# ---------------------------------------------------------------- snapshot / reset
+
+class FakeSchedulerService:
+    def __init__(self):
+        self.cfg = {"profiles": [{"schedulerName": "default-scheduler"}]}
+        self.restarts = []
+
+    def get_config(self):
+        return dict(self.cfg)
+
+    def restart_scheduler(self, cfg):
+        self.restarts.append(cfg)
+        if cfg is not None:
+            self.cfg = dict(cfg)
+
+
+class TestSnapshot:
+    def test_snap_load_roundtrip(self):
+        s = ObjectStore()
+        s.create("namespaces", {"metadata": {"name": "prod"}})
+        s.create("namespaces", {"metadata": {"name": "kube-system"}})
+        s.create("priorityclasses", {"metadata": {"name": "system-node-critical"}})
+        s.create("priorityclasses", {"metadata": {"name": "biz-critical"}})
+        s.create("nodes", node("n1"))
+        s.create("pods", pod("a"))
+        svc = SnapshotService(s, FakeSchedulerService())
+        snap = svc.snap()
+        assert [n["metadata"]["name"] for n in snap["namespaces"]] == ["prod"]
+        assert [c["metadata"]["name"] for c in snap["priorityClasses"]] == ["biz-critical"]
+        assert "schedulerConfig" in snap
+
+        s2 = ObjectStore()
+        sched2 = FakeSchedulerService()
+        svc2 = SnapshotService(s2, sched2)
+        svc2.load(json.loads(json.dumps(snap)))
+        assert sched2.restarts  # scheduler restarted with snapshot config
+        assert s2.get("nodes", "n1")
+        assert s2.get("pods", "a")
+
+    def test_reset_restores_boot_state(self):
+        s = ObjectStore()
+        s.create("nodes", node("n1"))
+        sched = FakeSchedulerService()
+        reset = ResetService(s, sched)
+        s.create("nodes", node("n2"))
+        s.delete("nodes", "n1")
+        reset.reset()
+        items, _ = s.list("nodes")
+        assert [i["metadata"]["name"] for i in items] == ["n1"]
+        assert sched.restarts
+
+
+# ---------------------------------------------------------------- record / replay
+
+class TestRecordReplay:
+    def test_record_then_replay(self, tmp_path):
+        src = ObjectStore()
+        rec = RecorderService(src, str(tmp_path / "rec.jsonl"), flush_interval=0.05)
+        rec.run()
+        src.create("nodes", node("n1"))
+        src.create("pods", pod("a"))
+        p = src.get("pods", "a")
+        p["metadata"]["labels"] = {"x": "1"}
+        src.update("pods", p)
+        src.create("pods", pod("gone"))
+        src.delete("pods", "gone")
+        time.sleep(0.3)
+        rec.stop()
+
+        lines = [json.loads(l) for l in open(tmp_path / "rec.jsonl")]
+        events = [(r["event"], r["resource"]["kind"]) for r in lines]
+        assert ("Add", "Node") in events and ("Update", "Pod") in events
+        assert ("Delete", "Pod") in events
+        delete_rec = next(r for r in lines if r["event"] == "Delete")
+        assert set(delete_rec["resource"].keys()) == {"apiVersion", "kind", "metadata"}
+
+        dst = ObjectStore()
+        replayer = ReplayerService(ResourceApplier(dst), str(tmp_path / "rec.jsonl"))
+        n = replayer.replay()
+        assert n == len(lines)
+        assert dst.get("nodes", "n1")
+        assert dst.get("pods", "a")["metadata"]["labels"] == {"x": "1"}
+        with pytest.raises(NotFound):
+            dst.get("pods", "gone")
+
+
+# ---------------------------------------------------------------- import / sync
+
+class TestImportSync:
+    def test_oneshot_import_with_selector(self):
+        src = ObjectStore()
+        src.create("nodes", node("n1"))
+        src.create("pods", pod("keep", labels={"team": "a"}))
+        src.create("pods", pod("skip", labels={"team": "b"}))
+        dst = ObjectStore()
+        imp = OneShotImporter(src, ResourceApplier(dst))
+        imp.import_cluster_resources({"matchLabels": {"team": "a"}})
+        items, _ = dst.list("pods")
+        assert [i["metadata"]["name"] for i in items] == ["keep"]
+
+    def test_file_source_import(self):
+        snap = {"nodes": [node("n1")], "pods": [pod("a")]}
+        dst = ObjectStore()
+        imp = OneShotImporter(FileSource(snap), ResourceApplier(dst))
+        assert imp.import_cluster_resources() == 2
+
+    def test_syncer_streams_and_keeps_scheduler_authority(self):
+        src, dst = ObjectStore(), ObjectStore()
+        syncer = SyncerService(src, ResourceApplier(dst))
+        src.create("nodes", node("n1"))
+        syncer.run()
+        src.create("pods", pod("a"))
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            try:
+                dst.get("pods", "a")
+                break
+            except NotFound:
+                time.sleep(0.01)
+        assert dst.get("nodes", "n1")
+        # simulator schedules the pod; a source update must NOT clobber it
+        p = dst.get("pods", "a")
+        p["spec"]["nodeName"] = "n1"
+        dst.update("pods", p)
+        sp = src.get("pods", "a")
+        sp["metadata"]["labels"] = {"changed": "yes"}
+        src.update("pods", sp)
+        time.sleep(0.2)
+        assert dst.get("pods", "a")["metadata"].get("labels") == {}
+        syncer.stop()
+
+
+# ---------------------------------------------------------------- reflector
+
+class TestReflector:
+    def test_reflect_merges_and_history(self):
+        s = ObjectStore()
+        s.create("pods", pod("a"))
+        rs = ResultStore({"NodeResourcesFit": 1})
+        rs.put_decoded("default", "a", {ann.SELECTED_NODE: "n1"})
+        refl = StoreReflector(s, sleep=lambda _: None)
+        refl.add_result_store(rs, "k")
+        refl.reflect("default", "a")
+        p = s.get("pods", "a")
+        assert p["metadata"]["annotations"][ann.SELECTED_NODE] == "n1"
+        history = json.loads(p["metadata"]["annotations"][ann.RESULT_HISTORY])
+        assert len(history) == 1 and history[0][ann.SELECTED_NODE] == "n1"
+        # store entry deleted after success
+        assert rs.get_stored_result(p) is None
+
+    def test_history_trims_oldest(self):
+        p = {"metadata": {"annotations": {}}}
+        big = "x" * 60000
+        for i in range(6):
+            update_result_history(p, {"payload": big, "i": str(i)})
+        history = json.loads(p["metadata"]["annotations"][ann.RESULT_HISTORY])
+        assert len(history) < 6  # trimmed from the oldest side
+        assert history[-1]["i"] == "5"
+        assert len(p["metadata"]["annotations"][ann.RESULT_HISTORY]) <= ann.TOTAL_ANNOTATION_SIZE_LIMIT
+
+
+# ---------------------------------------------------------------- engine + service
+
+class TestEngineAndService:
+    def test_schedule_pending_binds_and_annotates(self):
+        s = ObjectStore()
+        for n in make_nodes(4, seed=5):
+            s.create("nodes", n)
+        for p in make_pods(6, seed=6):
+            s.create("pods", p)
+        engine = SchedulerEngine(s)
+        bound = engine.schedule_pending()
+        assert bound == 6
+        p = s.get("pods", "pod-00000")
+        assert p["spec"]["nodeName"]
+        annos = p["metadata"]["annotations"]
+        assert annos[ann.SELECTED_NODE] == p["spec"]["nodeName"]
+        assert ann.FINAL_SCORE_RESULT in annos
+        assert json.loads(annos[ann.RESULT_HISTORY])
+
+    def test_unschedulable_pod_gets_condition(self):
+        s = ObjectStore()
+        for n in make_nodes(2, seed=5):
+            s.create("nodes", n)
+        huge = pod("huge")
+        huge["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "100000"
+        s.create("pods", huge)
+        engine = SchedulerEngine(s)
+        assert engine.schedule_pending() == 0
+        p = s.get("pods", "huge")
+        cond = p["status"]["conditions"][0]
+        assert cond["status"] == "False" and cond["reason"] == "Unschedulable"
+        assert p["metadata"]["annotations"][ann.SELECTED_NODE] == ""
+
+    def test_priority_order(self):
+        s = ObjectStore()
+        for n in make_nodes(2, seed=1):
+            s.create("nodes", n)
+        low = pod("low")
+        high = pod("high")
+        high["spec"]["priority"] = 1000
+        s.create("pods", low)
+        s.create("pods", high)
+        engine = SchedulerEngine(s)
+        assert [p["metadata"]["name"] for p in engine.pending_pods()] == ["high", "low"]
+
+    def test_scheduler_service_rollback(self):
+        engine = SchedulerEngine(ObjectStore())
+        svc = SchedulerService(engine)
+        good = svc.get_config()
+        bad = {"profiles": [{"plugins": {"multiPoint": {"enabled": 42}}}]}
+        with pytest.raises(Exception):
+            svc.restart_scheduler(bad)
+        assert svc.get_config() == good
+
+
+# ---------------------------------------------------------------- config conversion
+
+class TestConvert:
+    def test_default_config_has_all_plugins(self):
+        cfg = default_scheduler_config()
+        names = [p["name"] for p in cfg["profiles"][0]["plugins"]["multiPoint"]["enabled"]]
+        assert "NodeResourcesFit" in names and "PodTopologySpread" in names
+
+    def test_convert_wraps_and_disables_star(self):
+        cfg = convert_configuration_for_simulator({"profiles": [{
+            "plugins": {"multiPoint": {"enabled": [{"name": "NodeResourcesFit", "weight": 2}]}},
+        }]})
+        mp = cfg["profiles"][0]["plugins"]["multiPoint"]
+        names = [p["name"] for p in mp["enabled"]]
+        assert all(n.endswith("Wrapped") for n in names)
+        assert "NodeResourcesFitWrapped" in names
+        assert mp["disabled"] == [{"name": "*"}]
+        # re-configured default keeps its position but takes the weight
+        fit = next(p for p in mp["enabled"] if p["name"] == "NodeResourcesFitWrapped")
+        assert fit["weight"] == 2
+
+    def test_parse_plugin_set_weights(self):
+        ps = parse_plugin_set({"profiles": [{"plugins": {"multiPoint": {"enabled": [
+            {"name": "NodeResourcesFit", "weight": 5},
+            {"name": "TaintToleration"},  # weight 0 -> 1
+        ], "disabled": [{"name": "*"}]}}}]})
+        assert ps.enabled == ["TaintToleration", "NodeResourcesFit"]
+        assert ps.weight("NodeResourcesFit") == 5
+        assert ps.weight("TaintToleration") == 1
+
+    def test_parse_default(self):
+        ps = parse_plugin_set(None)
+        assert ps.weight("TaintToleration") == 3
+        assert ps.weight("NodeAffinity") == 2

@@ -1,0 +1,107 @@
+"""Focused plugin-semantics regressions (cases found in review, each a
+divergence risk vs upstream v1.32 behavior)."""
+
+import json
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store import annotations as ann
+from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+
+def run_both(nodes, pods, cfg, bound=None):
+    seq = SequentialScheduler(nodes, pods, cfg, bound_pods=bound).schedule_all()
+    rr = replay(compile_workload(nodes, pods, cfg, bound_pods=bound), chunk=16)
+    dev = [(decode_pod_result(rr, i), int(rr.selected[i])) for i in range(len(pods))]
+    for i, ((sa, ss), (da, ds)) in enumerate(zip(seq, dev)):
+        assert ss == ds, f"pod {i} selection: seq={ss} dev={ds}"
+        assert sa == da, f"pod {i} annotations diverge"
+    return seq
+
+
+def mini_pod(name, cpu="100m", labels=None, **spec_extra):
+    spec = {"containers": [{"name": "c", "resources": {"requests": {"cpu": cpu}}}]}
+    spec.update(spec_extra)
+    return {"metadata": {"name": name, "namespace": "default", "labels": labels or {}},
+            "spec": spec}
+
+
+def small_nodes(n=3):
+    return [
+        {"metadata": {"name": f"n{i}", "labels": {"zone": f"z{i % 2}"}},
+         "status": {"allocatable": {"cpu": "1", "memory": "2Gi", "pods": "110"}}}
+        for i in range(n)
+    ]
+
+
+def test_zero_request_pod_on_overcommitted_node():
+    """Upstream fitsRequest early-returns for zero-request pods; an
+    overcommitted node (bound pods exceed allocatable) must still accept
+    them — only 'Too many pods' can fail."""
+    nodes = small_nodes(2)
+    # overcommit n0 beyond allocatable via bound pods
+    bound = [(mini_pod(f"big{i}", cpu="900m"), "n0") for i in range(3)]
+    zero = {"metadata": {"name": "zero", "namespace": "default"},
+            "spec": {"containers": [{"name": "c"}]}}
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"])
+    seq = run_both(nodes, [zero], cfg, bound=bound)
+    fr = json.loads(seq[0][0][ann.FILTER_RESULT])
+    assert fr["n0"]["NodeResourcesFit"] == "passed"
+
+
+def test_nodename_always_records():
+    """NodeName has no PreFilter: it must appear in filter-result for pods
+    without spec.nodeName too (upstream records 'passed' everywhere)."""
+    nodes = small_nodes(2)
+    cfg = PluginSetConfig(enabled=["NodeName", "NodeResourcesFit"])
+    seq = run_both(nodes, [mini_pod("p")], cfg)
+    fr = json.loads(seq[0][0][ann.FILTER_RESULT])
+    assert fr["n0"]["NodeName"] == "passed"
+
+
+def test_nodename_pinned():
+    nodes = small_nodes(3)
+    cfg = PluginSetConfig(enabled=["NodeName", "NodeResourcesFit"])
+    seq = run_both(nodes, [mini_pod("p", nodeName="n2")], cfg)
+    assert seq[0][0][ann.SELECTED_NODE] == "n2"
+    fr = json.loads(seq[0][0][ann.FILTER_RESULT])
+    assert fr["n0"]["NodeName"] == "node(s) didn't match the requested node name"
+
+
+def test_gt_expression_invalid_values_never_match():
+    nodes = [
+        {"metadata": {"name": "n0", "labels": {"gpu-count": "4"}},
+         "status": {"allocatable": {"cpu": "1", "memory": "1Gi", "pods": "10"}}},
+    ]
+    aff = {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+        "nodeSelectorTerms": [{"matchExpressions": [
+            {"key": "gpu-count", "operator": "Gt", "values": []}  # invalid
+        ]}]}}}
+    cfg = PluginSetConfig(enabled=["NodeAffinity", "NodeResourcesFit"])
+    seq = run_both(nodes, [mini_pod("p", affinity=aff)], cfg)
+    assert seq[0][1] == -1  # invalid Gt matches nothing -> unschedulable
+
+
+def test_first_pod_self_affinity_escape_ignores_unkeyed_nodes():
+    """A bound pod on a node WITHOUT the term's topologyKey must not block
+    the first-pod-in-series affinity escape (upstream only counts keyed
+    nodes in affinityCounts)."""
+    nodes = [
+        {"metadata": {"name": "keyed", "labels": {"zone": "z1"}},
+         "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}}},
+        {"metadata": {"name": "unkeyed"},  # no zone label
+         "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}}},
+    ]
+    # bound pod matching the selector sits on the UNKEYED node
+    bound = [(mini_pod("existing", labels={"app": "db"}), "unkeyed")]
+    aff = {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+        {"topologyKey": "zone", "labelSelector": {"matchLabels": {"app": "db"}}},
+    ]}}
+    incoming = mini_pod("incoming", labels={"app": "db"}, affinity=aff)
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit", "InterPodAffinity"])
+    seq = run_both(nodes, [incoming], cfg, bound=bound)
+    # escape applies on the keyed node: schedulable there
+    assert seq[0][0][ann.SELECTED_NODE] == "keyed"

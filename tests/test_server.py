@@ -1,0 +1,136 @@
+"""HTTP API tests — the real server on an ephemeral port, driven over HTTP.
+
+Route-parity checks against reference simulator/server/server.go:42-61.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.config.config import SimulatorConfiguration
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes
+from kube_scheduler_simulator_tpu.server.di import DIContainer
+from kube_scheduler_simulator_tpu.server.server import SimulatorServer
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+
+@pytest.fixture()
+def server():
+    cfg = SimulatorConfiguration(port=0)
+    di = DIContainer(cfg)
+    srv = SimulatorServer(di, port=0)
+    srv.start(block=False)
+    yield srv
+    srv.shutdown()
+
+
+def req(srv, method, path, body=None):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None
+
+
+def test_scheduler_configuration_roundtrip(server):
+    code, cfg = req(server, "GET", "/api/v1/schedulerconfiguration")
+    assert code == 200 and cfg["kind"] == "KubeSchedulerConfiguration"
+    code, _ = req(server, "POST", "/api/v1/schedulerconfiguration", {
+        "profiles": [{"schedulerName": "default-scheduler", "plugins": {
+            "multiPoint": {"enabled": [{"name": "NodeResourcesFit", "weight": 9}],
+                           "disabled": [{"name": "*"}]}}}],
+    })
+    assert code == 202
+    code, cfg = req(server, "GET", "/api/v1/schedulerconfiguration")
+    assert cfg["profiles"][0]["plugins"]["multiPoint"]["enabled"][0]["weight"] == 9
+
+
+def test_resource_crud_and_scheduling_e2e(server):
+    for n in make_nodes(3, seed=2):
+        code, _ = req(server, "POST", "/api/v1/nodes", n)
+        assert code == 201
+    pod = {"metadata": {"name": "web", "namespace": "default"},
+           "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "500m"}}}]}}
+    code, created = req(server, "POST", "/api/v1/pods", pod)
+    assert code == 201 and created["metadata"]["uid"]
+    # the scheduling loop should bind + annotate it
+    deadline = time.time() + 10
+    bound = None
+    while time.time() < deadline:
+        _, got = req(server, "GET", "/api/v1/pods/default/web")
+        if (got.get("spec") or {}).get("nodeName"):
+            bound = got
+            break
+        time.sleep(0.1)
+    assert bound, "pod was not scheduled by the scheduling loop"
+    annos = bound["metadata"]["annotations"]
+    assert annos[ann.SELECTED_NODE] == bound["spec"]["nodeName"]
+    assert ann.FINAL_SCORE_RESULT in annos
+    assert bound["status"]["phase"] == "Running"
+
+
+def test_export_import_reset(server):
+    req(server, "POST", "/api/v1/nodes", make_nodes(1, seed=3)[0])
+    code, snap = req(server, "GET", "/api/v1/export")
+    assert code == 200 and len(snap["nodes"]) == 1
+    code, _ = req(server, "PUT", "/api/v1/reset")
+    assert code == 202
+    _, after = req(server, "GET", "/api/v1/export")
+    assert after["nodes"] == []
+    code, _ = req(server, "POST", "/api/v1/import", snap)
+    assert code == 200
+    _, back = req(server, "GET", "/api/v1/export")
+    assert len(back["nodes"]) == 1
+
+
+def test_listwatch_stream(server):
+    req(server, "POST", "/api/v1/nodes", make_nodes(1, seed=4)[0])
+    url = f"http://127.0.0.1:{server.port}/api/v1/listwatchresources"
+    events = []
+
+    def read_stream():
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            dec = json.JSONDecoder()
+            buf = ""
+            while len(events) < 2:
+                chunk = resp.read1(65536).decode()
+                if not chunk:
+                    break
+                buf += chunk
+                while buf:
+                    try:
+                        obj, end = dec.raw_decode(buf)
+                    except json.JSONDecodeError:
+                        break
+                    events.append(obj)
+                    buf = buf[end:]
+
+    t = threading.Thread(target=read_stream, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    req(server, "POST", "/api/v1/nodes", {"metadata": {"name": "late-node"},
+                                          "status": {"allocatable": {"cpu": "1"}}})
+    t.join(timeout=5)
+    kinds = [(e["kind"], e["eventType"]) for e in events]
+    assert ("Node", "ADDED") in kinds
+    names = [e["obj"]["metadata"]["name"] for e in events if e["kind"] == "Node"]
+    assert "late-node" in names or len(names) >= 1
+
+
+def test_extender_route_without_extenders(server):
+    code, body = req(server, "POST", "/api/v1/extender/filter/0", {"Nodes": None})
+    assert code == 400
+
+
+def test_unknown_route_404(server):
+    code, _ = req(server, "GET", "/api/v1/nosuch")
+    assert code == 404
