@@ -26,7 +26,8 @@ from ..store.decode import decode_pod_result
 from ..store.reflector import StoreReflector
 from ..store.resultstore import ResultStore
 
-RESULT_STORE_KEY = "PluginResultStoreKey"  # reference: plugins.go:23
+RESULT_STORE_KEY = "PluginResultStoreKey"      # reference: plugins.go:23
+EXTENDER_STORE_KEY = "ExtenderResultStoreKey"  # reference: extender/service.go:24
 
 
 class SchedulerEngine:
@@ -41,10 +42,23 @@ class SchedulerEngine:
             self.reflector.add_result_store(self.result_store, RESULT_STORE_KEY)
         self.plugin_config = plugin_config or PluginSetConfig()
         self.chunk = chunk
+        self.extender_service = None
+        self.plugin_extenders: list = []
 
     def set_plugin_config(self, cfg: PluginSetConfig) -> None:
         # validates by constructing; the service uses this for rollback
-        self.plugin_config = PluginSetConfig(enabled=list(cfg.enabled), weights=dict(cfg.weights))
+        self.plugin_config = PluginSetConfig(
+            enabled=list(cfg.enabled), weights=dict(cfg.weights), custom=dict(cfg.custom)
+        )
+
+    def set_extenders(self, extender_service) -> None:
+        """Configure webhook extenders; scheduling switches to the phased
+        (host-interleaved) path while any are present."""
+        self.extender_service = extender_service
+        if extender_service is not None:
+            self.reflector.add_result_store(extender_service.result_store, EXTENDER_STORE_KEY)
+        else:
+            self.reflector.result_stores.pop(EXTENDER_STORE_KEY, None)
 
     # ------------------------------------------------------------ run
 
@@ -72,6 +86,8 @@ class SchedulerEngine:
             if (p.get("spec") or {}).get("nodeName")
         ]
         cw = compile_workload(nodes, pending, self.plugin_config, bound_pods=bound)
+        if self.extender_service is not None and self.extender_service.extenders:
+            return self._schedule_with_extenders(cw, pending)
         rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)))
 
         n_bound = 0
@@ -80,9 +96,137 @@ class SchedulerEngine:
             ns, name = meta.get("namespace") or "default", meta.get("name", "")
             annotations = decode_pod_result(rr, i)
             self.result_store.put_decoded(ns, name, annotations)
+            for hook in self.plugin_extenders:
+                hook.after_cycle(pod, annotations, self.result_store)
             sel = int(rr.selected[i])
             if sel >= 0:
                 self._bind(ns, name, cw.node_table.names[sel])
+                n_bound += 1
+            else:
+                self._mark_unschedulable(ns, name)
+            self.reflector.reflect(ns, name)
+        return n_bound
+
+    def _schedule_with_extenders(self, cw, pending) -> int:
+        """Phased path: device eval -> extender Filter/Prioritize over HTTP
+        -> host selection -> device bind (the reference's extender
+        round-trip, SURVEY.md §3.3, spliced into the tensor pipeline)."""
+        import jax
+        import numpy as np
+
+        from .pipeline import build_phased
+        from .replay import ReplayResult
+
+        eval_fn, bind_fn = build_phased(cw)
+        carry = jax.tree.map(lambda a: a, cw.init_carry)
+        names = cw.node_table.names
+        name_to_idx = {nm: j for j, nm in enumerate(names)}
+        n_bound = 0
+
+        for i, pod in enumerate(pending):
+            sl = jax.tree.map(lambda a: a[i] if hasattr(a, "ndim") and a.ndim else a, cw.xs)
+            out = eval_fn(carry, sl)
+            codes = np.asarray(out.filter_codes)
+            fskip = cw.host["filter_skip"]
+            active = [f for f, nm in enumerate(cw.config.filters()) if not fskip[nm][i]]
+            feasible = codes[active].max(axis=0) == 0 if active else np.ones(len(names), bool)
+
+            meta = pod.get("metadata") or {}
+            ns, name = meta.get("namespace") or "default", meta.get("name", "")
+            ext_error = False
+            for idx, ext in enumerate(self.extender_service.extenders):
+                if not ext.filter_verb or not feasible.any():
+                    continue
+                node_names = [names[j] for j in np.flatnonzero(feasible)]
+                args = {"Pod": pod, "NodeNames": node_names}
+                try:
+                    result = self.extender_service.handle("filter", idx, args)
+                except Exception:
+                    if ext.ignorable:
+                        continue
+                    ext_error = True
+                    break
+                # nodeCacheCapable extenders answer with NodeNames; the
+                # default contract answers with a full Nodes list.  Per-node
+                # FailedNodes reasons travel in the recorded
+                # extender-filter-result annotation (handle() stored the
+                # whole response).
+                kept = result.get("NodeNames") or result.get("nodeNames")
+                if kept is None:
+                    nodes_obj = result.get("Nodes") or result.get("nodes")
+                    if nodes_obj is not None:
+                        kept = [
+                            ((item.get("metadata") or {}).get("name", ""))
+                            for item in (nodes_obj.get("Items") or nodes_obj.get("items") or [])
+                        ]
+                if kept is None:
+                    continue  # extender restricted nothing
+                keep_mask = np.zeros(len(names), bool)
+                for nm in kept:
+                    j = name_to_idx.get(nm)
+                    if j is not None:
+                        keep_mask[j] = True
+                feasible &= keep_mask
+
+            total = np.asarray(out.score_final).sum(axis=0).astype(np.int64)
+            for idx, ext in enumerate(self.extender_service.extenders):
+                if not ext.prioritize_verb or feasible.sum() <= 1:
+                    continue
+                node_names = [names[j] for j in np.flatnonzero(feasible)]
+                try:
+                    plist = self.extender_service.handle(
+                        "prioritize", idx, {"Pod": pod, "NodeNames": node_names}
+                    )
+                except Exception:
+                    continue
+                for entry in plist or []:
+                    j = name_to_idx.get(entry.get("Host") or entry.get("host", ""))
+                    if j is not None:
+                        total[j] += int(entry.get("Score") or entry.get("score") or 0) * ext.weight
+
+            count = int(feasible.sum())
+            sel = -1
+            if count == 1:
+                sel = int(np.flatnonzero(feasible)[0])
+            elif count > 1:
+                masked = np.where(feasible, total, -1)
+                sel = int(masked.argmax())
+
+            rr1 = ReplayResult(
+                cw=cw,
+                filter_codes=codes[None],
+                score_raw=np.asarray(out.score_raw)[None],
+                score_final=np.asarray(out.score_final)[None],
+                selected=np.asarray([sel], dtype=np.int32),
+                feasible_count=np.asarray([count], dtype=np.int32),
+            )
+            annotations = decode_pod_result(rr1, 0, feasible_override=feasible)
+            self.result_store.put_decoded(ns, name, annotations)
+            for hook in self.plugin_extenders:
+                hook.after_cycle(pod, annotations, self.result_store)
+
+            bind_ok = sel >= 0 and not ext_error
+            if bind_ok:
+                bound_node = names[sel]
+                bind_ext = next(
+                    (k for k, e in enumerate(self.extender_service.extenders) if e.bind_verb),
+                    None,
+                )
+                if bind_ext is not None:
+                    # upstream: a bind-verb extender REPLACES the default
+                    # binder; its failure fails the cycle (pod retries)
+                    try:
+                        result = self.extender_service.handle("bind", bind_ext, {
+                            "PodName": name, "PodNamespace": ns,
+                            "PodUID": meta.get("uid", ""), "Node": bound_node,
+                        })
+                        if (result or {}).get("Error"):
+                            bind_ok = False
+                    except Exception:
+                        bind_ok = False
+            if bind_ok:
+                carry = bind_fn(carry, sl, sel)
+                self._bind(ns, name, names[sel])
                 n_bound += 1
             else:
                 self._mark_unschedulable(ns, name)

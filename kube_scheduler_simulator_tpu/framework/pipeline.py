@@ -51,6 +51,8 @@ class StepOut(NamedTuple):
 
 
 def _filter_one(name: str, cw: CompiledWorkload, carry, sl) -> jnp.ndarray:
+    if cw.config.is_custom(name):
+        return sl[name].codes.astype(jnp.int32)
     if name == "NodeResourcesFit":
         return noderesources.fit_filter(cw.statics["core"], sl["core"], carry["core"])
     if name == "NodeAffinity":
@@ -74,6 +76,9 @@ def _filter_one(name: str, cw: CompiledWorkload, carry, sl) -> jnp.ndarray:
 
 def _score_one(name: str, cw: CompiledWorkload, carry, sl, feasible):
     """-> (raw int64 [N], normalized int64 [N])."""
+    if cw.config.is_custom(name):
+        raw = sl[name].scores.astype(jnp.int64)
+        return raw, raw  # custom NormalizeScore unsupported (build_custom rejects)
     if name == "NodeResourcesFit":
         raw = noderesources.fit_score(cw.statics["core"], sl["core"], carry["core"])
         return raw, raw  # no ScoreExtensions
@@ -99,6 +104,59 @@ def _score_one(name: str, cw: CompiledWorkload, carry, sl, feasible):
     raise ValueError(f"no score kernel for {name}")
 
 
+def _eval_phase(cw: CompiledWorkload, carry, sl, weights, filter_names, score_names):
+    """filter -> score -> normalize -> weight. Returns
+    (filter_codes [F,N], score_raw [S,N], score_final [S,N], feasible [N],
+    total [N] with infeasible forced to -1)."""
+    n = cw.n_nodes
+
+    codes = []
+    feasible = jnp.ones(n, dtype=bool)
+    for name in filter_names:
+        code = _filter_one(name, cw, carry, sl)
+        x = sl.get(name)
+        if x is not None and hasattr(x, "filter_skip"):
+            code = jnp.where(x.filter_skip, 0, code)
+        codes.append(code)
+        feasible = feasible & (code == 0)
+    filter_codes = jnp.stack(codes) if codes else jnp.zeros((0, n), dtype=jnp.int32)
+
+    raws, finals = [], []
+    total = jnp.zeros(n, dtype=jnp.int64)
+    for i, name in enumerate(score_names):
+        raw, normed = _score_one(name, cw, carry, sl, feasible)
+        final = normed * weights[i]
+        x = sl.get(name)
+        if x is not None and hasattr(x, "score_skip"):
+            skip = x.score_skip
+            raw = jnp.where(skip, 0, raw)
+            final = jnp.where(skip, 0, final)
+        raws.append(raw)
+        finals.append(final)
+        total = total + final
+    score_raw = jnp.stack(raws) if raws else jnp.zeros((0, n), dtype=jnp.int64)
+    score_final = jnp.stack(finals) if finals else jnp.zeros((0, n), dtype=jnp.int64)
+    total = jnp.where(feasible, total, jnp.int64(-1))
+    return filter_codes, score_raw, score_final, feasible, total
+
+
+def _bind_phase(cw: CompiledWorkload, carry, sl, selected):
+    """Apply a bind of this pod to node `selected` (-1: no-op)."""
+    new_carry = dict(carry)
+    new_carry["core"] = noderesources.core_bind_update(carry["core"], sl["core"], selected)
+    if "PodTopologySpread" in carry:
+        new_carry["PodTopologySpread"] = topologyspread.bind_update(
+            cw.statics["PodTopologySpread"], sl["PodTopologySpread"],
+            carry["PodTopologySpread"], selected,
+        )
+    if "InterPodAffinity" in carry:
+        new_carry["InterPodAffinity"] = interpod.bind_update(
+            cw.statics["InterPodAffinity"], sl["InterPodAffinity"],
+            carry["InterPodAffinity"], selected,
+        )
+    return new_carry
+
+
 def build_step(cw: CompiledWorkload):
     """Returns step(carry_dict, xs_slice_dict) -> (carry', StepOut)."""
     cfg = cw.config
@@ -107,63 +165,17 @@ def build_step(cw: CompiledWorkload):
     weights = jnp.asarray([cfg.weight(n) for n in score_names], dtype=jnp.int64)
 
     def step(carry: dict[str, Any], sl: dict[str, Any]):
-        n = cw.n_nodes
-
-        codes = []
-        feasible = jnp.ones(n, dtype=bool)
-        for name in filter_names:
-            code = _filter_one(name, cw, carry, sl)
-            x = sl.get(name)
-            if x is not None and hasattr(x, "filter_skip"):
-                code = jnp.where(x.filter_skip, 0, code)
-            codes.append(code)
-            feasible = feasible & (code == 0)
-        filter_codes = (
-            jnp.stack(codes) if codes else jnp.zeros((0, n), dtype=jnp.int32)
+        filter_codes, score_raw, score_final, feasible, total = _eval_phase(
+            cw, carry, sl, weights, filter_names, score_names
         )
-
-        raws, finals = [], []
-        total = jnp.zeros(n, dtype=jnp.int64)
-        for i, name in enumerate(score_names):
-            raw, normed = _score_one(name, cw, carry, sl, feasible)
-            final = normed * weights[i]
-            x = sl.get(name)
-            if x is not None and hasattr(x, "score_skip"):
-                skip = x.score_skip
-                raw = jnp.where(skip, 0, raw)
-                final = jnp.where(skip, 0, final)
-            raws.append(raw)
-            finals.append(final)
-            total = total + final
-        score_raw = (
-            jnp.stack(raws) if raws else jnp.zeros((0, n), dtype=jnp.int64)
-        )
-        score_final = (
-            jnp.stack(finals) if finals else jnp.zeros((0, n), dtype=jnp.int64)
-        )
-
         feasible_count = jnp.sum(feasible, dtype=jnp.int32)
-        total = jnp.where(feasible, total, jnp.int64(-1))
         selected = jnp.argmax(total).astype(jnp.int32)  # first max == lowest index
         selected = jnp.where(feasible_count > 0, selected, jnp.int32(-1))
         is_pad = sl.get("is_pad")
         if is_pad is not None:
             selected = jnp.where(is_pad, jnp.int32(-1), selected)
 
-        # --- bind: update carries --------------------------------------
-        new_carry = dict(carry)
-        new_carry["core"] = noderesources.core_bind_update(carry["core"], sl["core"], selected)
-        if "PodTopologySpread" in carry:
-            new_carry["PodTopologySpread"] = topologyspread.bind_update(
-                cw.statics["PodTopologySpread"], sl["PodTopologySpread"],
-                carry["PodTopologySpread"], selected,
-            )
-        if "InterPodAffinity" in carry:
-            new_carry["InterPodAffinity"] = interpod.bind_update(
-                cw.statics["InterPodAffinity"], sl["InterPodAffinity"],
-                carry["InterPodAffinity"], selected,
-            )
-
+        new_carry = _bind_phase(cw, carry, sl, selected)
         out = StepOut(
             filter_codes=filter_codes.astype(jnp.int32),
             score_raw=score_raw.astype(jnp.int32),
@@ -174,3 +186,40 @@ def build_step(cw: CompiledWorkload):
         return new_carry, out
 
     return step
+
+
+def build_phased(cw: CompiledWorkload):
+    """(eval_fn, bind_fn) for host-interleaved phases — the extender path:
+    the host can veto/boost nodes between the device's score phase and the
+    bind (reference extender round-trip, SURVEY.md §3.3).
+
+      eval_fn(carry, xs_slice) -> StepOut (selected = the device's own
+                                  choice, advisory; carry NOT updated)
+      bind_fn(carry, xs_slice, selected int32) -> carry'
+    """
+    import jax
+
+    cfg = cw.config
+    filter_names = cfg.filters()
+    score_names = cfg.scorers()
+    weights = jnp.asarray([cfg.weight(n) for n in score_names], dtype=jnp.int64)
+
+    def eval_fn(carry, sl):
+        filter_codes, score_raw, score_final, feasible, total = _eval_phase(
+            cw, carry, sl, weights, filter_names, score_names
+        )
+        feasible_count = jnp.sum(feasible, dtype=jnp.int32)
+        selected = jnp.argmax(total).astype(jnp.int32)
+        selected = jnp.where(feasible_count > 0, selected, jnp.int32(-1))
+        return StepOut(
+            filter_codes=filter_codes.astype(jnp.int32),
+            score_raw=score_raw.astype(jnp.int32),
+            score_final=score_final.astype(jnp.int32),
+            selected=selected,
+            feasible_count=feasible_count,
+        )
+
+    def bind_fn(carry, sl, selected):
+        return _bind_phase(cw, carry, sl, jnp.asarray(selected, dtype=jnp.int32))
+
+    return jax.jit(eval_fn), jax.jit(bind_fn)

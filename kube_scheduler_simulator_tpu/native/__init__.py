@@ -1,0 +1,71 @@
+"""ctypes loader for the native annotation codec.
+
+Builds annotation_codec.cpp with g++ on first use (cached next to the
+source); falls back to the pure-Python encoder when the toolchain is
+unavailable.  See annotation_codec.cpp for the encoding contract.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build_and_load():
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "annotation_codec.cpp")
+    so = os.path.join(here, "_annotation_codec.so")
+    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so, src],
+            check=True, capture_output=True,
+        )
+    lib = ctypes.CDLL(so)
+    P = ctypes.POINTER
+    lib.encode_filter_result.restype = ctypes.c_void_p
+    lib.encode_filter_result.argtypes = [
+        ctypes.c_int32, ctypes.c_int32,
+        P(ctypes.c_int32), P(ctypes.c_uint8),
+        P(ctypes.c_char_p), P(ctypes.c_char_p),
+        P(ctypes.c_int32), P(ctypes.c_int32),
+        P(ctypes.c_char_p), P(ctypes.c_int32), P(ctypes.c_uint8),
+    ]
+    lib.encode_score_result.restype = ctypes.c_void_p
+    lib.encode_score_result.argtypes = [
+        ctypes.c_int32, ctypes.c_int32,
+        P(ctypes.c_int32), P(ctypes.c_uint8), P(ctypes.c_uint8),
+        P(ctypes.c_char_p), P(ctypes.c_char_p),
+        P(ctypes.c_int32), P(ctypes.c_int32),
+    ]
+    lib.codec_free.restype = None
+    lib.codec_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def get_lib():
+    """The loaded codec, or None when native build is unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is None and not _tried:
+            _tried = True
+            try:
+                _lib = _build_and_load()
+            except Exception:
+                _lib = None
+    return _lib
+
+
+def take_string(lib, ptr) -> str:
+    """Copy a codec-allocated C string and free it."""
+    try:
+        return ctypes.string_at(ptr).decode()
+    finally:
+        lib.codec_free(ptr)

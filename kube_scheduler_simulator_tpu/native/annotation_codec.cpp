@@ -1,0 +1,183 @@
+// Native annotation codec — the host-side hot path of the reflector.
+//
+// The reference serializes scheduling results to Pod annotations in Go
+// (simulator/scheduler/plugin/resultstore/store.go:133-198); at 10k pods x
+// 5k nodes the filter/score/finalscore JSON blobs dominate host time in
+// this framework's write-back path, so they are encoded here in C++ and
+// exposed over a C ABI consumed via ctypes (no pybind11 in this image).
+//
+// Encoding contract (byte-identical to Go encoding/json):
+//   * compact (no spaces), map keys sorted lexicographically (Go sorts
+//     map keys when marshaling);
+//   * strings escaped per encoding/json: ", \\, control chars, and the
+//     HTML-safe set < > & as < > &;
+//   * filter map reproduces the framework's stop-at-first-fail truncation:
+//     plugins in execution order until the first failure, keys sorted in
+//     the output object.
+//
+// Message resolution is table-driven: per filter plugin a LUT indexed by
+// (code-1), either shared across nodes or per-node (taint messages embed
+// the node's taint key/value).  Python builds the LUTs once per compiled
+// workload.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <string>
+#include <algorithm>
+#include <vector>
+
+namespace {
+
+void append_escaped(std::string& out, const char* s) {
+    out.push_back('"');
+    for (const unsigned char* p = (const unsigned char*)s; *p; ++p) {
+        unsigned char c = *p;
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\r': out += "\\r"; break;
+            case '\t': out += "\\t"; break;
+            case '<': out += "\\u003c"; break;
+            case '>': out += "\\u003e"; break;
+            case '&': out += "\\u0026"; break;
+            default:
+                if (c < 0x20) {
+                    char buf[8];
+                    snprintf(buf, sizeof buf, "\\u%04x", c);
+                    out += buf;
+                } else {
+                    out.push_back((char)c);
+                }
+        }
+    }
+    out.push_back('"');
+}
+
+char* dup_string(const std::string& s) {
+    char* out = (char*)std::malloc(s.size() + 1);
+    std::memcpy(out, s.c_str(), s.size() + 1);
+    return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void codec_free(char* p) { std::free(p); }
+
+// filter-result: {"node":{"Plugin":"passed"|msg,...},...}
+//
+// codes:        [F*N] int32, 0 == pass (plugin-skip already zeroed)
+// active:       [F] uint8 — plugins whose Filter ran for this pod
+// sorted_nodes: [N] int32 — node indices in lexicographic name order
+// sorted_plugins_by_name: [F] int32 — plugin indices sorted by name
+// lut_flat/lut_off: message LUTs; for plugin f the LUT spans
+//     lut_flat[lut_off[f] .. lut_off[f+1]) ; node-dependent plugins
+//     (per_node[f] != 0) use stride = (lut_off[f+1]-lut_off[f])/N per node.
+char* encode_filter_result(
+    int32_t n, int32_t f,
+    const int32_t* codes,
+    const uint8_t* active,
+    const char* const* node_names,
+    const char* const* plugin_names,
+    const int32_t* sorted_nodes,
+    const int32_t* sorted_plugins_by_name,
+    const char* const* lut_flat,
+    const int32_t* lut_off,
+    const uint8_t* per_node) {
+    std::string out;
+    out.reserve((size_t)n * 64);
+    out.push_back('{');
+    bool any_active = false;
+    for (int32_t pf = 0; pf < f; ++pf) any_active |= (bool)active[pf];
+    bool first_node = true;
+    for (int32_t si = 0; si < n && any_active; ++si) {
+        int32_t j = sorted_nodes[si];
+        // index (in execution order) of the first failing active plugin
+        int32_t fail_at = f;
+        for (int32_t pf = 0; pf < f; ++pf) {
+            if (active[pf] && codes[(size_t)pf * n + j] != 0) { fail_at = pf; break; }
+        }
+        if (!first_node) out.push_back(',');
+        first_node = false;
+        append_escaped(out, node_names[j]);
+        out.push_back(':');
+        out.push_back('{');
+        // entries: active plugins with index <= fail_at, sorted by name
+        bool first_plugin = true;
+        for (int32_t k = 0; k < f; ++k) {
+            int32_t pf = sorted_plugins_by_name[k];
+            if (!active[pf] || pf > fail_at) continue;
+            const char* msg;
+            int32_t code = codes[(size_t)pf * n + j];
+            if (code == 0) {
+                msg = "passed";
+            } else {
+                int32_t span = lut_off[pf + 1] - lut_off[pf];
+                int32_t base = lut_off[pf];
+                if (per_node[pf]) {
+                    int32_t stride = span / n;
+                    msg = lut_flat[base + (size_t)j * stride + (code - 1)];
+                } else {
+                    msg = lut_flat[base + (code - 1)];
+                }
+            }
+            if (!first_plugin) out.push_back(',');
+            first_plugin = false;
+            append_escaped(out, plugin_names[pf]);
+            out.push_back(':');
+            append_escaped(out, msg);
+        }
+        out.push_back('}');
+    }
+    out.push_back('}');
+    return dup_string(out);
+}
+
+// score-result / finalscore-result: {"node":{"Plugin":"<int>",...},...}
+// over feasible nodes only; plugins with sskip are omitted.
+char* encode_score_result(
+    int32_t n, int32_t s,
+    const int32_t* values,           // [S*N]
+    const uint8_t* sskip,            // [S]
+    const uint8_t* feasible,         // [N]
+    const char* const* node_names,
+    const char* const* score_names,
+    const int32_t* sorted_nodes,
+    const int32_t* sorted_scores_by_name) {
+    std::string out;
+    out.reserve((size_t)n * 48);
+    out.push_back('{');
+    bool first_node = true;
+    for (int32_t si = 0; si < n; ++si) {
+        int32_t j = sorted_nodes[si];
+        if (!feasible[j]) continue;
+        bool any = false;
+        for (int32_t q = 0; q < s; ++q) if (!sskip[q]) { any = true; break; }
+        if (!any) continue;
+        if (!first_node) out.push_back(',');
+        first_node = false;
+        append_escaped(out, node_names[j]);
+        out.push_back(':');
+        out.push_back('{');
+        bool first_sc = true;
+        for (int32_t k = 0; k < s; ++k) {
+            int32_t q = sorted_scores_by_name[k];
+            if (sskip[q]) continue;
+            if (!first_sc) out.push_back(',');
+            first_sc = false;
+            append_escaped(out, score_names[q]);
+            out.push_back(':');
+            char buf[16];
+            snprintf(buf, sizeof buf, "\"%d\"", values[(size_t)q * n + j]);
+            out += buf;
+        }
+        out.push_back('}');
+    }
+    out.push_back('}');
+    return dup_string(out);
+}
+
+}  // extern "C"

@@ -1,0 +1,92 @@
+"""Out-of-tree (custom) plugins — the WithPlugin analogue.
+
+The reference lets users build a debuggable scheduler embedding their own
+plugins (reference: simulator/pkg/debuggablescheduler/command.go:64-75
+WithPlugin/WithPluginExtenders; the wrapping machinery then records their
+results like any in-tree plugin).  Here a custom plugin is a Python object:
+
+    class MyPlugin(CustomPlugin):
+        name = "MyPlugin"
+        default_weight = 1
+        def filter(self, pod, node) -> str | None: ...   # None == pass
+        def score(self, pod, node) -> int: ...
+        def normalize(self, scores: list[int]) -> list[int]: ...  # optional
+
+Because the tensor pipeline precompiles the workload, custom plugin
+results are evaluated host-side ONCE per (pod, node) at compile time and
+enter the device program as dense arrays — exactly like the in-tree
+label-based plugins.  The contract (documented divergence from the
+reference, docs/SEMANTICS.md): custom filter/score must be pure functions
+of (pod manifest, node manifest); they do not observe in-flight bind state.
+Custom messages are interned per plugin; "passed"/"success" recording
+follows the shim semantics (wrappedplugin.go:523-548).
+
+Plugin extenders (Before/After hooks with AddCustomResult) run in the
+engine around each pod's cycle; see scheduler/debuggable.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class CustomPlugin:
+    name: str = "CustomPlugin"
+    default_weight: int = 1
+
+    # presence of overridden methods decides the extension points
+    def filter(self, pod: dict, node: dict) -> str | None:  # pragma: no cover
+        raise NotImplementedError
+
+    def score(self, pod: dict, node: dict) -> int:  # pragma: no cover
+        raise NotImplementedError
+
+    def normalize(self, scores: list[int]) -> list[int]:
+        return list(scores)
+
+    @property
+    def has_filter(self) -> bool:
+        return type(self).filter is not CustomPlugin.filter
+
+    @property
+    def has_score(self) -> bool:
+        return type(self).score is not CustomPlugin.score
+
+    @property
+    def has_normalize(self) -> bool:
+        return type(self).normalize is not CustomPlugin.normalize
+
+
+class CustomXS(NamedTuple):
+    codes: jnp.ndarray   # [P, N] int32; 0 pass, else 1 + msg id
+    scores: jnp.ndarray  # [P, N] int64
+
+
+def build_custom(plugin: CustomPlugin, table, pods: list[dict], node_manifests: list[dict]):
+    """-> (CustomXS, msg_table) — messages interned per plugin."""
+    if plugin.has_normalize:
+        raise ValueError(
+            f"custom plugin {plugin.name}: NormalizeScore extensions are not "
+            "supported in the tensor pipeline yet (arbitrary Python cannot "
+            "run inside the device scan); drop normalize() or open an issue"
+        )
+    n, p = table.n, len(pods)
+    codes = np.zeros((p, n), dtype=np.int32)
+    scores = np.zeros((p, n), dtype=np.int64)
+    msgs: list[str] = []
+    msg_ids: dict[str, int] = {}
+    for i, pod in enumerate(pods):
+        for j in range(n):
+            if plugin.has_filter:
+                msg = plugin.filter(pod, node_manifests[j])
+                if msg is not None:
+                    mid = msg_ids.setdefault(msg, len(msgs))
+                    if mid == len(msgs):
+                        msgs.append(msg)
+                    codes[i, j] = 1 + mid
+            if plugin.has_score:
+                scores[i, j] = int(plugin.score(pod, node_manifests[j]))
+    return CustomXS(codes=jnp.asarray(codes), scores=jnp.asarray(scores)), msgs

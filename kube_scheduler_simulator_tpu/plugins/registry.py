@@ -69,30 +69,49 @@ class PluginSetConfig:
     """Enabled plugins (ordered as in DEFAULT_ORDER) + score weights.
 
     Weight semantics follow the reference: a configured weight of 0 means 1
-    (plugins.go:296-300)."""
+    (plugins.go:296-300).  custom maps out-of-tree plugin name ->
+    CustomPlugin instance (the WithPlugin analogue); custom plugins sort
+    after the in-tree set, like upstream mergePluginSet appending custom
+    enables."""
 
     enabled: list[str] = field(default_factory=default_plugin_names)
     weights: dict[str, int] = field(default_factory=dict)
+    custom: dict[str, object] = field(default_factory=dict)
 
     def __post_init__(self):
         order = {n: i for i, n in enumerate(DEFAULT_ORDER)}
         self.enabled = sorted(self.enabled, key=lambda n: order.get(n, 99))
         for name in self.enabled:
-            if name not in PLUGIN_REGISTRY:
+            if name not in PLUGIN_REGISTRY and name not in self.custom:
                 raise ValueError(f"unknown plugin {name}")
 
+    def _desc(self, name: str):
+        d = PLUGIN_REGISTRY.get(name)
+        if d is not None:
+            return d
+        return self.custom[name]
+
+    def is_custom(self, name: str) -> bool:
+        return name in self.custom and name not in PLUGIN_REGISTRY
+
     def weight(self, name: str) -> int:
-        w = self.weights.get(name, PLUGIN_REGISTRY[name].default_weight)
+        w = self.weights.get(name, self._desc(name).default_weight)
         return w if w != 0 else 1
 
     def filters(self) -> list[str]:
-        return [n for n in self.enabled if PLUGIN_REGISTRY[n].has_filter]
+        return [n for n in self.enabled if self._desc(n).has_filter]
 
     def scorers(self) -> list[str]:
-        return [n for n in self.enabled if PLUGIN_REGISTRY[n].has_score]
+        return [n for n in self.enabled if self._desc(n).has_score]
 
     def prefilters(self) -> list[str]:
-        return [n for n in self.enabled if PLUGIN_REGISTRY[n].has_prefilter]
+        return [
+            n for n in self.enabled
+            if not self.is_custom(n) and PLUGIN_REGISTRY[n].has_prefilter
+        ]
 
     def prescorers(self) -> list[str]:
-        return [n for n in self.enabled if PLUGIN_REGISTRY[n].has_prescore]
+        return [
+            n for n in self.enabled
+            if not self.is_custom(n) and PLUGIN_REGISTRY[n].has_prescore
+        ]

@@ -44,6 +44,7 @@ class SequentialScheduler:
     def __init__(self, nodes, pods, config: PluginSetConfig | None = None, bound_pods=None):
         self.config = config or PluginSetConfig()
         self.pods = pods
+        self.node_manifests = nodes
         self.schema = ResourceSchema.discover(pods + [bp for bp, _ in (bound_pods or [])], nodes)
         self.table = build_node_table(nodes, self.schema)
         self.labels = self.table.labels
@@ -69,6 +70,8 @@ class SequentialScheduler:
 
     def _filter(self, name, pod, req, j) -> str | None:
         """None == pass, else failure message."""
+        if self.config.is_custom(name):
+            return self.config.custom[name].filter(pod, self.node_manifests[j])
         if name == "NodeResourcesFit":
             reasons = []
             if self.num_pods[j] + 1 > self.table.allowed_pods[j]:
@@ -140,6 +143,8 @@ class SequentialScheduler:
         return False
 
     def _score(self, name, pod, req, nz, j) -> int:
+        if self.config.is_custom(name):
+            return int(self.config.custom[name].score(pod, self.node_manifests[j]))
         if name == "NodeResourcesFit":
             total = 0
             for c, col in ((CPU, 0), (MEMORY, 1)):
@@ -189,6 +194,8 @@ class SequentialScheduler:
         raise ValueError(name)
 
     def _normalize(self, name, scores: dict[int, int], pod) -> dict[int, int]:
+        if self.config.is_custom(name):
+            return dict(scores)  # custom NormalizeScore unsupported (see custom.py)
         if name in ("NodeResourcesFit", "NodeResourcesBalancedAllocation"):
             return dict(scores)
         if name in ("NodeAffinity", "TaintToleration"):

@@ -1,0 +1,71 @@
+"""Debuggable-scheduler library: embed custom plugins and hooks.
+
+API parity with the reference's integration library
+(reference: simulator/pkg/debuggablescheduler/command.go:14-75):
+
+    NewSchedulerCommand(WithPlugin(...), WithPluginExtenders(...))
+
+becomes
+
+    di, server = new_scheduler_command(
+        with_plugins=[MyPlugin()],
+        with_plugin_extenders={"NodeResourcesFit": MyExtender()},
+        config=<KubeSchedulerConfiguration dict>, port=1212)
+
+Custom plugins (plugins/custom.py) are compiled into the tensor pipeline;
+plugin extenders are host-side hooks invoked around each pod's scheduling
+cycle with access to the result store, supporting the reference's
+AddCustomResult debugging flow (resultstore/store.go:617-626).  The
+reference's Before* hooks can rewrite plugin inputs mid-cycle; that part
+is out of scope for the tensor pipeline (documented in docs/SEMANTICS.md)
+— after_cycle observation + custom annotations are supported.
+"""
+
+from __future__ import annotations
+
+from .convert import default_scheduler_config
+from ..config.config import SimulatorConfiguration
+from ..plugins.custom import CustomPlugin
+
+
+class PluginExtender:
+    """Host-side hook around a pod's scheduling cycle.
+
+    after_cycle(pod, annotations, result_store): called after the cycle's
+    results are decoded and deposited, before the reflector writes them
+    back; add custom annotations via
+    result_store.add_custom_result(ns, name, key, value).
+    """
+
+    def after_cycle(self, pod: dict, annotations: dict[str, str], result_store) -> None:
+        pass
+
+
+def new_scheduler_command(
+    with_plugins: list[CustomPlugin] | None = None,
+    with_plugin_extenders: dict[str, PluginExtender] | None = None,
+    config: dict | None = None,
+    port: int | None = None,
+    start_scheduler: bool = True,
+):
+    """-> (DIContainer, SimulatorServer) with the custom plugins enabled.
+
+    The returned server is not started; call server.start(block=...).
+    """
+    from ..server.di import DIContainer
+    from ..server.server import SimulatorServer
+
+    sim_cfg = SimulatorConfiguration(port=port if port is not None else 1212)
+    di = DIContainer(sim_cfg, start_scheduler=start_scheduler)
+
+    cfg = config or default_scheduler_config()
+    # register customs FIRST so they survive every restart/reset, then
+    # apply the user's config (including its extenders) through the normal
+    # restart path
+    di.scheduler_service.register_custom_plugins(with_plugins or [])
+    di.scheduler_service._initial = cfg
+    di.scheduler_service.restart_scheduler(cfg)
+    di.engine.plugin_extenders = list((with_plugin_extenders or {}).values())
+
+    server = SimulatorServer(di, port=port if port is not None else sim_cfg.port)
+    return di, server

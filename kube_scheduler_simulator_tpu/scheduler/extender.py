@@ -1,0 +1,180 @@
+"""Scheduler extender support: webhook client, recording proxy, config rewrite.
+
+Capability parity with the reference extender subsystem
+(reference: simulator/scheduler/extender/):
+
+  * Extender client (extender.go:86-199): HTTP POST of ExtenderArgs JSON to
+    the configured urlPrefix + verb, 5s default timeout, managedResources /
+    nodeCacheCapable handling reduced to the JSON contract; Prioritize
+    results are weight-scaled by the caller as upstream does.
+  * Service (service.go:28-85): one entry per config extender; each call
+    records (args, result) into the extender result store, then returns
+    the real extender's response verbatim.
+  * OverrideExtendersCfgToSimulator (service.go:88-109): rewrites each
+    extender's urlPrefix to
+    http://localhost:<port>/api/v1/extender/<verb>/<index> so scheduler
+    traffic routes through the simulator, is recorded, and is then
+    forwarded to the user's real extender.
+  * Result store (extender/resultstore/resultstore.go): per-verb
+    map[extenderHost] -> result JSON under the 4 annotation keys
+    extender-{filter,prioritize,preempt,bind}-result
+    (extender/annotation/annotation.go:3-12).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from urllib.parse import urlparse
+
+from ..store import annotations as ann
+
+DEFAULT_TIMEOUT_SECONDS = 5  # reference: extender.go:22-24
+
+
+class ExtenderClient:
+    """HTTP client for one configured extender."""
+
+    def __init__(self, config: dict):
+        self.config = config
+        self.url_prefix = (config.get("urlPrefix") or "").rstrip("/")
+        self.weight = int(config.get("weight") or 1)
+        from ..utils.duration import parse_duration_seconds
+
+        raw_timeout = config.get("httpTimeout")
+        self.timeout = (
+            parse_duration_seconds(raw_timeout) if raw_timeout else DEFAULT_TIMEOUT_SECONDS
+        )
+        self.filter_verb = config.get("filterVerb") or ""
+        self.prioritize_verb = config.get("prioritizeVerb") or ""
+        self.preempt_verb = config.get("preemptVerb") or ""
+        self.bind_verb = config.get("bindVerb") or ""
+        self.ignorable = bool(config.get("ignorable", False))
+
+    @property
+    def host(self) -> str:
+        return urlparse(self.url_prefix).netloc or self.url_prefix
+
+    def _send(self, verb: str, args: dict) -> dict:
+        url = f"{self.url_prefix}/{verb}"
+        req = urllib.request.Request(
+            url, data=json.dumps(args).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def filter(self, args: dict) -> dict:
+        return self._send(self.filter_verb, args)
+
+    def prioritize(self, args: dict) -> dict:
+        return self._send(self.prioritize_verb, args)
+
+    def preempt(self, args: dict) -> dict:
+        return self._send(self.preempt_verb, args)
+
+    def bind(self, args: dict) -> dict:
+        return self._send(self.bind_verb, args)
+
+
+class ExtenderResultStore:
+    """4 annotation blobs, per-verb map[extenderHost] -> result."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._results: dict[str, dict[str, dict]] = {}
+
+    def _entry(self, namespace: str, pod_name: str) -> dict:
+        k = f"{namespace}/{pod_name}"
+        if k not in self._results:
+            self._results[k] = {"filter": {}, "prioritize": {}, "preempt": {}, "bind": {}}
+        return self._results[k]
+
+    def _add(self, verb: str, args: dict, result, host: str):
+        pod = (args.get("Pod") or args.get("pod") or {})
+        meta = pod.get("metadata") or {}
+        with self._mu:
+            e = self._entry(meta.get("namespace") or "default", meta.get("name", ""))
+            e[verb][host] = result
+
+    def add_filter_result(self, args, result, host):
+        self._add("filter", args, result, host)
+
+    def add_prioritize_result(self, args, result, host):
+        self._add("prioritize", args, result, host)
+
+    def add_preempt_result(self, args, result, host):
+        self._add("preempt", args, result, host)
+
+    def add_bind_result(self, args, result, host):
+        # bind args carry PodNamespace/PodName directly
+        ns = args.get("PodNamespace") or args.get("podNamespace") or "default"
+        name = args.get("PodName") or args.get("podName") or ""
+        with self._mu:
+            self._entry(ns, name)["bind"][host] = result
+
+    def get_stored_result(self, pod: dict) -> dict[str, str] | None:
+        meta = pod.get("metadata") or {}
+        k = f"{meta.get('namespace') or 'default'}/{meta.get('name', '')}"
+        with self._mu:
+            e = self._results.get(k)
+            if e is None:
+                return None
+            return {
+                ann.EXTENDER_FILTER_RESULT: ann.marshal(e["filter"]),
+                ann.EXTENDER_PRIORITIZE_RESULT: ann.marshal(e["prioritize"]),
+                ann.EXTENDER_PREEMPT_RESULT: ann.marshal(e["preempt"]),
+                ann.EXTENDER_BIND_RESULT: ann.marshal(e["bind"]),
+            }
+
+    def delete_data(self, pod: dict) -> None:
+        meta = pod.get("metadata") or {}
+        with self._mu:
+            self._results.pop(f"{meta.get('namespace') or 'default'}/{meta.get('name', '')}", None)
+
+
+class ExtenderService:
+    """Recording proxy in front of the configured extenders
+    (reference: service.go:45-85)."""
+
+    def __init__(self, extender_configs: list[dict], result_store: ExtenderResultStore | None = None):
+        self.extenders = [ExtenderClient(c) for c in extender_configs or []]
+        self.result_store = result_store or ExtenderResultStore()
+
+    def handle(self, verb: str, idx: int, args: dict) -> dict:
+        if idx < 0 or idx >= len(self.extenders):
+            raise IndexError(f"extender {idx} not configured")
+        ext = self.extenders[idx]
+        if verb == "filter":
+            result = ext.filter(args)
+            self.result_store.add_filter_result(args, result, ext.host)
+        elif verb == "prioritize":
+            result = ext.prioritize(args)
+            self.result_store.add_prioritize_result(args, result, ext.host)
+        elif verb == "preempt":
+            result = ext.preempt(args)
+            self.result_store.add_preempt_result(args, result, ext.host)
+        elif verb == "bind":
+            result = ext.bind(args)
+            self.result_store.add_bind_result(args, result, ext.host)
+        else:
+            raise ValueError(f"unknown extender verb {verb}")
+        return result
+
+
+def override_extenders_cfg_to_simulator(cfg: dict, port: int) -> dict:
+    """Rewrite extender urlPrefixes to route through the simulator proxy
+    (reference: service.go:88-109)."""
+    import copy
+
+    cfg = copy.deepcopy(cfg or {})
+    for i, ext in enumerate(cfg.get("extenders") or []):
+        ext["urlPrefix"] = f"http://localhost:{port}/api/v1/extender"
+        for verb_field, verb in (
+            ("filterVerb", "filter"), ("prioritizeVerb", "prioritize"),
+            ("preemptVerb", "preempt"), ("bindVerb", "bind"),
+        ):
+            if ext.get(verb_field):
+                ext[verb_field] = f"{verb}/{i}"
+    return cfg

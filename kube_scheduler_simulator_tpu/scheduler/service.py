@@ -23,8 +23,20 @@ class SchedulerService:
         self.engine = engine
         self._initial = copy.deepcopy(initial_config) if initial_config else default_scheduler_config()
         self._current = copy.deepcopy(self._initial)
+        # out-of-tree plugins registered via the debuggable-scheduler API;
+        # they live in the process (like the reference's compiled-in
+        # WithPlugin factories) and survive every config restart/reset
+        self._custom_plugins: dict[str, object] = {}
         if engine is not None:
             engine.set_plugin_config(parse_plugin_set(self._current))
+            self._apply_extenders(self._current)
+
+    def register_custom_plugins(self, plugins: list) -> None:
+        """WithPlugin analogue: make plugins part of the registry for this
+        process, enabled by default, surviving restart/reset."""
+        for p in plugins:
+            self._custom_plugins[p.name] = p
+        self.restart_scheduler(self._current)
 
     def get_config(self) -> dict:
         return copy.deepcopy(self._current)
@@ -36,14 +48,33 @@ class SchedulerService:
             cfg = default_scheduler_config()
         old = self._current
         try:
-            plugin_set = parse_plugin_set(cfg)
+            plugin_set = self._with_customs(parse_plugin_set(cfg))
             if self.engine is not None:
                 self.engine.set_plugin_config(plugin_set)
+                self._apply_extenders(cfg)
             self._current = copy.deepcopy(cfg)
         except Exception:
             if self.engine is not None:
-                self.engine.set_plugin_config(parse_plugin_set(old))
+                self.engine.set_plugin_config(self._with_customs(parse_plugin_set(old)))
+                self._apply_extenders(old)
             raise
+
+    def _with_customs(self, plugin_set):
+        for name, p in self._custom_plugins.items():
+            plugin_set.custom[name] = p
+            if name not in plugin_set.enabled:
+                plugin_set.enabled.append(name)
+        return plugin_set
+
+    def _apply_extenders(self, cfg: dict) -> None:
+        from .extender import ExtenderService
+
+        extenders = (cfg or {}).get("extenders") or []
+        self.engine.set_extenders(ExtenderService(extenders) if extenders else None)
+
+    @property
+    def extender_service(self):
+        return self.engine.extender_service if self.engine else None
 
     def reset_scheduler(self) -> None:
         self.restart_scheduler(copy.deepcopy(self._initial))

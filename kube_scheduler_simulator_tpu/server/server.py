@@ -215,10 +215,13 @@ def _make_handler(di: DIContainer):
             if not m:
                 return self._json(404, {"message": "unknown extender route"})
             verb, idx = m.group(1), int(m.group(2))
-            svc = getattr(di, "extender_service", None)
+            svc = di.scheduler_service.extender_service
             if svc is None:
                 return self._json(400, {"message": "no extenders configured"})
-            result = svc.handle(verb, idx, self._body() or {})
+            try:
+                result = svc.handle(verb, idx, self._body() or {})
+            except IndexError as e:
+                return self._json(400, {"message": str(e)})
             return self._json(200, result)
 
         def _resource_crud(self, method: str, m, url):

@@ -127,6 +127,14 @@ def compile_workload(
         xs["PodTopologySpread"] = x
         counts = _prime_spread_counts(counts, st, pods, bound_pods, name_idx)
         init_carry["PodTopologySpread"] = counts
+    for name, plugin in config.custom.items():
+        if name not in enabled:
+            continue
+        from ..plugins.custom import build_custom
+
+        x, msg_table = build_custom(plugin, table, pods, nodes)
+        xs[name] = x
+        host.setdefault("custom_msgs", {})[name] = msg_table
     if "InterPodAffinity" in enabled:
         # Build the term table over queue + bound pods together so the bound
         # pods' terms (which matter for the symmetric existing-pod checks)

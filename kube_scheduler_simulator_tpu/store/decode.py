@@ -17,10 +17,29 @@ GetStoredResult -> 13 JSON blobs), from the ReplayResult tensors:
 
 from __future__ import annotations
 
+import os
+
+import numpy as np
+
 from . import annotations as ann
 from ..framework.replay import ReplayResult
 from ..plugins import affinity, interpod, noderesources, taints, topologyspread
 from ..plugins.registry import PLUGIN_REGISTRY
+
+
+def _native_ctx(cw):
+    """Per-workload native-codec context; None disables the fast path
+    (or set KSS_TPU_DISABLE_NATIVE=1 to force the Python encoder)."""
+    if os.environ.get("KSS_TPU_DISABLE_NATIVE") == "1":
+        return None
+    if "_native_ctx" not in cw.host:
+        from . import native_decode
+
+        try:
+            cw.host["_native_ctx"] = native_decode.build_context(cw)
+        except Exception:
+            cw.host["_native_ctx"] = None
+    return cw.host["_native_ctx"]
 
 _DECODERS = {
     "NodeResourcesFit": lambda code, node, aux: noderesources.decode_fit_filter(code, aux["schema"]),
@@ -34,11 +53,19 @@ _DECODERS = {
 
 
 def decode_filter_message(name: str, code: int, node_idx: int, host_aux) -> str:
-    return _DECODERS[name](code, node_idx, host_aux)
+    dec = _DECODERS.get(name)
+    if dec is None:  # custom plugin: interned message table
+        return host_aux["custom_msgs"][name][code - 1]
+    return dec(code, node_idx, host_aux)
 
 
-def decode_pod_result(rr: ReplayResult, i: int) -> dict[str, str]:
-    """The 13 plugin annotations for pod i, values JSON-encoded as Go would."""
+def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None) -> dict[str, str]:
+    """The 13 plugin annotations for pod i, values JSON-encoded as Go would.
+
+    feasible_override: [N] bool — the extender path narrows feasibility
+    after the plugin filters (upstream scores only nodes that survive the
+    extender Filter round-trip too); overrides the feasibility derived
+    from the plugin filter codes for the score maps."""
     cw = rr.cw
     cfg = cw.config
     names = cw.node_table.names
@@ -57,42 +84,66 @@ def decode_pod_result(rr: ReplayResult, i: int) -> dict[str, str]:
         (f, name) for f, name in enumerate(filter_names) if not fskip[name][i]
     ]
     codes = rr.filter_codes[i]  # [F, N]
-    filter_map: dict[str, dict[str, str]] = {}
-    for n, node in enumerate(names):
-        entry = {}
-        for f, name in active:
-            c = int(codes[f, n])
-            if c == 0:
-                entry[name] = ann.PASSED_FILTER_MESSAGE
-            else:
-                entry[name] = decode_filter_message(name, c, n, cw.host)
-                break
-        if entry:
-            filter_map[node] = entry
+
+    native_ctx = _native_ctx(cw)
+    filter_json: str | None = None
+    if native_ctx is not None:
+        from . import native_decode
+
+        active_mask = np.asarray([not fskip[name][i] for name in filter_names], np.uint8)
+        filter_json = native_decode.encode_filter(native_ctx, codes, active_mask)
+    else:
+        filter_map: dict[str, dict[str, str]] = {}
+        for n, node in enumerate(names):
+            entry = {}
+            for f, name in active:
+                c = int(codes[f, n])
+                if c == 0:
+                    entry[name] = ann.PASSED_FILTER_MESSAGE
+                else:
+                    entry[name] = decode_filter_message(name, c, n, cw.host)
+                    break
+            if entry:
+                filter_map[node] = entry
 
     # --- score (only when >1 feasible node) -----------------------------
     feasible_count = int(rr.feasible_count[i])
     prescore: dict[str, str] = {}
     score_map: dict[str, dict[str, str]] = {}
     final_map: dict[str, dict[str, str]] = {}
+    score_json: str | None = None
+    final_json: str | None = None
     if feasible_count > 1:
         for name in cfg.prescorers():
             prescore[name] = "" if sskip[name][i] else ann.SUCCESS_MESSAGE
         feasible = (codes[[f for f, _ in active], :] == 0).all(axis=0) if active else None
+        if feasible_override is not None:
+            feasible = feasible_override
         raw = rr.score_raw[i]
         fin = rr.score_final[i]
-        for n, node in enumerate(names):
-            if feasible is not None and not feasible[n]:
-                continue
-            se, fe = {}, {}
-            for s, name in enumerate(score_names):
-                if sskip[name][i]:
+        if native_ctx is not None:
+            from . import native_decode
+
+            sskip_mask = np.asarray([bool(sskip[name][i]) for name in score_names], np.uint8)
+            feas = (
+                np.ones(len(names), np.uint8) if feasible is None
+                else np.asarray(feasible, np.uint8)
+            )
+            score_json = native_decode.encode_scores(native_ctx, raw, sskip_mask, feas)
+            final_json = native_decode.encode_scores(native_ctx, fin, sskip_mask, feas)
+        else:
+            for n, node in enumerate(names):
+                if feasible is not None and not feasible[n]:
                     continue
-                se[name] = str(int(raw[s, n]))
-                fe[name] = str(int(fin[s, n]))
-            if se:
-                score_map[node] = se
-                final_map[node] = fe
+                se, fe = {}, {}
+                for s, name in enumerate(score_names):
+                    if sskip[name][i]:
+                        continue
+                    se[name] = str(int(raw[s, n]))
+                    fe[name] = str(int(fin[s, n]))
+                if se:
+                    score_map[node] = se
+                    final_map[node] = fe
 
     # --- bind phase -----------------------------------------------------
     sel = int(rr.selected[i])
@@ -102,11 +153,11 @@ def decode_pod_result(rr: ReplayResult, i: int) -> dict[str, str]:
     return {
         ann.PRE_FILTER_STATUS_RESULT: ann.marshal(prefilter_status),
         ann.PRE_FILTER_RESULT: ann.marshal({}),
-        ann.FILTER_RESULT: ann.marshal(filter_map),
+        ann.FILTER_RESULT: filter_json if filter_json is not None else ann.marshal(filter_map),
         ann.POST_FILTER_RESULT: ann.marshal({}),
         ann.PRE_SCORE_RESULT: ann.marshal(prescore),
-        ann.SCORE_RESULT: ann.marshal(score_map),
-        ann.FINAL_SCORE_RESULT: ann.marshal(final_map),
+        ann.SCORE_RESULT: score_json if score_json is not None else ann.marshal(score_map),
+        ann.FINAL_SCORE_RESULT: final_json if final_json is not None else ann.marshal(final_map),
         ann.RESERVE_RESULT: ann.marshal({}),
         ann.PERMIT_STATUS_RESULT: ann.marshal({}),
         ann.PERMIT_TIMEOUT_RESULT: ann.marshal({}),

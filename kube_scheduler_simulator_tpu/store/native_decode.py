@@ -1,0 +1,153 @@
+"""Native fast path for the annotation decoder.
+
+Builds per-workload context (name arrays, sorted orders, message LUTs) for
+native/annotation_codec.cpp and encodes the three heavy blobs
+(filter-result, score-result, finalscore-result) in C++.  Used by
+store/decode.py when the native codec is available; output is
+byte-identical to the Python path (asserted by tests/test_native_codec.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..native import get_lib, take_string
+from ..plugins import affinity, interpod, taints, topologyspread
+from ..plugins.noderesources import decode_fit_filter
+
+_MAX_FIT_LUT_BITS = 16
+
+
+def _c_str_array(strings: list[bytes]):
+    arr = (ctypes.c_char_p * len(strings))(*strings)
+    return arr
+
+
+def build_context(cw):
+    """-> context dict or None when a plugin's messages can't be LUT'd."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    table = cw.node_table
+    n = table.n
+    filter_names = cw.config.filters()
+    score_names = cw.config.scorers()
+
+    luts: list[list[bytes]] = []
+    per_node: list[int] = []
+    for name in filter_names:
+        if name == "NodeResourcesFit":
+            bits = cw.schema.n + 1
+            if bits > _MAX_FIT_LUT_BITS:
+                return None
+            lut = [
+                decode_fit_filter(code, cw.schema).encode()
+                for code in range(1, (1 << bits))
+            ]
+            per_node.append(0)
+        elif name == "NodeAffinity":
+            lut = [affinity.ERR_REASON.encode()]
+            per_node.append(0)
+        elif name == "NodeUnschedulable":
+            lut = [taints.ERR_UNSCHEDULABLE.encode()]
+            per_node.append(0)
+        elif name == "NodeName":
+            lut = [taints.ERR_NODE_NAME.encode()]
+            per_node.append(0)
+        elif name == "TaintToleration":
+            stride = max((len(t) for t in table.taints), default=0)
+            if stride == 0:
+                lut = [b""] * n  # never indexed (no taints -> no failures)
+                stride = 1
+            else:
+                lut = []
+                for j in range(n):
+                    for ti in range(stride):
+                        if ti < len(table.taints[j]):
+                            key, value, _ = table.taints[j][ti]
+                            lut.append(
+                                ("node(s) had untolerated taint {%s: %s}" % (key, value)).encode()
+                            )
+                        else:
+                            lut.append(b"")
+            per_node.append(1)
+        elif name == "PodTopologySpread":
+            lut = []
+            for code in range(1, 2 * topologyspread.MAX_CONSTRAINTS + 1):
+                lut.append(
+                    (topologyspread.ERR_MISSING_LABEL if code % 2 == 1
+                     else topologyspread.ERR_SKEW).encode()
+                )
+            per_node.append(0)
+        elif name == "InterPodAffinity":
+            lut = [interpod.ERR_AFFINITY.encode(), interpod.ERR_ANTI_AFFINITY.encode(),
+                   interpod.ERR_EXISTING_ANTI.encode()]
+            per_node.append(0)
+        elif name in cw.host.get("custom_msgs", {}):
+            lut = [m.encode() for m in cw.host["custom_msgs"][name]] or [b""]
+            per_node.append(0)
+        else:
+            return None
+        luts.append(lut)
+
+    lut_flat: list[bytes] = []
+    lut_off = [0]
+    for lut in luts:
+        lut_flat.extend(lut)
+        lut_off.append(len(lut_flat))
+
+    names_sorted = np.argsort(np.asarray(table.names)).astype(np.int32)
+    ctx = {
+        "lib": lib,
+        "n": n,
+        "node_names": _c_str_array([nm.encode() for nm in table.names]),
+        "filter_names": _c_str_array([nm.encode() for nm in filter_names]),
+        "score_names": _c_str_array([nm.encode() for nm in score_names]),
+        "sorted_nodes": np.ascontiguousarray(names_sorted),
+        "sorted_filters": np.argsort(np.asarray(filter_names)).astype(np.int32)
+        if filter_names else np.zeros(0, np.int32),
+        "sorted_scores": np.argsort(np.asarray(score_names)).astype(np.int32)
+        if score_names else np.zeros(0, np.int32),
+        "lut_flat": _c_str_array(lut_flat or [b""]),
+        "lut_off": np.asarray(lut_off, dtype=np.int32),
+        "per_node": np.asarray(per_node, dtype=np.uint8),
+    }
+    return ctx
+
+
+def _i32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u8p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def encode_filter(ctx, codes: np.ndarray, active: np.ndarray) -> str:
+    lib = ctx["lib"]
+    codes = np.ascontiguousarray(codes, dtype=np.int32)
+    active = np.ascontiguousarray(active, dtype=np.uint8)
+    ptr = lib.encode_filter_result(
+        ctx["n"], codes.shape[0],
+        _i32p(codes), _u8p(active),
+        ctx["node_names"], ctx["filter_names"],
+        _i32p(ctx["sorted_nodes"]), _i32p(ctx["sorted_filters"]),
+        ctx["lut_flat"], _i32p(ctx["lut_off"]), _u8p(ctx["per_node"]),
+    )
+    return take_string(lib, ptr)
+
+
+def encode_scores(ctx, values: np.ndarray, sskip: np.ndarray, feasible: np.ndarray) -> str:
+    lib = ctx["lib"]
+    values = np.ascontiguousarray(values, dtype=np.int32)
+    sskip = np.ascontiguousarray(sskip, dtype=np.uint8)
+    feasible = np.ascontiguousarray(feasible, dtype=np.uint8)
+    ptr = lib.encode_score_result(
+        ctx["n"], values.shape[0],
+        _i32p(values), _u8p(sskip), _u8p(feasible),
+        ctx["node_names"], ctx["score_names"],
+        _i32p(ctx["sorted_nodes"]), _i32p(ctx["sorted_scores"]),
+    )
+    return take_string(lib, ptr)

@@ -1,0 +1,126 @@
+"""Extender round-trip tests: a real HTTP extender server, the recording
+proxy, the phased engine path, and the 4 extender annotations.
+
+Mirrors the reference extender flow (SURVEY.md §3.3): scheduler -> proxy
+-> real extender -> record -> respond.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+from kube_scheduler_simulator_tpu.scheduler.extender import (
+    ExtenderService,
+    override_extenders_cfg_to_simulator,
+)
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+
+class FakeExtender(BaseHTTPRequestHandler):
+    """A user extender that vetoes node index 0 and boosts the last node."""
+
+    calls: list[tuple[str, dict]] = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        FakeExtender.calls.append((self.path, body))
+        names = body.get("NodeNames") or []
+        if self.path.endswith("/filter"):
+            kept = [n for n in names if not n.endswith("00000")]
+            resp = {"NodeNames": kept, "FailedNodes": {n: "vetoed by extender"
+                                                       for n in names if n.endswith("00000")}}
+        elif self.path.endswith("/prioritize"):
+            resp = [{"Host": n, "Score": 10 if n == names[-1] else 0} for n in names]
+        elif self.path.endswith("/bind"):
+            resp = {}
+        else:
+            resp = {}
+        data = json.dumps(resp).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+@pytest.fixture()
+def fake_extender():
+    FakeExtender.calls = []
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeExtender)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def extender_cfg(url):
+    return {"urlPrefix": url, "filterVerb": "filter", "prioritizeVerb": "prioritize",
+            "weight": 2}
+
+
+def test_override_cfg_rewrites_urls():
+    cfg = {"extenders": [extender_cfg("http://real-extender:8080/api")]}
+    out = override_extenders_cfg_to_simulator(cfg, 1212)
+    e = out["extenders"][0]
+    assert e["urlPrefix"] == "http://localhost:1212/api/v1/extender"
+    assert e["filterVerb"] == "filter/0"
+    assert e["prioritizeVerb"] == "prioritize/0"
+
+
+def test_extender_proxy_records(fake_extender):
+    svc = ExtenderService([extender_cfg(fake_extender)])
+    pod = {"metadata": {"name": "p", "namespace": "default"}}
+    result = svc.handle("filter", 0, {"Pod": pod, "NodeNames": ["node-00000", "node-00001"]})
+    assert result["NodeNames"] == ["node-00001"]
+    stored = svc.result_store.get_stored_result(pod)
+    blob = json.loads(stored[ann.EXTENDER_FILTER_RESULT])
+    host = list(blob)[0]
+    assert blob[host]["FailedNodes"]["node-00000"] == "vetoed by extender"
+
+
+def test_engine_phased_path_with_extender(fake_extender):
+    store = ObjectStore()
+    for n in make_nodes(3, seed=9):
+        store.create("nodes", n)
+    for p in make_pods(2, seed=10):
+        store.create("pods", p)
+    engine = SchedulerEngine(store)
+    svc = SchedulerService(engine)
+    cfg = svc.get_config()
+    cfg["extenders"] = [extender_cfg(fake_extender)]
+    svc.restart_scheduler(cfg)
+
+    bound = engine.schedule_pending()
+    assert bound == 2
+    p = store.get("pods", "pod-00000")
+    # extender vetoed node-00000 -> never selected
+    assert p["spec"]["nodeName"] != "node-00000"
+    annos = p["metadata"]["annotations"]
+    ext_filter = json.loads(annos[ann.EXTENDER_FILTER_RESULT])
+    assert any("vetoed by extender" in json.dumps(v) for v in ext_filter.values())
+    assert ann.EXTENDER_PRIORITIZE_RESULT in annos
+    # plugin annotations still present alongside extender ones
+    assert ann.FILTER_RESULT in annos
+    # score maps cover only post-extender feasible nodes
+    fs = json.loads(annos[ann.FINAL_SCORE_RESULT])
+    assert "node-00000" not in fs
+
+
+def test_ignorable_extender_failure():
+    svc = ExtenderService([
+        {"urlPrefix": "http://127.0.0.1:1", "filterVerb": "filter", "ignorable": True}
+    ])
+    store = ObjectStore()
+    for n in make_nodes(2, seed=11):
+        store.create("nodes", n)
+    store.create("pods", make_pods(1, seed=12)[0])
+    engine = SchedulerEngine(store)
+    engine.set_extenders(svc)
+    assert engine.schedule_pending() == 1  # unreachable but ignorable

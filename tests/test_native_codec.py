@@ -1,0 +1,54 @@
+"""Native C++ annotation codec vs pure-Python encoder: byte identity."""
+
+import os
+
+import pytest
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.models.workloads import baseline_config
+from kube_scheduler_simulator_tpu.native import get_lib
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+pytestmark = pytest.mark.skipif(get_lib() is None, reason="no native toolchain")
+
+
+@pytest.mark.parametrize("idx,scale", [(3, 0.02), (5, 0.01)])
+def test_native_matches_python(idx, scale, monkeypatch):
+    nodes, pods, cfg = baseline_config(idx, scale=scale, seed=42)
+    cw = compile_workload(nodes, pods, cfg)
+    rr = replay(cw, chunk=64)
+
+    native = [decode_pod_result(rr, i) for i in range(len(pods))]
+
+    monkeypatch.setenv("KSS_TPU_DISABLE_NATIVE", "1")
+    pure = [decode_pod_result(rr, i) for i in range(len(pods))]
+
+    for i, (na, pa) in enumerate(zip(native, pure)):
+        for k in pa:
+            assert na[k] == pa[k], f"pod {i} key {k}\n native={na[k][:300]}\n python={pa[k][:300]}"
+
+
+def test_native_escaping():
+    """Message content with JSON-special and HTML-escaped characters."""
+    nodes = [
+        {"metadata": {"name": 'n"0'},
+         "spec": {"taints": [{"key": 'a<b&"c', "value": "x\\y", "effect": "NoSchedule"}]},
+         "status": {"allocatable": {"cpu": "2", "memory": "2Gi", "pods": "10"}}},
+        {"metadata": {"name": "n1"},
+         "status": {"allocatable": {"cpu": "2", "memory": "2Gi", "pods": "10"}}},
+    ]
+    pods = [{"metadata": {"name": "p", "namespace": "default"},
+             "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}]}}]
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    cfg = PluginSetConfig(enabled=["TaintToleration", "NodeResourcesFit"])
+    cw = compile_workload(nodes, pods, cfg)
+    rr = replay(cw)
+    native = decode_pod_result(rr, 0)
+    os.environ["KSS_TPU_DISABLE_NATIVE"] = "1"
+    try:
+        pure = decode_pod_result(rr, 0)
+    finally:
+        del os.environ["KSS_TPU_DISABLE_NATIVE"]
+    assert native == pure
