@@ -75,10 +75,21 @@ class SchedulerEngine:
         return pending
 
     def schedule_pending(self, collect: bool = True) -> int:
-        """One scheduling wave over all pending pods. Returns #bound."""
+        """One scheduling wave over all pending pods (plus retry waves for
+        pods unblocked by preemption). Returns #bound."""
+        n_bound = 0
+        for _ in range(8):  # preemption retry bound; one wave normally
+            bound, preempted = self._schedule_wave(collect)
+            n_bound += bound
+            if not preempted:
+                break
+        return n_bound
+
+    def _schedule_wave(self, collect: bool = True) -> tuple[int, bool]:
+        """One scheduling wave. Returns (#bound, any preemption happened)."""
         pending = self.pending_pods()
         if not pending:
-            return 0
+            return 0, False
         nodes, _ = self.store.list("nodes")
         pods_all, _ = self.store.list("pods")
         bound = [
@@ -87,10 +98,13 @@ class SchedulerEngine:
         ]
         cw = compile_workload(nodes, pending, self.plugin_config, bound_pods=bound)
         if self.extender_service is not None and self.extender_service.extenders:
-            return self._schedule_with_extenders(cw, pending)
+            return self._schedule_with_extenders(cw, pending), False
+
         rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)))
+        postfilter_on = bool(self.plugin_config.postfilters())
 
         n_bound = 0
+        any_preempted = False
         for i, pod in enumerate(pending):
             meta = pod.get("metadata") or {}
             ns, name = meta.get("namespace") or "default", meta.get("name", "")
@@ -103,9 +117,51 @@ class SchedulerEngine:
                 self._bind(ns, name, cw.node_table.names[sel])
                 n_bound += 1
             else:
+                if postfilter_on:
+                    any_preempted |= self._run_postfilter(cw, rr, i, pod, ns, name)
                 self._mark_unschedulable(ns, name)
             self.reflector.reflect(ns, name)
-        return n_bound
+        return n_bound, any_preempted
+
+    def _run_postfilter(self, cw, rr, i, pod, ns: str, name: str) -> bool:
+        """Run DefaultPreemption for an unschedulable pod; record the
+        postfilter-result; execute victims + nomination. True if a node
+        was nominated (the caller then runs a retry wave)."""
+        from .preemption import PLUGIN_NAME, Preemptor, first_fail_plugins
+
+        fskip = cw.host["filter_skip"]
+        filters = cw.config.filters()
+        active_idx = [f for f, n in enumerate(filters) if not fskip[n][i]]
+        active_names = [filters[f] for f in active_idx]
+        firsts = first_fail_plugins(rr.filter_codes[i][active_idx], active_names)
+        failed = [
+            (node, firsts[j]) for j, node in enumerate(cw.node_table.names)
+            if firsts[j] is not None
+        ]
+        outcome = Preemptor(self.store, self.plugin_config).preempt(pod, failed)
+        self.result_store.add_post_filter_result(
+            ns, name, outcome.nominated_node, PLUGIN_NAME, outcome.evaluated_nodes
+        )
+        if not outcome.nominated_node:
+            return False
+        for v in outcome.victims:
+            vm = v.get("metadata") or {}
+            try:
+                self.store.delete("pods", vm.get("name", ""), vm.get("namespace") or "default")
+            except NotFound:
+                pass
+        for _ in range(5):
+            try:
+                cur = self.store.get("pods", name, ns)
+            except NotFound:
+                break
+            cur.setdefault("status", {})["nominatedNodeName"] = outcome.nominated_node
+            try:
+                self.store.update("pods", cur)
+                break
+            except Conflict:
+                time.sleep(0.001)
+        return True
 
     def _schedule_with_extenders(self, cw, pending) -> int:
         """Phased path: device eval -> extender Filter/Prioritize over HTTP

@@ -21,6 +21,7 @@ class PluginDesc:
     name: str
     has_prefilter: bool = False
     has_filter: bool = False
+    has_postfilter: bool = False
     has_prescore: bool = False
     has_score: bool = False
     has_normalize: bool = False  # ScoreExtensions != nil
@@ -42,6 +43,7 @@ PLUGIN_REGISTRY: dict[str, PluginDesc] = {
                    has_score=True, has_normalize=True, default_weight=2),
         PluginDesc("InterPodAffinity", has_prefilter=True, has_filter=True, has_prescore=True,
                    has_score=True, has_normalize=True, default_weight=2),
+        PluginDesc("DefaultPreemption", has_postfilter=True),
         PluginDesc("NodeResourcesBalancedAllocation", has_prescore=True, has_score=True,
                    default_weight=1),
     ]
@@ -56,6 +58,7 @@ DEFAULT_ORDER = [
     "NodeResourcesFit",
     "PodTopologySpread",
     "InterPodAffinity",
+    "DefaultPreemption",
     "NodeResourcesBalancedAllocation",
 ]
 
@@ -100,6 +103,12 @@ class PluginSetConfig:
 
     def filters(self) -> list[str]:
         return [n for n in self.enabled if self._desc(n).has_filter]
+
+    def postfilters(self) -> list[str]:
+        return [
+            n for n in self.enabled
+            if not self.is_custom(n) and PLUGIN_REGISTRY[n].has_postfilter
+        ]
 
     def scorers(self) -> list[str]:
         return [n for n in self.enabled if self._desc(n).has_score]

@@ -1,0 +1,167 @@
+"""DefaultPreemption (PostFilter) tests.
+
+Modeled on upstream defaultpreemption table tests as recorded by the
+reference (reference: simulator/scheduler/plugin/wrappedplugin.go:550-583
+PostFilter recording; resultstore/store.go:439-458 annotation shape).
+"""
+
+import json
+
+from kube_scheduler_simulator_tpu.cluster.store import NotFound, ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+
+def node(name, cpu="1", mem="1Gi", taints=None):
+    n = {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "spec": {},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": mem, "pods": "110"},
+            "capacity": {"cpu": cpu, "memory": mem, "pods": "110"},
+        },
+    }
+    if taints:
+        n["spec"]["taints"] = taints
+    return n
+
+
+def pod(name, cpu="100m", priority=0, node_name=None, policy=None, created=None):
+    p = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "priority": priority,
+            "containers": [{"name": "c", "resources": {"requests": {"cpu": cpu}}}],
+        },
+        "status": {},
+    }
+    if node_name:
+        p["spec"]["nodeName"] = node_name
+        p["status"]["phase"] = "Running"
+    if policy:
+        p["spec"]["preemptionPolicy"] = policy
+    if created:
+        p["metadata"]["creationTimestamp"] = created
+    return p
+
+
+def first_history_entry(store, name):
+    p = store.get("pods", name)
+    return json.loads(p["metadata"]["annotations"][ann.RESULT_HISTORY])[0]
+
+
+def test_preempts_lower_priority_victim():
+    s = ObjectStore()
+    s.create("nodes", node("n1", cpu="1"))
+    s.create("pods", pod("victim", cpu="800m", priority=0, node_name="n1"))
+    s.create("pods", pod("pri", cpu="500m", priority=10))
+    engine = SchedulerEngine(s)
+    assert engine.schedule_pending() == 1
+
+    # victim evicted, preemptor bound to the freed node
+    try:
+        s.get("pods", "victim")
+        assert False, "victim should be deleted"
+    except NotFound:
+        pass
+    p = s.get("pods", "pri")
+    assert p["spec"]["nodeName"] == "n1"
+
+    # first cycle's postfilter-result records the nominated node
+    h0 = first_history_entry(s, "pri")
+    pf = json.loads(h0[ann.POST_FILTER_RESULT])
+    assert pf == {"n1": {"DefaultPreemption": "preemption victim"}}
+
+
+def test_no_preemption_when_policy_never():
+    s = ObjectStore()
+    s.create("nodes", node("n1", cpu="1"))
+    s.create("pods", pod("victim", cpu="800m", priority=0, node_name="n1"))
+    s.create("pods", pod("pri", cpu="500m", priority=10, policy="Never"))
+    engine = SchedulerEngine(s)
+    assert engine.schedule_pending() == 0
+    assert s.get("pods", "victim")  # untouched
+    pf = json.loads(
+        s.get("pods", "pri")["metadata"]["annotations"][ann.POST_FILTER_RESULT]
+    )
+    assert pf == {"n1": {}}  # evaluated but nothing nominated
+
+
+def test_no_preemption_for_equal_priority():
+    s = ObjectStore()
+    s.create("nodes", node("n1", cpu="1"))
+    s.create("pods", pod("victim", cpu="800m", priority=10, node_name="n1"))
+    s.create("pods", pod("pri", cpu="500m", priority=10))
+    engine = SchedulerEngine(s)
+    assert engine.schedule_pending() == 0
+    assert s.get("pods", "victim")
+
+
+def test_unresolvable_failure_not_a_candidate():
+    # node rejected by taint (UnschedulableAndUnresolvable upstream):
+    # deleting pods can't help, so no preemption even though a lower-
+    # priority pod is present
+    s = ObjectStore()
+    s.create("nodes", node("n1", cpu="1", taints=[
+        {"key": "k", "value": "v", "effect": "NoSchedule"},
+    ]))
+    s.create("pods", pod("victim", cpu="100m", priority=0, node_name="n1"))
+    s.create("pods", pod("pri", cpu="500m", priority=10))
+    engine = SchedulerEngine(s)
+    assert engine.schedule_pending() == 0
+    assert s.get("pods", "victim")
+    pf = json.loads(
+        s.get("pods", "pri")["metadata"]["annotations"][ann.POST_FILTER_RESULT]
+    )
+    assert pf == {"n1": {}}
+
+
+def test_reprieve_keeps_higher_priority_victim():
+    # removing only the prio-1 pod suffices; the prio-2 pod is reprieved
+    s = ObjectStore()
+    s.create("nodes", node("n1", cpu="1"))
+    s.create("pods", pod("v-lo", cpu="400m", priority=1, node_name="n1"))
+    s.create("pods", pod("v-hi", cpu="400m", priority=2, node_name="n1"))
+    s.create("pods", pod("pri", cpu="500m", priority=10))
+    engine = SchedulerEngine(s)
+    assert engine.schedule_pending() == 1
+    assert s.get("pods", "v-hi")  # reprieved
+    try:
+        s.get("pods", "v-lo")
+        assert False, "lower-priority victim should be evicted"
+    except NotFound:
+        pass
+    assert s.get("pods", "pri")["spec"]["nodeName"] == "n1"
+
+
+def test_candidate_selection_prefers_lower_victim_priority():
+    s = ObjectStore()
+    s.create("nodes", node("a", cpu="1"))
+    s.create("nodes", node("b", cpu="1"))
+    s.create("pods", pod("victim-hi", cpu="800m", priority=5, node_name="a"))
+    s.create("pods", pod("victim-lo", cpu="800m", priority=1, node_name="b"))
+    s.create("pods", pod("pri", cpu="500m", priority=10))
+    engine = SchedulerEngine(s)
+    assert engine.schedule_pending() == 1
+    assert s.get("pods", "pri")["spec"]["nodeName"] == "b"
+    assert s.get("pods", "victim-hi")  # untouched
+    try:
+        s.get("pods", "victim-lo")
+        assert False
+    except NotFound:
+        pass
+
+
+def test_nominated_node_recorded_on_status():
+    s = ObjectStore()
+    s.create("nodes", node("n1", cpu="1"))
+    s.create("pods", pod("victim", cpu="800m", priority=0, node_name="n1"))
+    s.create("pods", pod("pri", cpu="500m", priority=10))
+    engine = SchedulerEngine(s)
+    engine.schedule_pending()
+    # by the end the pod is bound; nominatedNodeName was set in between and
+    # survives on status
+    p = s.get("pods", "pri")
+    assert p["status"].get("nominatedNodeName") == "n1"
