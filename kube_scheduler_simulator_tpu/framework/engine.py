@@ -74,18 +74,18 @@ class SchedulerEngine:
         )
         return pending
 
-    def schedule_pending(self, collect: bool = True) -> int:
+    def schedule_pending(self) -> int:
         """One scheduling wave over all pending pods (plus retry waves for
         pods unblocked by preemption). Returns #bound."""
         n_bound = 0
         for _ in range(8):  # preemption retry bound; one wave normally
-            bound, preempted = self._schedule_wave(collect)
+            bound, preempted = self._schedule_wave()
             n_bound += bound
             if not preempted:
                 break
         return n_bound
 
-    def _schedule_wave(self, collect: bool = True) -> tuple[int, bool]:
+    def _schedule_wave(self) -> tuple[int, bool]:
         """One scheduling wave. Returns (#bound, any preemption happened)."""
         pending = self.pending_pods()
         if not pending:
@@ -98,7 +98,7 @@ class SchedulerEngine:
         ]
         cw = compile_workload(nodes, pending, self.plugin_config, bound_pods=bound)
         if self.extender_service is not None and self.extender_service.extenders:
-            return self._schedule_with_extenders(cw, pending), False
+            return self._schedule_with_extenders(cw, pending)
 
         rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)))
         postfilter_on = bool(self.plugin_config.postfilters())
@@ -118,22 +118,26 @@ class SchedulerEngine:
                 n_bound += 1
             else:
                 if postfilter_on:
-                    any_preempted |= self._run_postfilter(cw, rr, i, pod, ns, name)
+                    any_preempted |= self._run_postfilter(
+                        cw, rr.filter_codes[i], i, pod, ns, name
+                    )
                 self._mark_unschedulable(ns, name)
             self.reflector.reflect(ns, name)
         return n_bound, any_preempted
 
-    def _run_postfilter(self, cw, rr, i, pod, ns: str, name: str) -> bool:
+    def _run_postfilter(self, cw, filter_codes, pod_idx, pod, ns: str, name: str) -> bool:
         """Run DefaultPreemption for an unschedulable pod; record the
         postfilter-result; execute victims + nomination. True if a node
-        was nominated (the caller then runs a retry wave)."""
+        was nominated (the caller then runs a retry wave).
+
+        filter_codes: [F, N] this pod's codes over cw.config.filters()."""
         from .preemption import PLUGIN_NAME, Preemptor, first_fail_plugins
 
         fskip = cw.host["filter_skip"]
         filters = cw.config.filters()
-        active_idx = [f for f, n in enumerate(filters) if not fskip[n][i]]
+        active_idx = [f for f, n in enumerate(filters) if not fskip[n][pod_idx]]
         active_names = [filters[f] for f in active_idx]
-        firsts = first_fail_plugins(rr.filter_codes[i][active_idx], active_names)
+        firsts = first_fail_plugins(filter_codes[active_idx], active_names)
         failed = [
             (node, firsts[j]) for j, node in enumerate(cw.node_table.names)
             if firsts[j] is not None
@@ -150,20 +154,14 @@ class SchedulerEngine:
                 self.store.delete("pods", vm.get("name", ""), vm.get("namespace") or "default")
             except NotFound:
                 pass
-        for _ in range(5):
-            try:
-                cur = self.store.get("pods", name, ns)
-            except NotFound:
-                break
+
+        def nominate(cur: dict) -> None:
             cur.setdefault("status", {})["nominatedNodeName"] = outcome.nominated_node
-            try:
-                self.store.update("pods", cur)
-                break
-            except Conflict:
-                time.sleep(0.001)
+
+        self._update_pod(ns, name, nominate)
         return True
 
-    def _schedule_with_extenders(self, cw, pending) -> int:
+    def _schedule_with_extenders(self, cw, pending) -> tuple[int, bool]:
         """Phased path: device eval -> extender Filter/Prioritize over HTTP
         -> host selection -> device bind (the reference's extender
         round-trip, SURVEY.md §3.3, spliced into the tensor pipeline)."""
@@ -177,7 +175,9 @@ class SchedulerEngine:
         carry = jax.tree.map(lambda a: a, cw.init_carry)
         names = cw.node_table.names
         name_to_idx = {nm: j for j, nm in enumerate(names)}
+        postfilter_on = bool(cw.config.postfilters())
         n_bound = 0
+        any_preempted = False
 
         for i, pod in enumerate(pending):
             sl = jax.tree.map(lambda a: a[i] if hasattr(a, "ndim") and a.ndim else a, cw.xs)
@@ -285,36 +285,47 @@ class SchedulerEngine:
                 self._bind(ns, name, names[sel])
                 n_bound += 1
             else:
+                # FitError (no feasible node) runs PostFilter, like the
+                # plain path; an extender/bind failure does not (upstream
+                # only preempts on FitError).  Candidate nodes are those
+                # that failed the PLUGIN filters — extender-rejected nodes
+                # are not preemption candidates (docs/SEMANTICS.md).
+                if postfilter_on and sel < 0 and not ext_error:
+                    any_preempted |= self._run_postfilter(cw, codes, i, pod, ns, name)
                 self._mark_unschedulable(ns, name)
             self.reflector.reflect(ns, name)
-        return n_bound
+        return n_bound, any_preempted
 
     # ------------------------------------------------------------ writes
 
-    def _bind(self, ns: str, name: str, node_name: str) -> None:
+    def _update_pod(self, ns: str, name: str, mutate) -> None:
+        """Re-fetch + mutate + update with conflict retry (the engine-side
+        analogue of the reflector's conflict-retry write)."""
         for _ in range(5):
             try:
                 pod = self.store.get("pods", name, ns)
             except NotFound:
                 return
-            pod.setdefault("spec", {})["nodeName"] = node_name
-            status = pod.setdefault("status", {})
-            status["phase"] = "Running"  # KWOK-style: no kubelet, fake-run
-            conds = [c for c in status.get("conditions") or [] if c.get("type") != "PodScheduled"]
-            conds.append({"type": "PodScheduled", "status": "True"})
-            status["conditions"] = conds
+            mutate(pod)
             try:
                 self.store.update("pods", pod)
                 return
             except Conflict:
                 time.sleep(0.001)
 
+    def _bind(self, ns: str, name: str, node_name: str) -> None:
+        def mutate(pod: dict) -> None:
+            pod.setdefault("spec", {})["nodeName"] = node_name
+            status = pod.setdefault("status", {})
+            status["phase"] = "Running"  # KWOK-style: no kubelet, fake-run
+            conds = [c for c in status.get("conditions") or [] if c.get("type") != "PodScheduled"]
+            conds.append({"type": "PodScheduled", "status": "True"})
+            status["conditions"] = conds
+
+        self._update_pod(ns, name, mutate)
+
     def _mark_unschedulable(self, ns: str, name: str) -> None:
-        for _ in range(5):
-            try:
-                pod = self.store.get("pods", name, ns)
-            except NotFound:
-                return
+        def mutate(pod: dict) -> None:
             status = pod.setdefault("status", {})
             status["phase"] = "Pending"
             conds = [c for c in status.get("conditions") or [] if c.get("type") != "PodScheduled"]
@@ -324,8 +335,5 @@ class SchedulerEngine:
                 "message": "0/%d nodes are available" % len(self.store.list("nodes")[0]),
             })
             status["conditions"] = conds
-            try:
-                self.store.update("pods", pod)
-                return
-            except Conflict:
-                time.sleep(0.001)
+
+        self._update_pod(ns, name, mutate)

@@ -157,8 +157,11 @@ def _bind_phase(cw: CompiledWorkload, carry, sl, selected):
     return new_carry
 
 
-def build_step(cw: CompiledWorkload):
-    """Returns step(carry_dict, xs_slice_dict) -> (carry', StepOut)."""
+def build_step(cw):
+    """Returns step(carry_dict, xs_slice_dict) -> (carry', StepOut).
+
+    cw: CompiledWorkload or any object with .config/.statics/.n_nodes
+    (replay passes a slim view so cached jits don't pin per-pod data)."""
     cfg = cw.config
     filter_names = cfg.filters()
     score_names = cfg.scorers()

@@ -89,7 +89,8 @@ def first_fail_plugins(codes: np.ndarray, active_names: list[str]) -> list[str |
     """Per node, the first filter plugin (upstream order) that rejected it,
     or None if the node passed.  codes: [F, N] over the ACTIVE filters."""
     out: list[str | None] = []
-    for n in range(codes.shape[1] if codes.size else 0):
+    n_nodes = codes.shape[1] if codes.ndim == 2 else 0
+    for n in range(n_nodes):
         hit = None
         for f, name in enumerate(active_names):
             if codes[f, n] != 0:
@@ -106,12 +107,18 @@ class Preemptor:
         self.store = store
         self.plugin_config = plugin_config
         self._fit_cache: dict = {}
+        self._nodes: list[dict] | None = None   # store snapshot, per preempt()
+        self._pods_all: list[dict] | None = None
 
     # ------------------------------------------------------------ oracle
 
     def _fits(self, pod: dict, node_name: str, removed: frozenset[str]) -> bool:
         """Would `pod` pass all Filter plugins on `node_name` with the pods
-        in `removed` (set of ns/name keys) deleted from the cluster?"""
+        in `removed` (set of ns/name keys) deleted from the cluster?
+
+        Each hypothesis recompiles workload tensors (cheap numpy) but the
+        jitted scan is shared via replay's content-keyed cache, so only the
+        first hypothesis of a given shape pays an XLA compile."""
         cache_key = (node_name, removed)
         hit = self._fit_cache.get(cache_key)
         if hit is not None:
@@ -120,10 +127,9 @@ class Preemptor:
         from .replay import replay
         from ..state.compile import compile_workload
 
-        nodes, _ = self.store.list("nodes")
-        pods_all, _ = self.store.list("pods")
+        nodes = self._nodes
         bound = [
-            (p, p["spec"]["nodeName"]) for p in pods_all
+            (p, p["spec"]["nodeName"]) for p in self._pods_all
             if (p.get("spec") or {}).get("nodeName") and _pod_key(p) not in removed
         ]
         cw = compile_workload(nodes, [pod], self.plugin_config, bound_pods=bound)
@@ -146,6 +152,8 @@ class Preemptor:
         """failed: (node name, first failing plugin or None) for every node
         evaluated in the failed scheduling cycle."""
         self._fit_cache.clear()
+        self._nodes, _ = self.store.list("nodes")
+        self._pods_all, _ = self.store.list("pods")
         evaluated = [n for n, _ in failed]
         out = PreemptionOutcome(evaluated_nodes=evaluated)
 
@@ -160,9 +168,8 @@ class Preemptor:
         if not potential:
             return out
 
-        pods_all, _ = self.store.list("pods")
         by_node: dict[str, list[dict]] = {}
-        for p in pods_all:
+        for p in self._pods_all:
             nn = (p.get("spec") or {}).get("nodeName")
             if nn:
                 by_node.setdefault(nn, []).append(p)
@@ -208,17 +215,13 @@ class Preemptor:
 
         def rank(c: tuple[str, list[dict]]):
             _, victims = c
-            if not victims:
-                return (-(10**9), 0, 0, "")
+            if not victims:  # leading 0: no-victim candidates always win
+                return (0, 0, 0, 0, _InvStr(""))
             prios = [_priority(v) for v in victims]
             top = max(prios)
-            # latest creation of a highest-priority victim, preferred →
-            # sort ascending by its negation via reversed string compare:
-            # use creation DESC by sorting on the complement is messy;
-            # rank uses tuple where smaller wins, so invert by sorting key
-            # with reversed ordering handled in _latest_creation_rank.
+            # later creation must rank first; _InvStr inverts string order
             latest = max(_creation(v) for v in victims if _priority(v) == top)
-            return (top, sum(prios), len(victims), _InvStr(latest))
+            return (1, top, sum(prios), len(victims), _InvStr(latest))
 
         best = min(range(len(candidates)), key=lambda i: (rank(candidates[i]), i))
         return candidates[best]

@@ -165,3 +165,43 @@ def test_nominated_node_recorded_on_status():
     # survives on status
     p = s.get("pods", "pri")
     assert p["status"].get("nominatedNodeName") == "n1"
+
+
+def test_preemption_runs_in_extender_path():
+    # an extender is configured but the failure is a plugin FitError —
+    # preemption must still run (upstream runs PostFilter on any FitError)
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderService
+
+    class PassThrough(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+            resp = {"NodeNames": body.get("NodeNames") or []}
+            data = json.dumps(resp).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), PassThrough)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        s = ObjectStore()
+        s.create("nodes", node("n1", cpu="1"))
+        s.create("pods", pod("victim", cpu="800m", priority=0, node_name="n1"))
+        s.create("pods", pod("pri", cpu="500m", priority=10))
+        engine = SchedulerEngine(s)
+        engine.set_extenders(ExtenderService([{"urlPrefix": url, "filterVerb": "filter"}]))
+        assert engine.schedule_pending() == 1
+        assert s.get("pods", "pri")["spec"]["nodeName"] == "n1"
+        h0 = first_history_entry(s, "pri")
+        pf = json.loads(h0[ann.POST_FILTER_RESULT])
+        assert pf == {"n1": {"DefaultPreemption": "preemption victim"}}
+    finally:
+        httpd.shutdown()
