@@ -88,6 +88,19 @@ class SchedulerEngine:
     def _schedule_wave(self) -> tuple[int, bool]:
         """One scheduling wave. Returns (#bound, any preemption happened)."""
         pending = self.pending_pods()
+        if self.plugin_config.preenqueues():
+            # SchedulingGates PreEnqueue: gated pods never enter the queue
+            gated = [
+                p for p in pending if (p.get("spec") or {}).get("schedulingGates")
+            ]
+            for p in gated:
+                meta = p.get("metadata") or {}
+                self._mark_gated(meta.get("namespace") or "default", meta.get("name", ""))
+            if gated:
+                pending = [
+                    p for p in pending
+                    if not (p.get("spec") or {}).get("schedulingGates")
+                ]
         if not pending:
             return 0, False
         nodes, _ = self.store.list("nodes")
@@ -321,6 +334,29 @@ class SchedulerEngine:
             conds = [c for c in status.get("conditions") or [] if c.get("type") != "PodScheduled"]
             conds.append({"type": "PodScheduled", "status": "True"})
             status["conditions"] = conds
+
+        self._update_pod(ns, name, mutate)
+
+    def _mark_gated(self, ns: str, name: str) -> None:
+        """upstream SchedulingGates PreEnqueue rejection condition."""
+        try:
+            cur = self.store.get("pods", name, ns)
+        except NotFound:
+            return
+        conds = (cur.get("status") or {}).get("conditions") or []
+        if any(c.get("reason") == "SchedulingGated" for c in conds):
+            return  # already marked; don't churn resourceVersion each wave
+
+        def mutate(pod: dict) -> None:
+            status = pod.setdefault("status", {})
+            status["phase"] = "Pending"
+            cs = [c for c in status.get("conditions") or [] if c.get("type") != "PodScheduled"]
+            cs.append({
+                "type": "PodScheduled", "status": "False",
+                "reason": "SchedulingGated",
+                "message": "Scheduling is blocked due to non-empty scheduling gates",
+            })
+            status["conditions"] = cs
 
         self._update_pod(ns, name, mutate)
 

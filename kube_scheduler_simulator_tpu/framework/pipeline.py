@@ -37,7 +37,9 @@ from typing import Any, NamedTuple
 
 import jax.numpy as jnp
 
-from ..plugins import affinity, interpod, noderesources, taints, topologyspread
+from ..plugins import (
+    affinity, imagelocality, interpod, noderesources, ports, taints, topologyspread,
+)
 from ..plugins.registry import PLUGIN_REGISTRY
 from ..state.compile import CompiledWorkload
 
@@ -63,6 +65,8 @@ def _filter_one(name: str, cw: CompiledWorkload, carry, sl) -> jnp.ndarray:
         return taints.unsched_filter(sl["NodeUnschedulable"])
     if name == "NodeName":
         return taints.nodename_filter(sl["NodeName"])
+    if name == "NodePorts":
+        return ports.filter_kernel(cw.statics["NodePorts"], sl["NodePorts"], carry["NodePorts"])
     if name == "PodTopologySpread":
         return topologyspread.filter_kernel(
             cw.statics["PodTopologySpread"], sl["PodTopologySpread"], carry["PodTopologySpread"]
@@ -84,6 +88,9 @@ def _score_one(name: str, cw: CompiledWorkload, carry, sl, feasible):
         return raw, raw  # no ScoreExtensions
     if name == "NodeResourcesBalancedAllocation":
         raw = noderesources.balanced_score(cw.statics["core"], sl["core"], carry["core"])
+        return raw, raw  # no ScoreExtensions
+    if name == "ImageLocality":
+        raw = imagelocality.score_kernel(sl["ImageLocality"])
         return raw, raw  # no ScoreExtensions
     if name == "NodeAffinity":
         raw = affinity.score_kernel(sl["NodeAffinity"])
@@ -144,6 +151,10 @@ def _bind_phase(cw: CompiledWorkload, carry, sl, selected):
     """Apply a bind of this pod to node `selected` (-1: no-op)."""
     new_carry = dict(carry)
     new_carry["core"] = noderesources.core_bind_update(carry["core"], sl["core"], selected)
+    if "NodePorts" in carry:
+        new_carry["NodePorts"] = ports.bind_update(
+            cw.statics["NodePorts"], sl["NodePorts"], carry["NodePorts"], selected
+        )
     if "PodTopologySpread" in carry:
         new_carry["PodTopologySpread"] = topologyspread.bind_update(
             cw.statics["PodTopologySpread"], sl["PodTopologySpread"],

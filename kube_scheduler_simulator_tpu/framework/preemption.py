@@ -174,7 +174,7 @@ class Preemptor:
             if nn:
                 by_node.setdefault(nn, []).append(p)
 
-        budget = _num_candidates(len(failed))
+        budget = _num_candidates(len(potential))
         candidates: list[tuple[str, list[dict]]] = []
         for node in potential:
             if len(candidates) >= budget:

@@ -1,0 +1,105 @@
+"""ImageLocality score kernel.
+
+Upstream v1.32 `imagelocality`: Score only (no Filter, no NormalizeScore),
+recorded by the reference shim like every score plugin (reference:
+simulator/scheduler/plugin/wrappedplugin.go:420-445).
+
+    sumScores = Σ over the pod's (init)containers whose image exists on
+                the node of  size_bytes * (nodes_having_image / total_nodes)
+    score     = 100 * (clamp(sumScores, min, max) - min) / (max - min)
+    min       = 23 MB * numContainers,  max = 1000 MB * numContainers
+
+Node images never change during a replay (KWOK-style nodes have no
+kubelet pulling images), so the whole score precompiles to a static
+[P, N] tensor — the kernel is a row gather.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+NAME = "ImageLocality"
+
+MB = 1024 * 1024
+MIN_THRESHOLD = 23 * MB
+MAX_CONTAINER_THRESHOLD = 1000 * MB
+MAX_NODE_SCORE = 100
+
+
+class ImageXS(NamedTuple):
+    score: jnp.ndarray  # [P, N] int64, precomputed
+
+
+def normalized_image_name(name: str) -> str:
+    """upstream normalizedImageName: append :latest when untagged."""
+    if name.rfind(":") <= name.rfind("/") and "@" not in name:
+        name += ":latest"
+    return name
+
+
+def node_image_states(nodes: list[dict]) -> dict[str, tuple[int, set[int]]]:
+    """image name -> (size_bytes, node indices having it)."""
+    states: dict[str, tuple[int, set[int]]] = {}
+    for j, node in enumerate(nodes):
+        for img in ((node.get("status") or {}).get("images")) or []:
+            size = int(img.get("sizeBytes") or 0)
+            for nm in img.get("names") or []:
+                nm = normalized_image_name(nm)
+                # first-seen size wins, like nodeinfo's imageStates
+                _, have = states.setdefault(nm, (size, set()))
+                have.add(j)
+    return states
+
+
+def pod_images(pod: dict) -> tuple[list[str], int]:
+    """(normalized image names, container count incl. init containers)."""
+    spec = pod.get("spec") or {}
+    containers = (spec.get("initContainers") or []) + (spec.get("containers") or [])
+    return [
+        normalized_image_name(c.get("image") or "") for c in containers if c.get("image")
+    ], len(containers)
+
+
+def calculate_priority(sum_scores: int, num_containers: int) -> int:
+    max_threshold = MAX_CONTAINER_THRESHOLD * num_containers
+    if sum_scores < MIN_THRESHOLD:
+        sum_scores = MIN_THRESHOLD
+    elif sum_scores > max_threshold:
+        sum_scores = max_threshold
+    return MAX_NODE_SCORE * (sum_scores - MIN_THRESHOLD) // (max_threshold - MIN_THRESHOLD)
+
+
+def score_for(pod: dict, states, n_nodes: int) -> np.ndarray:
+    """[N] int64 ImageLocality score, the scalar/parity formula."""
+    images, num_containers = pod_images(pod)
+    out = np.zeros(n_nodes, dtype=np.int64)
+    if not images or num_containers == 0:
+        return out
+    sums = np.zeros(n_nodes, dtype=np.int64)
+    for nm in images:
+        st = states.get(nm)
+        if st is None:
+            continue
+        size, have = st
+        scaled = int(float(size) * (float(len(have)) / float(n_nodes)))
+        for j in have:
+            sums[j] += scaled
+    for j in range(n_nodes):
+        out[j] = calculate_priority(int(sums[j]), num_containers)
+    return out
+
+
+def build(nodes: list[dict], pods: list[dict]) -> ImageXS:
+    states = node_image_states(nodes)
+    n = len(nodes)
+    score = np.zeros((len(pods), n), dtype=np.int64)
+    for i, pod in enumerate(pods):
+        score[i] = score_for(pod, states, n)
+    return ImageXS(score=jnp.asarray(score))
+
+
+def score_kernel(sl: ImageXS) -> jnp.ndarray:
+    return sl.score.astype(jnp.int64)

@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 @dataclass(frozen=True)
 class PluginDesc:
     name: str
+    has_preenqueue: bool = False
     has_prefilter: bool = False
     has_filter: bool = False
     has_postfilter: bool = False
@@ -37,6 +38,7 @@ PLUGIN_REGISTRY: dict[str, PluginDesc] = {
                    has_normalize=True, default_weight=3),
         PluginDesc("NodeAffinity", has_prefilter=True, has_filter=True, has_prescore=True,
                    has_score=True, has_normalize=True, default_weight=2),
+        PluginDesc("NodePorts", has_prefilter=True, has_filter=True),
         PluginDesc("NodeResourcesFit", has_prefilter=True, has_filter=True, has_prescore=True,
                    has_score=True, default_weight=1),
         PluginDesc("PodTopologySpread", has_prefilter=True, has_filter=True, has_prescore=True,
@@ -46,20 +48,25 @@ PLUGIN_REGISTRY: dict[str, PluginDesc] = {
         PluginDesc("DefaultPreemption", has_postfilter=True),
         PluginDesc("NodeResourcesBalancedAllocation", has_prescore=True, has_score=True,
                    default_weight=1),
+        PluginDesc("ImageLocality", has_score=True, default_weight=1),
+        PluginDesc("SchedulingGates", has_preenqueue=True),
     ]
 }
 
 # upstream MultiPoint order (v1.32 getDefaultPlugins), restricted to the above
 DEFAULT_ORDER = [
+    "SchedulingGates",
     "NodeUnschedulable",
     "NodeName",
     "TaintToleration",
     "NodeAffinity",
+    "NodePorts",
     "NodeResourcesFit",
     "PodTopologySpread",
     "InterPodAffinity",
     "DefaultPreemption",
     "NodeResourcesBalancedAllocation",
+    "ImageLocality",
 ]
 
 
@@ -103,6 +110,12 @@ class PluginSetConfig:
 
     def filters(self) -> list[str]:
         return [n for n in self.enabled if self._desc(n).has_filter]
+
+    def preenqueues(self) -> list[str]:
+        return [
+            n for n in self.enabled
+            if not self.is_custom(n) and PLUGIN_REGISTRY[n].has_preenqueue
+        ]
 
     def postfilters(self) -> list[str]:
         return [

@@ -54,6 +54,7 @@ class SequentialScheduler:
         self.nonzero = [[0, 0] for _ in range(self.n)]
         self.num_pods = [0] * self.n
         self.assigned: list[tuple[dict, int]] = []  # (pod manifest, node idx)
+        self._image_states = None  # lazy ImageLocality node-image index
         self._name_idx = {nm: j for j, nm in enumerate(self.names)}
         for bp, node_name in bound_pods or []:
             j = self._name_idx.get(node_name)
@@ -111,6 +112,17 @@ class SequentialScheduler:
         if name == "NodeName":
             want = _spec(pod).get("nodeName") or ""
             return None if (not want or want == self.names[j]) else "node(s) didn't match the requested node name"
+        if name == "NodePorts":
+            from ..plugins import ports as portsmod
+
+            wanted = portsmod.pod_host_ports(pod)
+            existing = [
+                t for ap, aj in self.assigned if aj == j
+                for t in portsmod.pod_host_ports(ap)
+            ]
+            if portsmod.sequential_conflict(wanted, existing):
+                return portsmod.ERR_NODE_PORTS
+            return None
         if name == "PodTopologySpread":
             return self._spread_filter(pod, j)
         if name == "InterPodAffinity":
@@ -118,6 +130,10 @@ class SequentialScheduler:
         raise ValueError(name)
 
     def _filter_skip(self, name, pod) -> bool:
+        if name == "NodePorts":
+            from ..plugins.ports import pod_host_ports
+
+            return not pod_host_ports(pod)
         if name == "NodeAffinity":
             spec = _spec(pod)
             req = (((spec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
@@ -191,12 +207,22 @@ class SequentialScheduler:
             return self._spread_score(pod, j)
         if name == "InterPodAffinity":
             return self._interpod_score(pod, j)
+        if name == "ImageLocality":
+            from ..plugins import imagelocality
+
+            row = self._cycle.get("image_row")
+            if row is None:
+                if self._image_states is None:
+                    self._image_states = imagelocality.node_image_states(self.node_manifests)
+                row = imagelocality.score_for(pod, self._image_states, self.n)
+                self._cycle["image_row"] = row
+            return int(row[j])
         raise ValueError(name)
 
     def _normalize(self, name, scores: dict[int, int], pod) -> dict[int, int]:
         if self.config.is_custom(name):
             return dict(scores)  # custom NormalizeScore unsupported (see custom.py)
-        if name in ("NodeResourcesFit", "NodeResourcesBalancedAllocation"):
+        if name in ("NodeResourcesFit", "NodeResourcesBalancedAllocation", "ImageLocality"):
             return dict(scores)
         if name in ("NodeAffinity", "TaintToleration"):
             reverse = name == "TaintToleration"

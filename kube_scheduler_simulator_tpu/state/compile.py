@@ -28,7 +28,9 @@ from . import resources as res
 from .nodes import NodeTable, build_node_table
 from .resources import ResourceSchema, pod_resource_request
 from ..plugins import registry as reg
-from ..plugins import affinity, interpod, noderesources, taints, topologyspread
+from ..plugins import (
+    affinity, imagelocality, interpod, noderesources, ports, taints, topologyspread,
+)
 
 
 @dataclass
@@ -115,6 +117,13 @@ def compile_workload(
 
     if "NodeAffinity" in enabled:
         xs["NodeAffinity"] = affinity.build(table, pods)
+    if "NodePorts" in enabled:
+        st, x, carry = ports.build(table, pods, bound_pods)
+        statics["NodePorts"] = st
+        xs["NodePorts"] = x
+        init_carry["NodePorts"] = carry
+    if "ImageLocality" in enabled:
+        xs["ImageLocality"] = imagelocality.build(nodes, pods)
     if "TaintToleration" in enabled:
         xs["TaintToleration"] = taints.build_taints(table, pods)
     if "NodeUnschedulable" in enabled:

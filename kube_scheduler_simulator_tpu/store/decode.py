@@ -23,7 +23,7 @@ import numpy as np
 
 from . import annotations as ann
 from ..framework.replay import ReplayResult
-from ..plugins import affinity, interpod, noderesources, taints, topologyspread
+from ..plugins import affinity, interpod, noderesources, ports, taints, topologyspread
 from ..plugins.registry import PLUGIN_REGISTRY
 
 
@@ -47,6 +47,7 @@ _DECODERS = {
     "TaintToleration": taints.decode_taint_filter,
     "NodeUnschedulable": lambda code, node, aux: taints.ERR_UNSCHEDULABLE,
     "NodeName": lambda code, node, aux: taints.ERR_NODE_NAME,
+    "NodePorts": lambda code, node, aux: ports.ERR_NODE_PORTS,
     "PodTopologySpread": topologyspread.decode_filter,
     "InterPodAffinity": interpod.decode_filter,
 }

@@ -14,7 +14,7 @@ import ctypes
 import numpy as np
 
 from ..native import get_lib, take_string
-from ..plugins import affinity, interpod, taints, topologyspread
+from ..plugins import affinity, interpod, ports, taints, topologyspread
 from ..plugins.noderesources import decode_fit_filter
 
 _MAX_FIT_LUT_BITS = 16
@@ -55,6 +55,9 @@ def build_context(cw):
             per_node.append(0)
         elif name == "NodeName":
             lut = [taints.ERR_NODE_NAME.encode()]
+            per_node.append(0)
+        elif name == "NodePorts":
+            lut = [ports.ERR_NODE_PORTS.encode()]
             per_node.append(0)
         elif name == "TaintToleration":
             stride = max((len(t) for t in table.taints), default=0)

@@ -1,0 +1,184 @@
+"""NodePorts, ImageLocality, SchedulingGates tests.
+
+Semantics sources: upstream v1.32 nodeports/imagelocality/schedulinggates
+plugins, recorded via the reference shim
+(reference: simulator/scheduler/plugin/wrappedplugin.go:420-445,523-548).
+"""
+
+import json
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.plugins import imagelocality
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store import annotations as ann
+from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+
+def node(name, cpu="4", images=None):
+    n = {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "spec": {},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": "8Gi", "pods": "110"},
+            "capacity": {"cpu": cpu, "memory": "8Gi", "pods": "110"},
+        },
+    }
+    if images:
+        n["status"]["images"] = images
+    return n
+
+
+def pod(name, ports=None, image="app:v1", gates=None, node_name=None):
+    p = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [{
+                "name": "c", "image": image,
+                "resources": {"requests": {"cpu": "100m"}},
+            }],
+        },
+        "status": {},
+    }
+    if ports:
+        p["spec"]["containers"][0]["ports"] = ports
+    if gates:
+        p["spec"]["schedulingGates"] = gates
+    if node_name:
+        p["spec"]["nodeName"] = node_name
+        p["status"]["phase"] = "Running"
+    return p
+
+
+def parity_check(nodes, pods, cfg):
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=8)
+    for i, (sa, ssel) in enumerate(seq):
+        da = decode_pod_result(rr, i)
+        assert int(rr.selected[i]) == ssel, f"pod {i} selection"
+        for k, v in sa.items():
+            assert da[k] == v, f"pod {i} {k}:\n dev={da[k]}\n seq={v}"
+
+
+# ---------------------------------------------------------------- NodePorts
+
+def test_nodeports_conflict_blocks_node():
+    s = ObjectStore()
+    s.create("nodes", node("n1"))
+    s.create("pods", pod("a", ports=[{"containerPort": 80, "hostPort": 8080}], node_name="n1"))
+    s.create("pods", pod("b", ports=[{"containerPort": 80, "hostPort": 8080}]))
+    engine = SchedulerEngine(s)
+    assert engine.schedule_pending() == 0
+    annos = s.get("pods", "b")["metadata"]["annotations"]
+    fr = json.loads(annos[ann.FILTER_RESULT])
+    assert fr["n1"]["NodePorts"] == "node(s) didn't have free ports for the requested pod ports"
+
+
+def test_nodeports_protocol_and_ip_rules():
+    from kube_scheduler_simulator_tpu.plugins.ports import sequential_conflict
+
+    # same port different protocol: no conflict
+    assert not sequential_conflict([("UDP", 80, "0.0.0.0")], [("TCP", 80, "0.0.0.0")])
+    # specific IPs differ: no conflict
+    assert not sequential_conflict([("TCP", 80, "10.0.0.1")], [("TCP", 80, "10.0.0.2")])
+    # wildcard vs specific: conflict
+    assert sequential_conflict([("TCP", 80, "0.0.0.0")], [("TCP", 80, "10.0.0.2")])
+    assert sequential_conflict([("TCP", 80, "10.0.0.2")], [("TCP", 80, "0.0.0.0")])
+
+
+def test_nodeports_sequence_parity():
+    nodes = [node("a"), node("b")]
+    pods = [
+        pod("p0", ports=[{"containerPort": 80, "hostPort": 8080}]),
+        pod("p1", ports=[{"containerPort": 80, "hostPort": 8080}]),
+        pod("p2", ports=[{"containerPort": 80, "hostPort": 8080}]),  # no node left
+        pod("p3"),  # no ports: PreFilter Skip
+        pod("p4", ports=[{"containerPort": 80, "hostPort": 9090, "hostIP": "10.0.0.1"}]),
+    ]
+    cfg = PluginSetConfig(enabled=[
+        "NodeUnschedulable", "NodeName", "NodePorts", "NodeResourcesFit",
+        "NodeResourcesBalancedAllocation",
+    ])
+    parity_check(nodes, pods, cfg)
+
+
+def test_nodeports_prefilter_skip_recorded():
+    nodes = [node("a"), node("b")]
+    pods = [pod("p", ports=None)]
+    cfg = PluginSetConfig(enabled=["NodePorts", "NodeResourcesFit"])
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=1)
+    da = decode_pod_result(rr, 0)
+    pf = json.loads(da[ann.PRE_FILTER_STATUS_RESULT])
+    assert pf["NodePorts"] == ""  # Skip
+    fr = json.loads(da[ann.FILTER_RESULT])
+    assert "NodePorts" not in fr.get("a", {})
+
+
+# ---------------------------------------------------------------- ImageLocality
+
+IMAGES_A = [{"names": ["app:v1"], "sizeBytes": 500 * 1024 * 1024}]
+
+
+def test_imagelocality_prefers_node_with_image():
+    nodes = [node("a", images=IMAGES_A), node("b")]
+    pods = [pod("p", image="app:v1")]
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit", "ImageLocality"])
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=1)
+    assert int(rr.selected[0]) == 0
+    da = decode_pod_result(rr, 0)
+    sc = json.loads(da[ann.SCORE_RESULT])
+    # 500MB * (1/2 nodes having it) = 250MB -> (250-23)/(1000-23) * 100 = 23
+    assert sc["a"]["ImageLocality"] == "23"
+    assert sc["b"]["ImageLocality"] == "0"
+
+
+def test_imagelocality_untagged_normalizes_to_latest():
+    assert imagelocality.normalized_image_name("nginx") == "nginx:latest"
+    assert imagelocality.normalized_image_name("nginx:1.2") == "nginx:1.2"
+    assert imagelocality.normalized_image_name("repo/img@sha256:ab") == "repo/img@sha256:ab"
+    assert imagelocality.normalized_image_name("host:5000/img") == "host:5000/img:latest"
+
+
+def test_imagelocality_sequence_parity():
+    nodes = [node("a", images=IMAGES_A), node("b"), node("c", images=IMAGES_A)]
+    pods = [pod(f"p{i}", image="app:v1") for i in range(4)] + [pod("q", image="other:v2")]
+    cfg = PluginSetConfig(enabled=[
+        "NodeResourcesFit", "NodeResourcesBalancedAllocation", "ImageLocality",
+    ])
+    parity_check(nodes, pods, cfg)
+
+
+# ---------------------------------------------------------------- SchedulingGates
+
+def test_gated_pod_not_scheduled():
+    s = ObjectStore()
+    s.create("nodes", node("n1"))
+    s.create("pods", pod("gated", gates=[{"name": "example.com/hold"}]))
+    s.create("pods", pod("free"))
+    engine = SchedulerEngine(s)
+    assert engine.schedule_pending() == 1
+    g = s.get("pods", "gated")
+    assert not g["spec"].get("nodeName")
+    cond = g["status"]["conditions"][0]
+    assert cond["reason"] == "SchedulingGated"
+    assert s.get("pods", "free")["spec"]["nodeName"] == "n1"
+    # no scheduling-cycle annotations for a gated pod (it never enqueued)
+    assert ann.SELECTED_NODE not in (g["metadata"].get("annotations") or {})
+
+
+def test_gate_removal_unblocks():
+    s = ObjectStore()
+    s.create("nodes", node("n1"))
+    s.create("pods", pod("gated", gates=[{"name": "example.com/hold"}]))
+    engine = SchedulerEngine(s)
+    assert engine.schedule_pending() == 0
+    g = s.get("pods", "gated")
+    g["spec"]["schedulingGates"] = []
+    s.update("pods", g)
+    assert engine.schedule_pending() == 1
+    assert s.get("pods", "gated")["spec"]["nodeName"] == "n1"
