@@ -109,7 +109,18 @@ class SchedulerEngine:
             (p, p["spec"]["nodeName"]) for p in pods_all
             if (p.get("spec") or {}).get("nodeName")
         ]
-        cw = compile_workload(nodes, pending, self.plugin_config, bound_pods=bound)
+        # volume manifests for the VolumeBinding/Zone/Restrictions/Limits
+        # family; CSINode is not one of the simulator's 7 synced GVRs
+        # (reference: recorder/recorder.go:45-53), so limits come only from
+        # callers using compile_workload directly
+        volumes = {
+            "pvcs": self.store.list("persistentvolumeclaims")[0],
+            "pvs": self.store.list("persistentvolumes")[0],
+            "storageclasses": self.store.list("storageclasses")[0],
+        }
+        cw = compile_workload(
+            nodes, pending, self.plugin_config, bound_pods=bound, volumes=volumes
+        )
         if self.extender_service is not None and self.extender_service.extenders:
             return self._schedule_with_extenders(cw, pending)
 
@@ -130,7 +141,11 @@ class SchedulerEngine:
                 self._bind(ns, name, cw.node_table.names[sel])
                 n_bound += 1
             else:
-                if postfilter_on:
+                # PreFilter-rejected pods skip preemption: the static
+                # rejects are UnschedulableAndUnresolvable upstream, and
+                # ReadWriteOncePod preemption (preempting the PVC holder)
+                # is not modeled — documented divergence
+                if postfilter_on and int(rr.prefilter_reject[i]) == 0:
                     any_preempted |= self._run_postfilter(
                         cw, rr.filter_codes[i], i, pod, ns, name
                     )
@@ -199,6 +214,10 @@ class SchedulerEngine:
             fskip = cw.host["filter_skip"]
             active = [f for f, nm in enumerate(cw.config.filters()) if not fskip[nm][i]]
             feasible = codes[active].max(axis=0) == 0 if active else np.ones(len(names), bool)
+            pf_reject = int(out.prefilter_reject)
+            if pf_reject:
+                # PreFilter aborted the cycle: no extender round-trip either
+                feasible[:] = False
 
             meta = pod.get("metadata") or {}
             ns, name = meta.get("namespace") or "default", meta.get("name", "")
@@ -268,8 +287,9 @@ class SchedulerEngine:
                 score_final=np.asarray(out.score_final)[None],
                 selected=np.asarray([sel], dtype=np.int32),
                 feasible_count=np.asarray([count], dtype=np.int32),
+                prefilter_reject=np.asarray([pf_reject], dtype=np.int32),
             )
-            annotations = decode_pod_result(rr1, 0, feasible_override=feasible)
+            annotations = decode_pod_result(rr1, 0, feasible_override=feasible, host_index=i)
             self.result_store.put_decoded(ns, name, annotations)
             for hook in self.plugin_extenders:
                 hook.after_cycle(pod, annotations, self.result_store)
@@ -303,7 +323,7 @@ class SchedulerEngine:
                 # only preempts on FitError).  Candidate nodes are those
                 # that failed the PLUGIN filters — extender-rejected nodes
                 # are not preemption candidates (docs/SEMANTICS.md).
-                if postfilter_on and sel < 0 and not ext_error:
+                if postfilter_on and sel < 0 and not ext_error and not pf_reject:
                     any_preempted |= self._run_postfilter(cw, codes, i, pod, ns, name)
                 self._mark_unschedulable(ns, name)
             self.reflector.reflect(ns, name)

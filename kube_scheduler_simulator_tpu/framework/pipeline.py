@@ -38,7 +38,8 @@ from typing import Any, NamedTuple
 import jax.numpy as jnp
 
 from ..plugins import (
-    affinity, imagelocality, interpod, noderesources, ports, taints, topologyspread,
+    affinity, imagelocality, interpod, noderesources, nodevolumelimits, ports,
+    taints, topologyspread, volumebinding, volumerestrictions, volumezone,
 )
 from ..plugins.registry import PLUGIN_REGISTRY
 from ..state.compile import CompiledWorkload
@@ -50,6 +51,9 @@ class StepOut(NamedTuple):
     score_final: jnp.ndarray   # [S, N] int32 (normalized x weight)
     selected: jnp.ndarray      # int32, -1 == unschedulable
     feasible_count: jnp.ndarray  # int32
+    prefilter_reject: jnp.ndarray  # int32, >0 == dynamic PreFilter reject
+    #   (currently only VolumeRestrictions' cluster-wide ReadWriteOncePod
+    #   conflict; the decoder maps 1 -> its message)
 
 
 def _filter_one(name: str, cw: CompiledWorkload, carry, sl) -> jnp.ndarray:
@@ -75,6 +79,22 @@ def _filter_one(name: str, cw: CompiledWorkload, carry, sl) -> jnp.ndarray:
         return interpod.filter_kernel(
             cw.statics["InterPodAffinity"], sl["InterPodAffinity"], carry["InterPodAffinity"]
         )
+    if name == "VolumeRestrictions":
+        return volumerestrictions.filter_kernel(
+            cw.statics["VolumeRestrictions"], sl["VolumeRestrictions"],
+            carry["VolumeRestrictions"],
+        )
+    if name == "NodeVolumeLimits":
+        return nodevolumelimits.filter_kernel(
+            cw.statics["NodeVolumeLimits"], sl["NodeVolumeLimits"],
+            carry["NodeVolumeLimits"],
+        )
+    if name == "VolumeBinding":
+        return volumebinding.filter_kernel(
+            cw.statics["VolumeBinding"], sl["VolumeBinding"], carry["VolumeBinding"]
+        )
+    if name == "VolumeZone":
+        return volumezone.filter_kernel(sl["VolumeZone"])
     raise ValueError(f"no filter kernel for {name}")
 
 
@@ -92,6 +112,9 @@ def _score_one(name: str, cw: CompiledWorkload, carry, sl, feasible):
     if name == "ImageLocality":
         raw = imagelocality.score_kernel(sl["ImageLocality"])
         return raw, raw  # no ScoreExtensions
+    if name == "VolumeBinding":
+        raw = volumebinding.score_kernel(cw.n_nodes)
+        return raw, raw  # scorer nil with VolumeCapacityPriority off
     if name == "NodeAffinity":
         raw = affinity.score_kernel(sl["NodeAffinity"])
         return raw, affinity.normalize(raw, feasible)
@@ -120,7 +143,8 @@ def _eval_phase(cw: CompiledWorkload, carry, sl, weights, filter_names, score_na
     codes = []
     feasible = jnp.ones(n, dtype=bool)
     for name in filter_names:
-        code = _filter_one(name, cw, carry, sl)
+        # broadcast: compact builders emit [1]-shaped always-pass codes
+        code = jnp.broadcast_to(_filter_one(name, cw, carry, sl), (n,))
         x = sl.get(name)
         if x is not None and hasattr(x, "filter_skip"):
             code = jnp.where(x.filter_skip, 0, code)
@@ -165,7 +189,37 @@ def _bind_phase(cw: CompiledWorkload, carry, sl, selected):
             cw.statics["InterPodAffinity"], sl["InterPodAffinity"],
             carry["InterPodAffinity"], selected,
         )
+    if "VolumeRestrictions" in carry:
+        new_carry["VolumeRestrictions"] = volumerestrictions.bind_update(
+            sl["VolumeRestrictions"], carry["VolumeRestrictions"], selected
+        )
+    if "NodeVolumeLimits" in carry:
+        new_carry["NodeVolumeLimits"] = nodevolumelimits.bind_update(
+            sl["NodeVolumeLimits"], carry["NodeVolumeLimits"], selected
+        )
+    if "VolumeBinding" in carry:
+        new_carry["VolumeBinding"] = volumebinding.bind_update(
+            cw.statics["VolumeBinding"], sl["VolumeBinding"],
+            carry["VolumeBinding"], selected,
+        )
     return new_carry
+
+
+def _prefilter_reject(cw, carry, sl) -> jnp.ndarray:
+    """Dynamic (replay-state-dependent) PreFilter rejects + the static
+    compile-time ones (xs['force_unsched']).  >0 forces selected = -1."""
+    code = jnp.int32(0)
+    if "VolumeRestrictions" in carry:
+        # bit 0: ReadWriteOncePod conflict (dynamic)
+        code = volumerestrictions.prefilter_reject(
+            sl["VolumeRestrictions"], carry["VolumeRestrictions"]
+        )
+    force = sl.get("force_unsched")
+    if force is not None:
+        # bit 1: compile-time reject; both bits can be set — the decoder
+        # resolves plugin attribution in prefilter order
+        code = code | jnp.where(force, jnp.int32(2), 0)
+    return code
 
 
 def build_step(cw):
@@ -182,7 +236,9 @@ def build_step(cw):
         filter_codes, score_raw, score_final, feasible, total = _eval_phase(
             cw, carry, sl, weights, filter_names, score_names
         )
+        reject = _prefilter_reject(cw, carry, sl)
         feasible_count = jnp.sum(feasible, dtype=jnp.int32)
+        feasible_count = jnp.where(reject > 0, 0, feasible_count)
         selected = jnp.argmax(total).astype(jnp.int32)  # first max == lowest index
         selected = jnp.where(feasible_count > 0, selected, jnp.int32(-1))
         is_pad = sl.get("is_pad")
@@ -196,6 +252,7 @@ def build_step(cw):
             score_final=score_final.astype(jnp.int32),
             selected=selected,
             feasible_count=feasible_count,
+            prefilter_reject=reject,
         )
         return new_carry, out
 
@@ -222,7 +279,9 @@ def build_phased(cw: CompiledWorkload):
         filter_codes, score_raw, score_final, feasible, total = _eval_phase(
             cw, carry, sl, weights, filter_names, score_names
         )
+        reject = _prefilter_reject(cw, carry, sl)
         feasible_count = jnp.sum(feasible, dtype=jnp.int32)
+        feasible_count = jnp.where(reject > 0, 0, feasible_count)
         selected = jnp.argmax(total).astype(jnp.int32)
         selected = jnp.where(feasible_count > 0, selected, jnp.int32(-1))
         return StepOut(
@@ -231,6 +290,7 @@ def build_phased(cw: CompiledWorkload):
             score_final=score_final.astype(jnp.int32),
             selected=selected,
             feasible_count=feasible_count,
+            prefilter_reject=reject,
         )
 
     def bind_fn(carry, sl, selected):

@@ -51,6 +51,9 @@ RESOLVABLE_PLUGINS = {
     "PodTopologySpread",
     "InterPodAffinity",
     "NodePorts",
+    # removing pods can free inline disks / CSI attachment slots
+    "VolumeRestrictions",
+    "NodeVolumeLimits",
 }
 
 # upstream DefaultPreemptionArgs defaults
@@ -109,6 +112,7 @@ class Preemptor:
         self._fit_cache: dict = {}
         self._nodes: list[dict] | None = None   # store snapshot, per preempt()
         self._pods_all: list[dict] | None = None
+        self._volumes: dict | None = None
 
     # ------------------------------------------------------------ oracle
 
@@ -132,11 +136,18 @@ class Preemptor:
             (p, p["spec"]["nodeName"]) for p in self._pods_all
             if (p.get("spec") or {}).get("nodeName") and _pod_key(p) not in removed
         ]
-        cw = compile_workload(nodes, [pod], self.plugin_config, bound_pods=bound)
+        cw = compile_workload(
+            nodes, [pod], self.plugin_config, bound_pods=bound, volumes=self._volumes
+        )
         rr = replay(cw, chunk=1)
         try:
             j = cw.node_table.names.index(node_name)
         except ValueError:
+            return False
+        if int(rr.prefilter_reject[0]) != 0:
+            # PreFilter still rejects the pod in the hypothesis (e.g. the
+            # ReadWriteOncePod holder is not among the removed victims)
+            self._fit_cache[cache_key] = False
             return False
         active = [
             f for f, name in enumerate(cw.config.filters())
@@ -154,6 +165,11 @@ class Preemptor:
         self._fit_cache.clear()
         self._nodes, _ = self.store.list("nodes")
         self._pods_all, _ = self.store.list("pods")
+        self._volumes = {
+            "pvcs": self.store.list("persistentvolumeclaims")[0],
+            "pvs": self.store.list("persistentvolumes")[0],
+            "storageclasses": self.store.list("storageclasses")[0],
+        }
         evaluated = [n for n, _ in failed]
         out = PreemptionOutcome(evaluated_nodes=evaluated)
 

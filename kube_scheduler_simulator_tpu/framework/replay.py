@@ -37,6 +37,7 @@ class ReplayResult:
     score_final: np.ndarray     # [P, S, N] int32
     selected: np.ndarray        # [P] int32 (-1 unschedulable)
     feasible_count: np.ndarray  # [P] int32
+    prefilter_reject: np.ndarray  # [P] int32 (bitmask, see pipeline.StepOut)
 
     @property
     def scheduled(self) -> int:
@@ -149,6 +150,7 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True) -> Repl
                 score_final=out.score_final[:0],
                 selected=out.selected,
                 feasible_count=out.feasible_count,
+                prefilter_reject=out.prefilter_reject,
             )
         outs.append(out)
 
@@ -169,4 +171,5 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True) -> Repl
         score_final=cat("score_final", (0, n_s, n)),
         selected=cat("selected", (0,)),
         feasible_count=cat("feasible_count", (0,)),
+        prefilter_reject=cat("prefilter_reject", (0,)),
     )

@@ -1,0 +1,140 @@
+"""NodeVolumeLimits (CSI) tensor kernels.
+
+Upstream v1.32 `nodevolumelimits.CSILimits`: Filter fails a node when
+attaching the pod's CSI volumes would push any driver's unique-volume
+count on that node over the CSINode-reported allocatable limit — status
+"node(s) exceed max volume count".  Nodes with no CSINode object or no
+limit for the driver are never failed.  PreFilter returns Skip when the
+pod has no PVC-backed volumes.
+
+Tensorization: CSI volumes (driver, volumeHandle) over PVC-bound PVs are
+interned as c-slots with a driver id; the carry tracks the per-node
+unique-volume bitmap `on_node[N, C]` (a volume shared by two pods counts
+once, matching upstream's unique-volume semantics).  Per-driver counts are
+derived with one masked matmul against the driver one-hot.
+
+Divergence (documented): volumes a pod acquires through dynamic
+WaitForFirstConsumer provisioning (plugins/volumebinding.py) have no PV at
+evaluation time and are not counted against later pods, and in-tree
+translated / inline ephemeral CSI volumes are not modeled.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..state.volumes import VolumeTable, pod_pvc_keys
+
+NAME = "NodeVolumeLimits"
+ERR_MAX_VOLUME_COUNT = "node(s) exceed max volume count"
+
+
+class LimitsStatic(NamedTuple):
+    driver_onehot: jnp.ndarray  # [C, D] bool
+    limits: jnp.ndarray         # [N, D] int64 (-1 = unlimited)
+
+
+class LimitsXS(NamedTuple):
+    pod_vols: jnp.ndarray       # [P, C] bool
+    filter_skip: jnp.ndarray    # [P] bool
+
+
+class LimitsCarry(NamedTuple):
+    on_node: jnp.ndarray        # [N, C] bool
+
+
+def pod_csi_volumes(vt: VolumeTable, pod: dict) -> list[tuple[str, str]]:
+    """(driver, handle) for each CSI volume reached through a bound PVC."""
+    out = []
+    for key in pod_pvc_keys(pod):
+        pvc = vt.pvcs.get(key)
+        if pvc is None or not pvc.volume_name:
+            continue
+        i = vt.pv_index.get(pvc.volume_name)
+        if i is None:
+            continue
+        pv = vt.pvs[i]
+        if pv.csi_driver and pv.csi_handle:
+            out.append((pv.csi_driver, pv.csi_handle))
+    return out
+
+
+def build(vt: VolumeTable, table, pods: list[dict],
+          bound_pods: list[tuple[dict, str]]):
+    """-> (LimitsStatic, LimitsXS, LimitsCarry).  With no CSINode-published
+    limits every dimension is 0 and the kernel can never fail a node."""
+    drivers = sorted(vt.csi_limits)
+    d_idx = {d: i for i, d in enumerate(drivers)}
+
+    vol_id: dict[tuple[str, str], int] = {}
+    vol_driver: list[int] = []
+
+    def c_of(vol: tuple[str, str]) -> int | None:
+        if vol[0] not in d_idx:
+            return None  # unlimited driver: irrelevant to the filter
+        i = vol_id.get(vol)
+        if i is None:
+            i = vol_id[vol] = len(vol_id)
+            vol_driver.append(d_idx[vol[0]])
+        return i
+
+    pod_vol_lists = [pod_csi_volumes(vt, p) for p in pods]
+    bound_vol_lists = [(pod_csi_volumes(vt, bp), nn) for bp, nn in bound_pods]
+    for vols in pod_vol_lists + [v for v, _ in bound_vol_lists]:
+        for vol in vols:
+            c_of(vol)
+
+    p, n = len(pods), table.n
+    nc, ndrv = len(vol_id), len(drivers)
+    pod_vols = np.zeros((p, nc), dtype=bool)
+    skip = np.ones(p, dtype=bool)
+    for i, pod in enumerate(pods):
+        if pod_pvc_keys(pod):
+            skip[i] = False  # upstream Skips only pods with no PVC volumes
+        for vol in pod_vol_lists[i]:
+            c = c_of(vol)
+            if c is not None:
+                pod_vols[i, c] = True
+
+    on_node = np.zeros((n, nc), dtype=bool)
+    name_idx = {name: j for j, name in enumerate(table.names)}
+    for vols, node_name in bound_vol_lists:
+        j = name_idx.get(node_name)
+        if j is None:
+            continue
+        for vol in vols:
+            c = c_of(vol)
+            if c is not None:
+                on_node[j, c] = True
+
+    onehot = np.zeros((nc, ndrv), dtype=bool)
+    for c, d in enumerate(vol_driver):
+        onehot[c, d] = True
+    limits = np.stack([vt.csi_limits[d] for d in drivers], axis=1) if drivers else \
+        np.zeros((n, 0), dtype=np.int64)
+
+    static = LimitsStatic(driver_onehot=jnp.asarray(onehot), limits=jnp.asarray(limits))
+    xs = LimitsXS(pod_vols=jnp.asarray(pod_vols), filter_skip=jnp.asarray(skip))
+    carry = LimitsCarry(on_node=jnp.asarray(on_node))
+    return static, xs, carry
+
+
+def filter_kernel(static: LimitsStatic, sl: LimitsXS, carry: LimitsCarry) -> jnp.ndarray:
+    """[N] int32: 1 where a driver limit would be exceeded."""
+    oh = static.driver_onehot.astype(jnp.int64)
+    existing = carry.on_node.astype(jnp.int64) @ oh                   # [N, D]
+    new = (sl.pod_vols[None, :] & ~carry.on_node).astype(jnp.int64) @ oh  # [N, D]
+    # upstream checks only drivers the pod ADDS volumes for (returns nil
+    # when len(newVolumes) == 0), so a node already over its limit still
+    # accepts pods that bring nothing new for that driver
+    over = (static.limits >= 0) & (new > 0) & (existing + new > static.limits)
+    return jnp.any(over, axis=1).astype(jnp.int32)
+
+
+def bind_update(sl: LimitsXS, carry: LimitsCarry, selected: jnp.ndarray) -> LimitsCarry:
+    n = carry.on_node.shape[0]
+    onehot = (jnp.arange(n) == selected)[:, None]
+    return LimitsCarry(on_node=carry.on_node | (onehot & sl.pod_vols[None, :]))

@@ -41,6 +41,11 @@ PLUGIN_REGISTRY: dict[str, PluginDesc] = {
         PluginDesc("NodePorts", has_prefilter=True, has_filter=True),
         PluginDesc("NodeResourcesFit", has_prefilter=True, has_filter=True, has_prescore=True,
                    has_score=True, default_weight=1),
+        PluginDesc("VolumeRestrictions", has_prefilter=True, has_filter=True),
+        PluginDesc("NodeVolumeLimits", has_prefilter=True, has_filter=True),
+        PluginDesc("VolumeBinding", has_prefilter=True, has_filter=True, has_score=True,
+                   default_weight=1),
+        PluginDesc("VolumeZone", has_prefilter=True, has_filter=True),
         PluginDesc("PodTopologySpread", has_prefilter=True, has_filter=True, has_prescore=True,
                    has_score=True, has_normalize=True, default_weight=2),
         PluginDesc("InterPodAffinity", has_prefilter=True, has_filter=True, has_prescore=True,
@@ -62,6 +67,10 @@ DEFAULT_ORDER = [
     "NodeAffinity",
     "NodePorts",
     "NodeResourcesFit",
+    "VolumeRestrictions",
+    "NodeVolumeLimits",
+    "VolumeBinding",
+    "VolumeZone",
     "PodTopologySpread",
     "InterPodAffinity",
     "DefaultPreemption",

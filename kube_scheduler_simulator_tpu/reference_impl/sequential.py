@@ -41,12 +41,28 @@ def _spec(pod):
 
 
 class SequentialScheduler:
-    def __init__(self, nodes, pods, config: PluginSetConfig | None = None, bound_pods=None):
+    def __init__(self, nodes, pods, config: PluginSetConfig | None = None, bound_pods=None,
+                 volumes=None):
+        from ..state.volumes import build_volume_table
+
         self.config = config or PluginSetConfig()
         self.pods = pods
         self.node_manifests = nodes
         self.schema = ResourceSchema.discover(pods + [bp for bp, _ in (bound_pods or [])], nodes)
         self.table = build_node_table(nodes, self.schema)
+        volumes = volumes or {}
+        # manifest parsing (VolumeTable) is shared with the tensor side;
+        # the *scheduling logic* below is independently scalar
+        self.vt = build_volume_table(
+            self.table, volumes.get("pvcs"), volumes.get("pvs"),
+            volumes.get("storageclasses"), volumes.get("csinodes"),
+        )
+        from ..plugins.volumebinding import prime_claims
+
+        self.pv_claimed = list(prime_claims(
+            self.vt, bound_pods or [],
+            {nm: j for j, nm in enumerate(self.table.names)},
+        ))
         self.labels = self.table.labels
         self.names = self.table.names
         self.n = self.table.n
@@ -127,7 +143,166 @@ class SequentialScheduler:
             return self._spread_filter(pod, j)
         if name == "InterPodAffinity":
             return self._interpod_filter(pod, j)
+        if name == "VolumeRestrictions":
+            from ..plugins import volumerestrictions as vr
+
+            wanted = vr.pod_inline_disks(pod)
+            existing = [
+                t for ap, aj in self.assigned if aj == j
+                for t in vr.pod_inline_disks(ap)
+            ]
+            if vr.sequential_disk_conflict(wanted, existing):
+                return vr.ERR_DISK_CONFLICT
+            return None
+        if name == "NodeVolumeLimits":
+            return self._volume_limits_filter(pod, j)
+        if name == "VolumeBinding":
+            from ..plugins import volumebinding as vb
+
+            code = self._vb_filter_code(pod, j)
+            return vb.decode_filter(code, j, None) if code else None
+        if name == "VolumeZone":
+            return self._volume_zone_filter(pod, j)
         raise ValueError(name)
+
+    # ---------------- volume plugins (scalar) ---------------------------
+
+    def _pod_pvcs(self, pod):
+        from ..state.volumes import pod_pvc_keys
+
+        return pod_pvc_keys(pod)
+
+    def _volume_zone_filter(self, pod, j) -> str | None:
+        from ..plugins.volumezone import ERR_VOLUME_ZONE_CONFLICT
+        from ..state.volumes import ZONE_LABELS
+
+        for key in self._pod_pvcs(pod):
+            pvc = self.vt.pvcs.get(key)
+            if pvc is None or not pvc.volume_name:
+                continue
+            vi = self.vt.pv_index.get(pvc.volume_name)
+            if vi is None:
+                continue
+            labels = self.vt.pvs[vi].labels
+            for zk in ZONE_LABELS:
+                if zk not in labels:
+                    continue
+                allowed = {z.strip() for z in str(labels[zk]).split(",")}
+                if self.labels[j].get(zk) not in allowed:
+                    return ERR_VOLUME_ZONE_CONFLICT
+        return None
+
+    def _volume_limits_filter(self, pod, j) -> str | None:
+        from ..plugins.nodevolumelimits import ERR_MAX_VOLUME_COUNT, pod_csi_volumes
+
+        if not self.vt.csi_limits:
+            return None
+        on_node: set[tuple[str, str]] = set()
+        for ap, aj in self.assigned:
+            if aj == j:
+                on_node.update(pod_csi_volumes(self.vt, ap))
+        new = set(pod_csi_volumes(self.vt, pod)) - on_node
+        # only drivers the pod adds NEW volumes for are checked (upstream
+        # returns nil when newVolumes is empty)
+        for drv in {d for d, _ in new}:
+            limits = self.vt.csi_limits.get(drv)
+            if limits is None or limits[j] < 0:
+                continue
+            cnt = sum(1 for d, _ in on_node | new if d == drv)
+            if cnt > limits[j]:
+                return ERR_MAX_VOLUME_COUNT
+        return None
+
+    def _vb_classified(self, pod):
+        from ..plugins.volumebinding import classify_pod
+
+        key = id(pod)
+        got = self._cycle.get(("vb", key))
+        if got is None:
+            got = classify_pod(self.vt, pod)
+            self._cycle[("vb", key)] = got
+        return got
+
+    def _vb_filter_code(self, pod, j) -> int:
+        """Bitmask mirroring plugins/volumebinding.filter_kernel, computed
+        scalar-style: bound-PV affinity/existence + greedy matching of
+        unbound WFFC claims (smallest capacity, lowest index, excluding
+        claims made by earlier-bound pods and earlier slots of this pod)."""
+        from ..plugins.volumebinding import (
+            CODE_BIND_CONFLICT, CODE_NODE_CONFLICT, CODE_PV_NOT_EXIST,
+        )
+        from ..state.volumes import NO_PROVISIONER, allowed_topologies_match
+
+        _, bound, unbound = self._vb_classified(pod)
+        code = 0
+        for b in bound:
+            if b < 0:
+                code |= CODE_PV_NOT_EXIST
+            elif not self.vt.pv_node_ok[b, j]:
+                code |= CODE_NODE_CONFLICT
+        chosen: set[int] = set()
+        for pvc in unbound:
+            vi = self._vb_pick(pvc, j, chosen)
+            if vi is not None:
+                chosen.add(vi)
+                continue
+            sc = self.vt.classes[pvc.storage_class or ""]
+            can_provision = (
+                sc.provisioner and sc.provisioner != NO_PROVISIONER
+                and allowed_topologies_match(sc, self.labels[j])
+            )
+            if not can_provision:
+                code |= CODE_BIND_CONFLICT
+        return code
+
+    def _vb_pick(self, pvc, j, chosen: set[int]) -> int | None:
+        from ..state.volumes import pv_matches_claim
+
+        best = None
+        for vi, pv in enumerate(self.vt.pvs):
+            if self.pv_claimed[vi] or vi in chosen:
+                continue
+            if not self.vt.pv_node_ok[vi, j]:
+                continue
+            if not pv_matches_claim(pv, pvc):
+                continue
+            if best is None or pv.capacity < self.vt.pvs[best].capacity:
+                best = vi
+        return best
+
+    def _vb_bind(self, pod, j) -> None:
+        """Claim the PVs the greedy matcher picks on the bound node."""
+        _, _, unbound = self._vb_classified(pod)
+        chosen: set[int] = set()
+        for pvc in unbound:
+            vi = self._vb_pick(pvc, j, chosen)
+            if vi is not None:
+                chosen.add(vi)
+        for vi in chosen:
+            self.pv_claimed[vi] = True
+
+    def _prefilter_reject(self, pod):
+        """-> (plugin name, message) of the first PreFilter reject in
+        config order, or None (upstream RunPreFilterPlugins stops at the
+        first non-success status)."""
+        from ..plugins.volumerestrictions import ERR_RWOP_CONFLICT, pod_rwop_keys
+
+        for name in self.config.prefilters():
+            if name == "VolumeRestrictions":
+                for key in self._pod_pvcs(pod):
+                    if key not in self.vt.pvcs:
+                        pvc_name = key.split("/", 1)[1]
+                        return name, f'persistentvolumeclaim "{pvc_name}" not found'
+                mine = set(pod_rwop_keys(self.vt, pod))
+                if mine:
+                    for ap, _ in self.assigned:
+                        if mine & set(pod_rwop_keys(self.vt, ap)):
+                            return name, ERR_RWOP_CONFLICT
+            elif name == "VolumeBinding":
+                reject, _, _ = self._vb_classified(pod)
+                if reject is not None:
+                    return name, reject
+        return None
 
     def _filter_skip(self, name, pod) -> bool:
         if name == "NodePorts":
@@ -145,6 +320,25 @@ class SequentialScheduler:
             return not any(c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" for c in cs)
         if name == "InterPodAffinity":
             return self._interpod_filter_skip(pod)
+        if name == "VolumeRestrictions":
+            from ..plugins.volumerestrictions import pod_inline_disks, pod_rwop_keys
+
+            return not pod_inline_disks(pod) and not pod_rwop_keys(self.vt, pod)
+        if name in ("NodeVolumeLimits", "VolumeBinding"):
+            return not self._pod_pvcs(pod)
+        if name == "VolumeZone":
+            from ..state.volumes import ZONE_LABELS
+
+            for key in self._pod_pvcs(pod):
+                pvc = self.vt.pvcs.get(key)
+                if pvc is None or not pvc.volume_name:
+                    continue
+                vi = self.vt.pv_index.get(pvc.volume_name)
+                if vi is not None and any(
+                    zk in self.vt.pvs[vi].labels for zk in ZONE_LABELS
+                ):
+                    return False
+            return True
         return False
 
     def _score_skip(self, name, pod) -> bool:
@@ -207,6 +401,8 @@ class SequentialScheduler:
             return self._spread_score(pod, j)
         if name == "InterPodAffinity":
             return self._interpod_score(pod, j)
+        if name == "VolumeBinding":
+            return 0  # VolumeCapacityPriority off: scorer nil -> 0
         if name == "ImageLocality":
             from ..plugins import imagelocality
 
@@ -222,8 +418,9 @@ class SequentialScheduler:
     def _normalize(self, name, scores: dict[int, int], pod) -> dict[int, int]:
         if self.config.is_custom(name):
             return dict(scores)  # custom NormalizeScore unsupported (see custom.py)
-        if name in ("NodeResourcesFit", "NodeResourcesBalancedAllocation", "ImageLocality"):
-            return dict(scores)
+        if name in ("NodeResourcesFit", "NodeResourcesBalancedAllocation", "ImageLocality",
+                    "VolumeBinding"):
+            return dict(scores)  # no ScoreExtensions
         if name in ("NodeAffinity", "TaintToleration"):
             reverse = name == "TaintToleration"
             mx = max(scores.values(), default=0)
@@ -532,6 +729,32 @@ class SequentialScheduler:
         self._cycle = {}  # per-cycle PreFilter/PreScore state cache
         req, nz = pod_resource_request(pod, self.schema)
 
+        reject = self._prefilter_reject(pod)
+        if reject is not None:
+            rej_name, rej_msg = reject
+            pf: dict[str, str] = {}
+            for nm in cfg.prefilters():
+                if nm == rej_name:
+                    pf[nm] = rej_msg
+                    break
+                pf[nm] = "" if self._filter_skip(nm, pod) else ann.SUCCESS_MESSAGE
+            empty = ann.marshal({})
+            return {
+                ann.PRE_FILTER_STATUS_RESULT: ann.marshal(pf),
+                ann.PRE_FILTER_RESULT: empty,
+                ann.FILTER_RESULT: empty,
+                ann.POST_FILTER_RESULT: empty,
+                ann.PRE_SCORE_RESULT: empty,
+                ann.SCORE_RESULT: empty,
+                ann.FINAL_SCORE_RESULT: empty,
+                ann.RESERVE_RESULT: empty,
+                ann.PERMIT_STATUS_RESULT: empty,
+                ann.PERMIT_TIMEOUT_RESULT: empty,
+                ann.PRE_BIND_RESULT: empty,
+                ann.BIND_RESULT: empty,
+                ann.SELECTED_NODE: "",
+            }, -1
+
         prefilter_status = {
             name: ("" if self._filter_skip(name, pod) else ann.SUCCESS_MESSAGE)
             for name in cfg.prefilters()
@@ -586,6 +809,16 @@ class SequentialScheduler:
             self.nonzero[selected][1] += int(nz[1])
             self.num_pods[selected] += 1
             self.assigned.append((pod, selected))
+            if "VolumeBinding" in self.config.enabled and self._pod_pvcs(pod):
+                self._vb_bind(pod, selected)
+
+        vb_on = (
+            "VolumeBinding" in self.config.enabled
+            and not self.config.is_custom("VolumeBinding")
+        )
+        reserve_map = (
+            {"VolumeBinding": ann.SUCCESS_MESSAGE} if selected >= 0 and vb_on else {}
+        )
 
         annotations = {
             ann.PRE_FILTER_STATUS_RESULT: ann.marshal(prefilter_status),
@@ -595,10 +828,10 @@ class SequentialScheduler:
             ann.PRE_SCORE_RESULT: ann.marshal(prescore),
             ann.SCORE_RESULT: ann.marshal(score_map),
             ann.FINAL_SCORE_RESULT: ann.marshal(final_map),
-            ann.RESERVE_RESULT: ann.marshal({}),
+            ann.RESERVE_RESULT: ann.marshal(reserve_map),
             ann.PERMIT_STATUS_RESULT: ann.marshal({}),
             ann.PERMIT_TIMEOUT_RESULT: ann.marshal({}),
-            ann.PRE_BIND_RESULT: ann.marshal({}),
+            ann.PRE_BIND_RESULT: ann.marshal(reserve_map),
             ann.BIND_RESULT: ann.marshal(
                 {"DefaultBinder": ann.SUCCESS_MESSAGE} if selected >= 0 else {}
             ),

@@ -27,10 +27,14 @@ import numpy as np
 from . import resources as res
 from .nodes import NodeTable, build_node_table
 from .resources import ResourceSchema, pod_resource_request
+from .volumes import build_volume_table
 from ..plugins import registry as reg
 from ..plugins import (
-    affinity, imagelocality, interpod, noderesources, ports, taints, topologyspread,
+    affinity, imagelocality, interpod, noderesources, nodevolumelimits, ports,
+    taints, topologyspread, volumebinding, volumerestrictions, volumezone,
 )
+
+VOLUME_PLUGINS = ("VolumeRestrictions", "NodeVolumeLimits", "VolumeBinding", "VolumeZone")
 
 
 @dataclass
@@ -64,15 +68,19 @@ def compile_workload(
     pods: list[dict],
     config: reg.PluginSetConfig | None = None,
     bound_pods: list[tuple[dict, str]] | None = None,
+    volumes: dict | None = None,
 ) -> CompiledWorkload:
     """Compile (nodes, queue pods, already-bound pods) into device tensors.
 
     bound_pods: (pod manifest, node name) pairs folded into the initial
     carry; they also contribute to topology/affinity counts, like the
     existing cluster pods the reference scheduler sees via informers.
+    volumes: optional {"pvcs": [...], "pvs": [...], "storageclasses": [...],
+    "csinodes": [...]} manifest lists backing the volume plugin family.
     """
     config = config or reg.PluginSetConfig()
     bound_pods = bound_pods or []
+    volumes = volumes or {}
     schema = ResourceSchema.discover(pods + [bp for bp, _ in bound_pods], nodes)
     table = build_node_table(nodes, schema)
 
@@ -136,6 +144,45 @@ def compile_workload(
         xs["PodTopologySpread"] = x
         counts = _prime_spread_counts(counts, st, pods, bound_pods, name_idx)
         init_carry["PodTopologySpread"] = counts
+    if any(name in enabled for name in VOLUME_PLUGINS):
+        vt = build_volume_table(
+            table, volumes.get("pvcs"), volumes.get("pvs"),
+            volumes.get("storageclasses"), volumes.get("csinodes"),
+        )
+        host["volume_table"] = vt
+        # per-pod PreFilter rejects (UnschedulableAndUnresolvable), keyed
+        # by the plugin whose PreFilter reports them; the earliest enabled
+        # prefilter plugin in DEFAULT_ORDER wins at decode time
+        rejects: dict[str, list[str | None]] = {}
+        if "VolumeRestrictions" in enabled:
+            st, x, carry = volumerestrictions.build(vt, table, pods, bound_pods)
+            statics["VolumeRestrictions"] = st
+            xs["VolumeRestrictions"] = x
+            init_carry["VolumeRestrictions"] = carry
+            # upstream VolumeRestrictions' PreFilter does the PVC lister
+            # lookup first, so a missing PVC rejects there
+            rejects["VolumeRestrictions"] = [
+                _missing_pvc_message(vt, pod) for pod in pods
+            ]
+        if "NodeVolumeLimits" in enabled:
+            st, x, carry = nodevolumelimits.build(vt, table, pods, bound_pods)
+            statics["NodeVolumeLimits"] = st
+            xs["NodeVolumeLimits"] = x
+            init_carry["NodeVolumeLimits"] = carry
+        if "VolumeBinding" in enabled:
+            st, x, carry, vb_rejects = volumebinding.build(vt, table, pods, bound_pods)
+            statics["VolumeBinding"] = st
+            xs["VolumeBinding"] = x
+            init_carry["VolumeBinding"] = carry
+            rejects["VolumeBinding"] = vb_rejects
+        if "VolumeZone" in enabled:
+            xs["VolumeZone"] = volumezone.build(vt, table, pods)
+        if any(any(m is not None for m in msgs) for msgs in rejects.values()):
+            host["prefilter_reject"] = rejects
+            xs["force_unsched"] = jnp.asarray(np.asarray([
+                any(msgs[i] is not None for msgs in rejects.values())
+                for i in range(p)
+            ], dtype=bool))
     for name, plugin in config.custom.items():
         if name not in enabled:
             continue
@@ -171,6 +218,16 @@ def compile_workload(
     )
     _collect_host_flags(cw)
     return cw
+
+
+def _missing_pvc_message(vt, pod: dict) -> str | None:
+    """upstream volumerestrictions PreFilter: the PVC lister Get fails."""
+    from .volumes import pod_pvc_keys
+
+    for key in pod_pvc_keys(pod):
+        if key not in vt.pvcs:
+            return f'persistentvolumeclaim "{key.split("/", 1)[1]}" not found'
+    return None
 
 
 def _prime_spread_counts(counts, st, pods, bound_pods, name_idx):

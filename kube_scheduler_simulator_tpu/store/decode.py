@@ -23,7 +23,10 @@ import numpy as np
 
 from . import annotations as ann
 from ..framework.replay import ReplayResult
-from ..plugins import affinity, interpod, noderesources, ports, taints, topologyspread
+from ..plugins import (
+    affinity, interpod, noderesources, nodevolumelimits, ports, taints,
+    topologyspread, volumebinding, volumerestrictions, volumezone,
+)
 from ..plugins.registry import PLUGIN_REGISTRY
 
 
@@ -50,7 +53,29 @@ _DECODERS = {
     "NodePorts": lambda code, node, aux: ports.ERR_NODE_PORTS,
     "PodTopologySpread": topologyspread.decode_filter,
     "InterPodAffinity": interpod.decode_filter,
+    "VolumeRestrictions": lambda code, node, aux: volumerestrictions.ERR_DISK_CONFLICT,
+    "NodeVolumeLimits": lambda code, node, aux: nodevolumelimits.ERR_MAX_VOLUME_COUNT,
+    "VolumeBinding": lambda code, node, aux: volumebinding.decode_filter(code, node, aux),
+    "VolumeZone": lambda code, node, aux: volumezone.ERR_VOLUME_ZONE_CONFLICT,
 }
+
+
+def prefilter_reject_message(cw, i: int, dynamic_code: int) -> tuple[str, str] | None:
+    """(plugin name, message) of the PreFilter reject that aborted pod i's
+    cycle, or None.  Resolution follows upstream RunPreFilterPlugins: the
+    first rejecting plugin in config order wins; within VolumeRestrictions
+    the static (PVC-lister) reject precedes the dynamic ReadWriteOncePod
+    conflict."""
+    static = cw.host.get("prefilter_reject", {})
+    if not static and not dynamic_code:
+        return None
+    for name in cw.config.prefilters():
+        msgs = static.get(name)
+        if msgs is not None and msgs[i] is not None:
+            return name, msgs[i]
+        if name == "VolumeRestrictions" and (dynamic_code & 1):
+            return name, volumerestrictions.ERR_RWOP_CONFLICT
+    return None
 
 
 def decode_filter_message(name: str, code: int, node_idx: int, host_aux) -> str:
@@ -60,14 +85,20 @@ def decode_filter_message(name: str, code: int, node_idx: int, host_aux) -> str:
     return dec(code, node_idx, host_aux)
 
 
-def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None) -> dict[str, str]:
+def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None,
+                      host_index: int | None = None) -> dict[str, str]:
     """The 13 plugin annotations for pod i, values JSON-encoded as Go would.
 
     feasible_override: [N] bool — the extender path narrows feasibility
     after the plugin filters (upstream scores only nodes that survive the
     extender Filter round-trip too); overrides the feasibility derived
-    from the plugin filter codes for the score maps."""
+    from the plugin filter codes for the score maps.
+    host_index: index into the CompiledWorkload's per-pod host tables
+    (skip flags, static prefilter rejects) when it differs from `i` — the
+    extender path builds single-row ReplayResults (i=0) against the full
+    workload's cw."""
     cw = rr.cw
+    hi = i if host_index is None else host_index
     cfg = cw.config
     names = cw.node_table.names
     filter_names = cfg.filters()
@@ -75,14 +106,41 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None) -> dict[
     fskip = cw.host["filter_skip"]
     sskip = cw.host["score_skip"]
 
+    # --- prefilter reject: the cycle aborted before Filter --------------
+    reject = prefilter_reject_message(cw, hi, int(rr.prefilter_reject[i]))
+    if reject is not None:
+        rej_name, rej_msg = reject
+        pf: dict[str, str] = {}
+        for name in cfg.prefilters():
+            if name == rej_name:
+                pf[name] = rej_msg
+                break
+            pf[name] = "" if fskip[name][hi] else ann.SUCCESS_MESSAGE
+        empty = ann.marshal({})
+        return {
+            ann.PRE_FILTER_STATUS_RESULT: ann.marshal(pf),
+            ann.PRE_FILTER_RESULT: empty,
+            ann.FILTER_RESULT: empty,
+            ann.POST_FILTER_RESULT: empty,
+            ann.PRE_SCORE_RESULT: empty,
+            ann.SCORE_RESULT: empty,
+            ann.FINAL_SCORE_RESULT: empty,
+            ann.RESERVE_RESULT: empty,
+            ann.PERMIT_STATUS_RESULT: empty,
+            ann.PERMIT_TIMEOUT_RESULT: empty,
+            ann.PRE_BIND_RESULT: empty,
+            ann.BIND_RESULT: empty,
+            ann.SELECTED_NODE: "",
+        }
+
     # --- prefilter ------------------------------------------------------
     prefilter_status = {}
     for name in cfg.prefilters():
-        prefilter_status[name] = "" if fskip[name][i] else ann.SUCCESS_MESSAGE
+        prefilter_status[name] = "" if fskip[name][hi] else ann.SUCCESS_MESSAGE
 
     # --- filter (stop at first fail per node) ---------------------------
     active = [
-        (f, name) for f, name in enumerate(filter_names) if not fskip[name][i]
+        (f, name) for f, name in enumerate(filter_names) if not fskip[name][hi]
     ]
     codes = rr.filter_codes[i]  # [F, N]
 
@@ -91,7 +149,7 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None) -> dict[
     if native_ctx is not None:
         from . import native_decode
 
-        active_mask = np.asarray([not fskip[name][i] for name in filter_names], np.uint8)
+        active_mask = np.asarray([not fskip[name][hi] for name in filter_names], np.uint8)
         filter_json = native_decode.encode_filter(native_ctx, codes, active_mask)
     else:
         filter_map: dict[str, dict[str, str]] = {}
@@ -116,7 +174,7 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None) -> dict[
     final_json: str | None = None
     if feasible_count > 1:
         for name in cfg.prescorers():
-            prescore[name] = "" if sskip[name][i] else ann.SUCCESS_MESSAGE
+            prescore[name] = "" if sskip[name][hi] else ann.SUCCESS_MESSAGE
         feasible = (codes[[f for f, _ in active], :] == 0).all(axis=0) if active else None
         if feasible_override is not None:
             feasible = feasible_override
@@ -125,7 +183,7 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None) -> dict[
         if native_ctx is not None:
             from . import native_decode
 
-            sskip_mask = np.asarray([bool(sskip[name][i]) for name in score_names], np.uint8)
+            sskip_mask = np.asarray([bool(sskip[name][hi]) for name in score_names], np.uint8)
             feas = (
                 np.ones(len(names), np.uint8) if feasible is None
                 else np.asarray(feasible, np.uint8)
@@ -138,7 +196,7 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None) -> dict[
                     continue
                 se, fe = {}, {}
                 for s, name in enumerate(score_names):
-                    if sskip[name][i]:
+                    if sskip[name][hi]:
                         continue
                     se[name] = str(int(raw[s, n]))
                     fe[name] = str(int(fin[s, n]))
@@ -150,6 +208,15 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None) -> dict[
     sel = int(rr.selected[i])
     scheduled = sel >= 0
     bind = {"DefaultBinder": ann.SUCCESS_MESSAGE} if scheduled else {}
+    # VolumeBinding is the only default plugin implementing Reserve and
+    # PreBind (assume/bind the chosen PVs); the reference shim records
+    # "success" for each on the happy path
+    # (reference: simulator/scheduler/plugin/wrappedplugin.go:622-651, :653-700)
+    reserve: dict[str, str] = {}
+    prebind: dict[str, str] = {}
+    if scheduled and "VolumeBinding" in cfg.enabled and not cfg.is_custom("VolumeBinding"):
+        reserve["VolumeBinding"] = ann.SUCCESS_MESSAGE
+        prebind["VolumeBinding"] = ann.SUCCESS_MESSAGE
 
     return {
         ann.PRE_FILTER_STATUS_RESULT: ann.marshal(prefilter_status),
@@ -159,10 +226,10 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None) -> dict[
         ann.PRE_SCORE_RESULT: ann.marshal(prescore),
         ann.SCORE_RESULT: score_json if score_json is not None else ann.marshal(score_map),
         ann.FINAL_SCORE_RESULT: final_json if final_json is not None else ann.marshal(final_map),
-        ann.RESERVE_RESULT: ann.marshal({}),
+        ann.RESERVE_RESULT: ann.marshal(reserve),
         ann.PERMIT_STATUS_RESULT: ann.marshal({}),
         ann.PERMIT_TIMEOUT_RESULT: ann.marshal({}),
-        ann.PRE_BIND_RESULT: ann.marshal({}),
+        ann.PRE_BIND_RESULT: ann.marshal(prebind),
         ann.BIND_RESULT: ann.marshal(bind),
         ann.SELECTED_NODE: names[sel] if scheduled else "",
     }

@@ -14,7 +14,10 @@ import ctypes
 import numpy as np
 
 from ..native import get_lib, take_string
-from ..plugins import affinity, interpod, ports, taints, topologyspread
+from ..plugins import (
+    affinity, interpod, nodevolumelimits, ports, taints, topologyspread,
+    volumebinding, volumerestrictions, volumezone,
+)
 from ..plugins.noderesources import decode_fit_filter
 
 _MAX_FIT_LUT_BITS = 16
@@ -87,6 +90,20 @@ def build_context(cw):
         elif name == "InterPodAffinity":
             lut = [interpod.ERR_AFFINITY.encode(), interpod.ERR_ANTI_AFFINITY.encode(),
                    interpod.ERR_EXISTING_ANTI.encode()]
+            per_node.append(0)
+        elif name == "VolumeRestrictions":
+            lut = [volumerestrictions.ERR_DISK_CONFLICT.encode()]
+            per_node.append(0)
+        elif name == "NodeVolumeLimits":
+            lut = [nodevolumelimits.ERR_MAX_VOLUME_COUNT.encode()]
+            per_node.append(0)
+        elif name == "VolumeBinding":
+            # codes are a bitmask (1 node-conflict | 2 bind-conflict |
+            # 4 pv-not-exist); decode_filter renders every combination
+            lut = [volumebinding.decode_filter(c, 0, None).encode() for c in range(1, 8)]
+            per_node.append(0)
+        elif name == "VolumeZone":
+            lut = [volumezone.ERR_VOLUME_ZONE_CONFLICT.encode()]
             per_node.append(0)
         elif name in cw.host.get("custom_msgs", {}):
             lut = [m.encode() for m in cw.host["custom_msgs"][name]] or [b""]

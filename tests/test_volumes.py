@@ -1,0 +1,510 @@
+"""Volume plugin family tests: VolumeBinding, VolumeZone,
+VolumeRestrictions, NodeVolumeLimits.
+
+Semantics sources: upstream v1.32 volume plugins, recorded through the
+reference shim (reference: simulator/scheduler/plugin/wrappedplugin.go:
+491-518 PreFilter status recording, :523-548 Filter recording); annotation
+keys reference: simulator/scheduler/plugin/annotation/annotation.go:3-30.
+"""
+
+import json
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.plugins import (
+    nodevolumelimits, volumebinding, volumerestrictions, volumezone,
+)
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store import annotations as ann
+from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+
+def node(name, labels=None, cpu="8"):
+    lab = {"kubernetes.io/hostname": name}
+    lab.update(labels or {})
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name, "labels": lab},
+        "spec": {},
+        "status": {"allocatable": {"cpu": cpu, "memory": "16Gi", "pods": "110"}},
+    }
+
+
+def pod(name, pvcs=None, volumes=None, node_name=None):
+    p = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [{
+                "name": "c", "image": "app:v1",
+                "resources": {"requests": {"cpu": "100m"}},
+            }],
+            "volumes": [],
+        },
+        "status": {},
+    }
+    for claim in pvcs or []:
+        p["spec"]["volumes"].append(
+            {"name": f"v-{claim}", "persistentVolumeClaim": {"claimName": claim}}
+        )
+    p["spec"]["volumes"].extend(volumes or [])
+    if node_name:
+        p["spec"]["nodeName"] = node_name
+        p["status"]["phase"] = "Running"
+    return p
+
+
+def pvc(name, sc=None, volume_name=None, request="1Gi", modes=("ReadWriteOnce",)):
+    spec = {
+        "accessModes": list(modes),
+        "resources": {"requests": {"storage": request}},
+    }
+    if sc is not None:
+        spec["storageClassName"] = sc
+    if volume_name:
+        spec["volumeName"] = volume_name
+    return {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def pv(name, capacity="1Gi", sc="", modes=("ReadWriteOnce",), labels=None,
+       node_affinity_hosts=None, claim_ref=None, csi=None):
+    spec = {
+        "capacity": {"storage": capacity},
+        "accessModes": list(modes),
+        "storageClassName": sc,
+    }
+    if node_affinity_hosts:
+        spec["nodeAffinity"] = {"required": {"nodeSelectorTerms": [{
+            "matchExpressions": [{
+                "key": "kubernetes.io/hostname", "operator": "In",
+                "values": list(node_affinity_hosts),
+            }],
+        }]}}
+    if claim_ref:
+        spec["claimRef"] = {"namespace": "default", "name": claim_ref}
+    if csi:
+        spec["csi"] = csi
+    return {
+        "apiVersion": "v1", "kind": "PersistentVolume",
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": spec,
+    }
+
+
+def sc(name, wffc=True, provisioner="ebs.csi.aws.com", topo_zones=None, default=False):
+    obj = {
+        "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+        "metadata": {"name": name, "annotations": {}},
+        "provisioner": provisioner,
+        "volumeBindingMode": "WaitForFirstConsumer" if wffc else "Immediate",
+    }
+    if topo_zones:
+        obj["allowedTopologies"] = [{"matchLabelExpressions": [{
+            "key": "topology.kubernetes.io/zone", "values": list(topo_zones),
+        }]}]
+    if default:
+        obj["metadata"]["annotations"]["storageclass.kubernetes.io/is-default-class"] = "true"
+    return obj
+
+
+VOL_CFG = PluginSetConfig(enabled=[
+    "NodeResourcesFit", "VolumeRestrictions", "NodeVolumeLimits",
+    "VolumeBinding", "VolumeZone",
+])
+
+
+def parity(nodes, pods, volumes, cfg=None, chunk=4):
+    cfg = cfg or VOL_CFG
+    seq = SequentialScheduler(nodes, pods, PluginSetConfig(
+        enabled=list(cfg.enabled), weights=dict(cfg.weights)), volumes=volumes,
+    ).schedule_all()
+    rr = replay(compile_workload(nodes, pods, cfg, volumes=volumes), chunk=chunk)
+    for i, (sa, _) in enumerate(seq):
+        da = decode_pod_result(rr, i)
+        for k in sa:
+            assert da[k] == sa[k], f"pod {i} key {k}\n dev={da[k]}\n seq={sa[k]}"
+    return rr, seq
+
+
+def filter_entry(annotations, node_name):
+    return json.loads(annotations[ann.FILTER_RESULT]).get(node_name, {})
+
+
+# --------------------------------------------------------------------------
+# VolumeZone
+
+
+def test_volume_zone_conflict_and_skip():
+    nodes = [
+        node("n-east", {"topology.kubernetes.io/zone": "east"}),
+        node("n-west", {"topology.kubernetes.io/zone": "west"}),
+    ]
+    volumes = {
+        "pvcs": [pvc("data", sc="", volume_name="pv-east")],
+        "pvs": [pv("pv-east", labels={"topology.kubernetes.io/zone": "east"})],
+    }
+    pods = [pod("p1", pvcs=["data"]), pod("p2")]
+    rr, seq = parity(nodes, pods, volumes)
+    a0 = seq[0][0]
+    assert filter_entry(a0, "n-west")["VolumeZone"] == volumezone.ERR_VOLUME_ZONE_CONFLICT
+    assert filter_entry(a0, "n-east")["VolumeZone"] == ann.PASSED_FILTER_MESSAGE
+    # p2 has no PVCs: VolumeZone prefilter Skips ("")
+    pf = json.loads(seq[1][0][ann.PRE_FILTER_STATUS_RESULT])
+    assert pf["VolumeZone"] == ""
+    # comma-separated multi-zone value set passes any listed zone
+    volumes2 = {
+        "pvcs": [pvc("data", sc="", volume_name="pv-multi")],
+        "pvs": [pv("pv-multi", labels={"topology.kubernetes.io/zone": "west, east"})],
+    }
+    _, seq2 = parity(nodes, [pod("p1", pvcs=["data"])], volumes2)
+    a = seq2[0][0]
+    assert filter_entry(a, "n-west")["VolumeZone"] == ann.PASSED_FILTER_MESSAGE
+
+
+# --------------------------------------------------------------------------
+# VolumeBinding: bound PVs
+
+
+def test_bound_pv_node_affinity_conflict():
+    nodes = [node("n1"), node("n2")]
+    volumes = {
+        "pvcs": [pvc("data", sc="", volume_name="pv1")],
+        "pvs": [pv("pv1", node_affinity_hosts=["n1"])],
+    }
+    rr, seq = parity(nodes, [pod("p1", pvcs=["data"])], volumes)
+    a = seq[0][0]
+    assert filter_entry(a, "n2")["VolumeBinding"] == volumebinding.ERR_NODE_CONFLICT
+    assert a[ann.SELECTED_NODE] == "n1"
+    # Reserve/PreBind record VolumeBinding success on the happy path
+    assert json.loads(a[ann.RESERVE_RESULT]) == {"VolumeBinding": "success"}
+    assert json.loads(a[ann.PRE_BIND_RESULT]) == {"VolumeBinding": "success"}
+
+
+def test_bound_pvc_missing_pv():
+    nodes = [node("n1")]
+    volumes = {"pvcs": [pvc("data", sc="", volume_name="ghost")], "pvs": []}
+    rr, seq = parity(nodes, [pod("p1", pvcs=["data"])], volumes)
+    a = seq[0][0]
+    assert filter_entry(a, "n1")["VolumeBinding"] == volumebinding.ERR_PV_NOT_EXIST
+    assert a[ann.SELECTED_NODE] == ""
+
+
+# --------------------------------------------------------------------------
+# VolumeBinding: unbound WFFC claims, greedy claiming across the queue
+
+
+def test_wffc_static_binding_claims_smallest_pv_and_is_consumed():
+    nodes = [node("n1"), node("n2")]
+    volumes = {
+        "pvcs": [pvc("c1", sc="wffc-sc"), pvc("c2", sc="wffc-sc")],
+        "pvs": [
+            pv("pv-big", capacity="10Gi", sc="wffc-sc"),
+            pv("pv-small", capacity="2Gi", sc="wffc-sc"),
+        ],
+        "storageclasses": [sc("wffc-sc", wffc=True, provisioner="kubernetes.io/no-provisioner")],
+    }
+    pods = [pod("p1", pvcs=["c1"]), pod("p2", pvcs=["c2"]), ]
+    rr, seq = parity(nodes, pods, volumes)
+    # both bind (greedy: p1 takes pv-small, p2 takes pv-big)
+    assert seq[0][0][ann.SELECTED_NODE] != ""
+    assert seq[1][0][ann.SELECTED_NODE] != ""
+    # a third claimant finds no PV left and no provisioner
+    pods3 = pods + [pod("p3", pvcs=["c3"])]
+    volumes3 = dict(volumes)
+    volumes3["pvcs"] = volumes["pvcs"] + [pvc("c3", sc="wffc-sc")]
+    rr3, seq3 = parity(nodes, pods3, volumes3)
+    a3 = seq3[2][0]
+    assert filter_entry(a3, "n1")["VolumeBinding"] == volumebinding.ERR_BIND_CONFLICT
+    assert a3[ann.SELECTED_NODE] == ""
+
+
+def test_wffc_pv_node_affinity_restricts_placement():
+    nodes = [node("n1"), node("n2")]
+    volumes = {
+        "pvcs": [pvc("c1", sc="local-sc")],
+        "pvs": [pv("pv-n2", sc="local-sc", node_affinity_hosts=["n2"])],
+        "storageclasses": [sc("local-sc", wffc=True, provisioner="kubernetes.io/no-provisioner")],
+    }
+    rr, seq = parity(nodes, [pod("p1", pvcs=["c1"])], volumes)
+    a = seq[0][0]
+    assert filter_entry(a, "n1")["VolumeBinding"] == volumebinding.ERR_BIND_CONFLICT
+    assert a[ann.SELECTED_NODE] == "n2"
+
+
+def test_wffc_dynamic_provisioning_allowed_topologies():
+    nodes = [
+        node("n-east", {"topology.kubernetes.io/zone": "east"}),
+        node("n-west", {"topology.kubernetes.io/zone": "west"}),
+    ]
+    volumes = {
+        "pvcs": [pvc("c1", sc="prov-sc")],
+        "pvs": [],
+        "storageclasses": [sc("prov-sc", wffc=True, topo_zones=["east"])],
+    }
+    rr, seq = parity(nodes, [pod("p1", pvcs=["c1"])], volumes)
+    a = seq[0][0]
+    assert filter_entry(a, "n-west")["VolumeBinding"] == volumebinding.ERR_BIND_CONFLICT
+    assert a[ann.SELECTED_NODE] == "n-east"
+
+
+def test_prebound_pv_claimref_matches_only_its_claim():
+    nodes = [node("n1")]
+    volumes = {
+        "pvcs": [pvc("mine", sc="wffc-sc"), pvc("other", sc="wffc-sc")],
+        "pvs": [pv("pv1", sc="wffc-sc", claim_ref="mine")],
+        "storageclasses": [sc("wffc-sc", wffc=True, provisioner="kubernetes.io/no-provisioner")],
+    }
+    # claimRef'd PVs are pre-claimed (claimed0): "other" cannot take pv1
+    rr, seq = parity(nodes, [pod("p-other", pvcs=["other"])], volumes)
+    a = seq[0][0]
+    assert filter_entry(a, "n1")["VolumeBinding"] == volumebinding.ERR_BIND_CONFLICT
+
+
+# --------------------------------------------------------------------------
+# PreFilter rejects
+
+
+def test_unbound_immediate_pvc_rejects_at_prefilter():
+    nodes = [node("n1")]
+    volumes = {
+        "pvcs": [pvc("c1", sc="imm-sc")],
+        "storageclasses": [sc("imm-sc", wffc=False)],
+    }
+    rr, seq = parity(nodes, [pod("p1", pvcs=["c1"])], volumes)
+    a = seq[0][0]
+    pf = json.loads(a[ann.PRE_FILTER_STATUS_RESULT])
+    assert pf["VolumeBinding"] == volumebinding.ERR_UNBOUND_IMMEDIATE
+    # cycle aborted: no filter/score/bind results, no entries after the
+    # rejecting plugin
+    assert json.loads(a[ann.FILTER_RESULT]) == {}
+    assert json.loads(a[ann.BIND_RESULT]) == {}
+    assert a[ann.SELECTED_NODE] == ""
+
+
+def test_missing_pvc_rejects_at_volumerestrictions():
+    nodes = [node("n1")]
+    rr, seq = parity(nodes, [pod("p1", pvcs=["ghost"])], {"pvcs": []})
+    a = seq[0][0]
+    pf = json.loads(a[ann.PRE_FILTER_STATUS_RESULT])
+    # VolumeRestrictions' PreFilter does the PVC lister lookup first
+    assert pf["VolumeRestrictions"] == 'persistentvolumeclaim "ghost" not found'
+    assert "VolumeBinding" not in pf
+
+
+def test_rwop_conflict_is_dynamic_across_the_queue():
+    nodes = [node("n1"), node("n2")]
+    volumes = {
+        "pvcs": [pvc("exclusive", sc="", volume_name="pv1", modes=("ReadWriteOncePod",))],
+        "pvs": [pv("pv1", modes=("ReadWriteOncePod",), claim_ref="exclusive")],
+    }
+    pods = [pod("p1", pvcs=["exclusive"]), pod("p2", pvcs=["exclusive"])]
+    rr, seq = parity(nodes, pods, volumes)
+    assert seq[0][0][ann.SELECTED_NODE] != ""
+    a2 = seq[1][0]
+    pf = json.loads(a2[ann.PRE_FILTER_STATUS_RESULT])
+    assert pf["VolumeRestrictions"] == volumerestrictions.ERR_RWOP_CONFLICT
+    assert a2[ann.SELECTED_NODE] == ""
+    assert int(rr.prefilter_reject[1]) & 1
+
+
+# --------------------------------------------------------------------------
+# VolumeRestrictions: inline disks
+
+
+def test_inline_gce_disk_conflict_readonly_exemption():
+    nodes = [node("n1")]
+    gce_rw = {"name": "d", "gcePersistentDisk": {"pdName": "disk-1"}}
+    gce_ro = {"name": "d", "gcePersistentDisk": {"pdName": "disk-1", "readOnly": True}}
+    # writer on node, second writer conflicts
+    pods = [pod("p1", volumes=[gce_rw]), pod("p2", volumes=[gce_rw])]
+    rr, seq = parity(nodes, pods, {})
+    a2 = seq[1][0]
+    assert filter_entry(a2, "n1")["VolumeRestrictions"] == volumerestrictions.ERR_DISK_CONFLICT
+    # both read-only: no conflict
+    pods_ro = [pod("p1", volumes=[gce_ro]), pod("p2", volumes=[gce_ro])]
+    rr2, seq2 = parity(nodes, pods_ro, {})
+    assert seq2[1][0][ann.SELECTED_NODE] == "n1"
+    # AWS EBS conflicts even read-only vs read-only
+    ebs_ro = {"name": "d", "awsElasticBlockStore": {"volumeID": "vol-1", "readOnly": True}}
+    pods_ebs = [pod("p1", volumes=[ebs_ro]), pod("p2", volumes=[ebs_ro])]
+    rr3, seq3 = parity(nodes, pods_ebs, {})
+    assert (
+        filter_entry(seq3[1][0], "n1")["VolumeRestrictions"]
+        == volumerestrictions.ERR_DISK_CONFLICT
+    )
+
+
+# --------------------------------------------------------------------------
+# NodeVolumeLimits
+
+
+def test_csi_volume_limits():
+    nodes = [node("n1"), node("n2")]
+    csinode = {
+        "apiVersion": "storage.k8s.io/v1", "kind": "CSINode",
+        "metadata": {"name": "n1"},
+        "spec": {"drivers": [{"name": "ebs.csi.aws.com", "allocatable": {"count": 1}}]},
+    }
+    volumes = {
+        "pvcs": [
+            pvc("c1", sc="", volume_name="pv1"),
+            pvc("c2", sc="", volume_name="pv2"),
+        ],
+        "pvs": [
+            pv("pv1", claim_ref="c1", csi={"driver": "ebs.csi.aws.com", "volumeHandle": "h1"}),
+            pv("pv2", claim_ref="c2", csi={"driver": "ebs.csi.aws.com", "volumeHandle": "h2"}),
+        ],
+        "csinodes": [csinode],
+    }
+    pods = [pod("p1", pvcs=["c1"]), pod("p2", pvcs=["c2"])]
+    rr, seq = parity(nodes, pods, volumes)
+    # p1 takes n1 or n2; p2 must avoid whichever holds a volume if limit 1
+    a1, a2 = seq[0][0], seq[1][0]
+    assert a1[ann.SELECTED_NODE] != ""
+    assert a2[ann.SELECTED_NODE] != ""
+    if a1[ann.SELECTED_NODE] == "n1":
+        assert (
+            filter_entry(a2, "n1").get("NodeVolumeLimits")
+            == nodevolumelimits.ERR_MAX_VOLUME_COUNT
+        )
+        assert a2[ann.SELECTED_NODE] == "n2"
+    # n2 has no CSINode: never limited
+    assert "NodeVolumeLimits" not in filter_entry(a1, "n2") or \
+        filter_entry(a1, "n2")["NodeVolumeLimits"] == ann.PASSED_FILTER_MESSAGE
+
+
+def test_same_volume_shared_counts_once():
+    nodes = [node("n1")]
+    csinode = {
+        "apiVersion": "storage.k8s.io/v1", "kind": "CSINode",
+        "metadata": {"name": "n1"},
+        "spec": {"drivers": [{"name": "ebs.csi.aws.com", "allocatable": {"count": 1}}]},
+    }
+    volumes = {
+        "pvcs": [pvc("shared", sc="", volume_name="pv1", modes=("ReadWriteMany",))],
+        "pvs": [pv("pv1", modes=("ReadWriteMany",), claim_ref="shared",
+                   csi={"driver": "ebs.csi.aws.com", "volumeHandle": "h1"})],
+        "csinodes": [csinode],
+    }
+    pods = [pod("p1", pvcs=["shared"]), pod("p2", pvcs=["shared"])]
+    rr, seq = parity(nodes, pods, volumes)
+    # the same volume on the node counts once: p2 still fits
+    assert seq[0][0][ann.SELECTED_NODE] == "n1"
+    assert seq[1][0][ann.SELECTED_NODE] == "n1"
+
+
+# --------------------------------------------------------------------------
+# default StorageClass resolution + full-default-config parity
+
+
+def test_bound_pod_wffc_claims_survive_recompile():
+    """A pod bound in an earlier wave re-claims its greedy PV choice when
+    the workload recompiles (prime_claims), so a later pod can't take it."""
+    nodes = [node("n1")]
+    volumes = {
+        "pvcs": [pvc("c1", sc="wffc-sc"), pvc("c2", sc="wffc-sc")],
+        "pvs": [pv("pv-only", sc="wffc-sc")],
+        "storageclasses": [sc("wffc-sc", wffc=True, provisioner="kubernetes.io/no-provisioner")],
+    }
+    bound = [(pod("p1", pvcs=["c1"], node_name="n1"), "n1")]
+    pods = [pod("p2", pvcs=["c2"])]
+    seq = SequentialScheduler(
+        nodes, pods, PluginSetConfig(enabled=list(VOL_CFG.enabled)),
+        bound_pods=bound, volumes=volumes,
+    ).schedule_all()
+    rr = replay(
+        compile_workload(nodes, pods, VOL_CFG, bound_pods=bound, volumes=volumes),
+        chunk=1,
+    )
+    a = seq[0][0]
+    assert filter_entry(a, "n1")["VolumeBinding"] == volumebinding.ERR_BIND_CONFLICT
+    assert a[ann.SELECTED_NODE] == ""
+    da = decode_pod_result(rr, 0)
+    assert da[ann.FILTER_RESULT] == a[ann.FILTER_RESULT]
+    assert int(rr.selected[0]) == -1
+
+
+def test_csi_limit_overfull_node_accepts_no_new_volume_pods():
+    """A node already over its CSINode limit still accepts pods that add
+    no new volume for that driver (upstream checks newVolumes only)."""
+    nodes = [node("n1")]
+    csinode = {
+        "apiVersion": "storage.k8s.io/v1", "kind": "CSINode",
+        "metadata": {"name": "n1"},
+        "spec": {"drivers": [{"name": "ebs.csi.aws.com", "allocatable": {"count": 1}}]},
+    }
+    volumes = {
+        "pvcs": [
+            pvc("a", sc="", volume_name="pv-a"),
+            pvc("b", sc="", volume_name="pv-b"),
+            pvc("shared", sc="", volume_name="pv-a", modes=("ReadWriteMany",)),
+        ],
+        "pvs": [
+            pv("pv-a", modes=("ReadWriteMany",),
+               csi={"driver": "ebs.csi.aws.com", "volumeHandle": "h-a"}),
+            pv("pv-b", csi={"driver": "ebs.csi.aws.com", "volumeHandle": "h-b"}),
+        ],
+        "csinodes": [csinode],
+    }
+    # two bound pods put the node at 2 volumes > limit 1 (bound pods bypass
+    # filters); a pod reusing volume h-a adds nothing new and still fits
+    bound = [
+        (pod("pa", pvcs=["a"], node_name="n1"), "n1"),
+        (pod("pb", pvcs=["b"], node_name="n1"), "n1"),
+    ]
+    pods = [pod("p-reuse", pvcs=["shared"])]
+    seq = SequentialScheduler(
+        nodes, pods, PluginSetConfig(enabled=list(VOL_CFG.enabled)),
+        bound_pods=bound, volumes=volumes,
+    ).schedule_all()
+    rr = replay(
+        compile_workload(nodes, pods, VOL_CFG, bound_pods=bound, volumes=volumes),
+        chunk=1,
+    )
+    assert seq[0][0][ann.SELECTED_NODE] == "n1"
+    assert int(rr.selected[0]) == 0
+
+
+def test_default_storageclass_applies_to_nil_class_pvc():
+    nodes = [node("n1")]
+    volumes = {
+        "pvcs": [pvc("c1")],  # no storageClassName
+        "storageclasses": [sc("the-default", wffc=True, default=True)],
+    }
+    rr, seq = parity(nodes, [pod("p1", pvcs=["c1"])], volumes)
+    # default class is WFFC with a provisioner: pod schedules via provisioning
+    assert seq[0][0][ann.SELECTED_NODE] == "n1"
+
+
+def test_volume_plugins_in_default_config_parity():
+    """Full default plugin set over a mixed volume workload."""
+    nodes = [
+        node("n1", {"topology.kubernetes.io/zone": "east"}),
+        node("n2", {"topology.kubernetes.io/zone": "west"}),
+        node("n3", {"topology.kubernetes.io/zone": "east"}),
+    ]
+    volumes = {
+        "pvcs": [
+            pvc("bound-east", sc="", volume_name="pv-east"),
+            pvc("wffc-1", sc="wffc-sc"),
+            pvc("wffc-2", sc="wffc-sc"),
+        ],
+        "pvs": [
+            pv("pv-east", labels={"topology.kubernetes.io/zone": "east"},
+               node_affinity_hosts=["n1", "n3"], claim_ref="bound-east"),
+            pv("pv-free", sc="wffc-sc", capacity="5Gi"),
+        ],
+        "storageclasses": [sc("wffc-sc", wffc=True, provisioner="kubernetes.io/no-provisioner")],
+    }
+    pods = [
+        pod("p-zone", pvcs=["bound-east"]),
+        pod("p-w1", pvcs=["wffc-1"]),
+        pod("p-w2", pvcs=["wffc-2"]),
+        pod("p-plain"),
+    ]
+    parity(nodes, pods, volumes, cfg=PluginSetConfig(), chunk=2)
