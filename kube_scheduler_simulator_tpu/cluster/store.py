@@ -188,20 +188,16 @@ class ObjectStore:
     def list(self, resource: str, namespace: str | None = None,
              label_selector: dict | None = None) -> tuple[list[dict], int]:
         """-> (items, list resourceVersion)."""
-        from ..state.selectors import label_selector_matches
+        from ..state.selectors import object_matches_label_selector
 
         with self._lock:
             items = []
             for key, obj in sorted(self._objects[resource].items()):
                 if namespace and (obj["metadata"].get("namespace") or "default") != namespace:
                     continue
-                if label_selector is not None:
-                    labels = {
-                        k: str(v)
-                        for k, v in (obj["metadata"].get("labels") or {}).items()
-                    }
-                    if not label_selector_matches(label_selector, labels):
-                        continue
+                if label_selector is not None and not object_matches_label_selector(
+                        label_selector, obj):
+                    continue
                 items.append(copy.deepcopy(obj))
             return items, self._last_rv
 

@@ -1,0 +1,7 @@
+"""Process entry points, mirroring the reference's cmd/ binaries
+(reference: simulator/cmd/{simulator,scheduler,sched-recorder}):
+
+  python -m kube_scheduler_simulator_tpu.cmd.simulator       — simulator server
+  python -m kube_scheduler_simulator_tpu.cmd.scheduler       — standalone debuggable scheduler
+  python -m kube_scheduler_simulator_tpu.cmd.sched_recorder  — recorder CLI
+"""

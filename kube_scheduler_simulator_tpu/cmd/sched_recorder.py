@@ -1,0 +1,45 @@
+"""Standalone recorder CLI (reference: simulator/cmd/sched-recorder/recorder.go:31-93).
+
+Watches the 7 resource kinds on a (simulated or remote) cluster and
+appends JSON-lines records to --path.  Flags mirror the reference:
+--path is required; --kubeconfig points at the cluster (here: the
+simulator server's URL instead of a kubeconfig file); --duration limits
+the recording (0 = until SIGINT, the reference's behavior without
+--duration).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="sched-recorder")
+    ap.add_argument("--path", required=True, help="record file to write (JSON lines)")
+    ap.add_argument("--kubeconfig", default="http://localhost:1212",
+                    help="cluster to record: simulator server URL")
+    ap.add_argument("--duration", type=float, default=0,
+                    help="seconds to record; 0 records until SIGINT")
+    args = ap.parse_args(argv)
+
+    from ..cluster.remote import RemoteCluster
+    from ..services.recorder import RecorderService
+
+    remote = RemoteCluster(args.kubeconfig)
+    recorder = RecorderService(remote, args.path)
+    recorder.run()
+    print(f"recording {args.kubeconfig} -> {args.path}")
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait(args.duration if args.duration > 0 else None)
+    recorder.stop()
+    remote.close()
+    print("recording stopped")
+
+
+if __name__ == "__main__":
+    main()

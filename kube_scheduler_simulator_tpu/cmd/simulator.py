@@ -1,0 +1,40 @@
+"""Simulator-server main (reference: simulator/cmd/simulator/simulator.go:36-141).
+
+Loads the simulator configuration (env overrides config.yaml), builds the
+DI container, optionally runs one-shot import / replay / sync, then
+serves the HTTP API.  With externalSchedulerEnabled the in-process
+scheduling loop stays off — the KWOK `disableKubeScheduler: true`
+analogue (reference: kwok.yaml:3-8) — so a standalone cmd/scheduler
+process drives scheduling over the HTTP API instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="simulator")
+    ap.add_argument("--config", default="./config.yaml",
+                    help="simulator config.yaml path (env vars override)")
+    args = ap.parse_args(argv)
+
+    from ..config.config import load_config
+    from ..server.di import DIContainer
+    from ..server.server import SimulatorServer
+
+    cfg = load_config(args.config)
+    di = DIContainer(cfg, start_scheduler=not cfg.external_scheduler_enabled)
+    if di.importer:
+        di.importer.import_cluster_resources(cfg.resource_import_label_selector or None)
+    if di.replayer:
+        di.replayer.replay()
+    if di.syncer:
+        di.syncer.run()
+    server = SimulatorServer(di)
+    print(f"kube-scheduler-simulator (TPU) listening on :{server.port}")
+    server.start(block=True)
+
+
+if __name__ == "__main__":
+    main()

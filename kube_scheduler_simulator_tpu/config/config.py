@@ -40,6 +40,10 @@ class SimulatorConfiguration:
     replayer_enabled: bool = False
     record_file_path: str = ""
     kube_config: str = ""
+    # KWOK `disableKubeScheduler: true` analogue (reference: kwok.yaml:3-8):
+    # leave the in-process scheduling loop off so a standalone
+    # cmd/scheduler process drives scheduling over the HTTP API
+    external_scheduler_enabled: bool = False
 
     def validate(self) -> None:
         if sum([self.external_import_enabled, self.resource_sync_enabled,
@@ -81,6 +85,7 @@ def load_config(path: str = "./config.yaml") -> SimulatorConfiguration:
         cfg.replayer_enabled = bool(raw.get("replayEnabled", raw.get("replayerEnabled", False)))
         cfg.record_file_path = raw.get("recordFilePath") or ""
         cfg.kube_config = raw.get("kubeConfig") or ""
+        cfg.external_scheduler_enabled = bool(raw.get("externalSchedulerEnabled", False))
 
     env = os.environ
     if env.get("PORT"):
@@ -98,6 +103,8 @@ def load_config(path: str = "./config.yaml") -> SimulatorConfiguration:
     cfg.replayer_enabled = _env_bool("REPLAYER_ENABLED", cfg.replayer_enabled)
     if env.get("RECORD_FILE_PATH"):
         cfg.record_file_path = env["RECORD_FILE_PATH"]
+    cfg.external_scheduler_enabled = _env_bool(
+        "EXTERNAL_SCHEDULER_ENABLED", cfg.external_scheduler_enabled)
 
     cfg.validate()
     return cfg

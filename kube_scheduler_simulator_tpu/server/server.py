@@ -32,23 +32,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..cluster.store import ApiError, RESOURCES
-from ..services.resourcewatcher import StreamWriter
+from ..services.resourcewatcher import StreamWriter, WATCH_PARAMS
 from ..services.snapshot import SnapshotOptions
 from .di import DIContainer
 
 # query-param names per kind (reference: handler/watcher.go:26-34 — note
 # "namespaceLastResourceVersion" is singular in the reference)
-_WATCH_PARAMS = {
-    "pods": "podsLastResourceVersion",
-    "nodes": "nodesLastResourceVersion",
-    "persistentvolumes": "pvsLastResourceVersion",
-    "persistentvolumeclaims": "pvcsLastResourceVersion",
-    "storageclasses": "scsLastResourceVersion",
-    "priorityclasses": "pcsLastResourceVersion",
-    "namespaces": "namespaceLastResourceVersion",
-}
-
-
 class SimulatorServer:
     def __init__(self, di: DIContainer, port: int | None = None):
         self.di = di
@@ -186,7 +175,7 @@ def _make_handler(di: DIContainer):
         def _list_watch(self, url):
             params = parse_qs(url.query)
             lrv = {}
-            for resource, param in _WATCH_PARAMS.items():
+            for resource, param in WATCH_PARAMS.items():
                 v = params.get(param, [""])[0]
                 if v:
                     lrv[resource] = int(v)
@@ -253,19 +242,12 @@ def _make_handler(di: DIContainer):
 
 
 def main():
-    from ..config.config import load_config
+    # single boot path lives in cmd/simulator.py (the reference's
+    # cmd/simulator/simulator.go); this alias keeps
+    # `python -m kube_scheduler_simulator_tpu.server` working
+    from ..cmd.simulator import main as _main
 
-    cfg = load_config()
-    di = DIContainer(cfg)
-    if di.importer:
-        di.importer.import_cluster_resources(cfg.resource_import_label_selector or None)
-    if di.replayer:
-        di.replayer.replay()
-    if di.syncer:
-        di.syncer.run()
-    server = SimulatorServer(di)
-    print(f"kube-scheduler-simulator (TPU) listening on :{server.port}")
-    server.start(block=True)
+    _main()
 
 
 if __name__ == "__main__":

@@ -18,6 +18,18 @@ import threading
 
 from ..cluster.store import ObjectStore, RESOURCES, ADDED
 
+# wire protocol: per-kind *LastResourceVersion query params a client passes
+# to resume (reference: server/handler/watcher.go:23-45 form values)
+WATCH_PARAMS = {
+    "pods": "podsLastResourceVersion",
+    "nodes": "nodesLastResourceVersion",
+    "persistentvolumes": "pvsLastResourceVersion",
+    "persistentvolumeclaims": "pvcsLastResourceVersion",
+    "storageclasses": "scsLastResourceVersion",
+    "priorityclasses": "pcsLastResourceVersion",
+    "namespaces": "namespaceLastResourceVersion",
+}
+
 
 class StreamWriter:
     """Serialises concurrent event writes onto one response stream
@@ -58,15 +70,22 @@ class ResourceWatcherService:
         for resource in self.resources:
             kind, _ = RESOURCES[resource]
             since = int(lrv.get(resource, 0))
-            # subscribe first so events between list and watch aren't lost
-            q = self.store.watch(resource, since_rv=since)
-            queues[resource] = q
             if since == 0:
-                items, _ = self.store.list(resource)
+                # initial listing, then watch from the listing's rv — NOT
+                # from 0, which would replay the event ring buffer on top
+                # of the listing and double-deliver every object.  Events
+                # racing in between are > list_rv and still buffered, so
+                # nothing is lost.
+                items, list_rv = self.store.list(resource)
+                q = self.store.watch(resource, since_rv=list_rv)
+                queues[resource] = q
                 for obj in items:
                     if not stream.send(kind, ADDED, obj):
                         self._cleanup(queues)
                         return
+            else:
+                q = self.store.watch(resource, since_rv=since)
+                queues[resource] = q
 
         threads = []
         dead = threading.Event()

@@ -92,6 +92,16 @@ def label_selector_matches(selector: dict | None, labels: dict[str, str]) -> boo
     return True
 
 
+def object_matches_label_selector(selector: dict | None, obj: dict) -> bool:
+    """label_selector_matches against an object's metadata.labels, with
+    values stringified the way the apiserver stores them."""
+    labels = {
+        k: str(v)
+        for k, v in (((obj.get("metadata") or {}).get("labels")) or {}).items()
+    }
+    return label_selector_matches(selector, labels)
+
+
 def toleration_tolerates(tol: dict, taint_key: str, taint_value: str, taint_effect: str) -> bool:
     """upstream v1.Toleration.ToleratesTaint."""
     if tol.get("effect") and tol["effect"] != taint_effect:
