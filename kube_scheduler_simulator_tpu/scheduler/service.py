@@ -27,6 +27,10 @@ class SchedulerService:
         # they live in the process (like the reference's compiled-in
         # WithPlugin factories) and survive every config restart/reset
         self._custom_plugins: dict[str, object] = {}
+        # guest plugins (wasm analogue, scheduler/guest.py) are config-
+        # declared, so they are reloaded on every restart rather than
+        # living for the process lifetime like compiled-in customs
+        self._guest_plugins: dict[str, object] = {}
         if engine is not None:
             engine.set_plugin_config(parse_plugin_set(self._current))
             self._apply_extenders(self._current)
@@ -47,20 +51,25 @@ class SchedulerService:
         if cfg is None:
             cfg = default_scheduler_config()
         old = self._current
+        old_guests = self._guest_plugins
         try:
+            from .guest import collect_guest_plugins
+
+            self._guest_plugins = collect_guest_plugins(cfg)
             plugin_set = self._with_customs(parse_plugin_set(cfg))
             if self.engine is not None:
                 self.engine.set_plugin_config(plugin_set)
                 self._apply_extenders(cfg)
             self._current = copy.deepcopy(cfg)
         except Exception:
+            self._guest_plugins = old_guests
             if self.engine is not None:
                 self.engine.set_plugin_config(self._with_customs(parse_plugin_set(old)))
                 self._apply_extenders(old)
             raise
 
     def _with_customs(self, plugin_set):
-        for name, p in self._custom_plugins.items():
+        for name, p in {**self._custom_plugins, **self._guest_plugins}.items():
             plugin_set.custom[name] = p
             if name not in plugin_set.enabled:
                 plugin_set.enabled.append(name)

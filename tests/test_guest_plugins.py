@@ -1,0 +1,105 @@
+"""Guest plugins (the wasm-extension analogue, scheduler/guest.py):
+config-declared out-of-tree plugins loaded from a file at restart, parity
+with reference RegisterWasmPlugins semantics (wasm.go:14-58)."""
+
+import json
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+from kube_scheduler_simulator_tpu.scheduler.guest import collect_guest_plugins
+from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+GUEST_SRC = '''
+from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+
+class Plugin(CustomPlugin):
+    default_weight = 1
+    def filter(self, pod, node):
+        idx = int(node["metadata"]["name"].rsplit("-", 1)[1])
+        return None if idx == 0 else "guest says no"
+'''
+
+GUEST_FACTORY_SRC = '''
+from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+
+def plugin(name, args):
+    class P(CustomPlugin):
+        def score(self, pod, node):
+            return int(args.get("bonus", 0))
+    return P()
+'''
+
+
+def _cfg_with_guest(path, name="MyGuest", enabled=True, args_extra=None):
+    mp = {"enabled": ([{"name": name}] if enabled else [])}
+    return {
+        "apiVersion": "kubescheduler.config.k8s.io/v1",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [{
+            "schedulerName": "default-scheduler",
+            "plugins": {"multiPoint": mp},
+            "pluginConfig": [
+                {"name": name,
+                 "args": {"guestURL": str(path), **(args_extra or {})}},
+            ],
+        }],
+    }
+
+
+def test_collect_only_enabled(tmp_path):
+    guest = tmp_path / "guest.py"
+    guest.write_text(GUEST_SRC)
+    out = collect_guest_plugins(_cfg_with_guest(guest, enabled=True))
+    assert list(out) == ["MyGuest"] and out["MyGuest"].name == "MyGuest"
+    # not multiPoint-enabled -> not registered (wasm.go:46-55)
+    assert collect_guest_plugins(_cfg_with_guest(guest, enabled=False)) == {}
+    # non-guest pluginConfig entries are skipped, not errors
+    assert collect_guest_plugins({"profiles": [{"pluginConfig": [
+        {"name": "NodeResourcesFit", "args": {"scoringStrategy": {}}}]}]}) == {}
+
+
+def test_guest_factory_and_args(tmp_path):
+    guest = tmp_path / "guest_factory.py"
+    guest.write_text(GUEST_FACTORY_SRC)
+    out = collect_guest_plugins(
+        _cfg_with_guest(guest, name="Bonus", args_extra={"bonus": 7}))
+    p = out["Bonus"]
+    assert p.name == "Bonus" and p.score({}, {}) == 7 and p.has_score
+
+
+def test_network_guest_url_rejected(tmp_path):
+    cfg = _cfg_with_guest("http://evil.example/p.py")
+    with pytest.raises(ValueError, match="file"):
+        collect_guest_plugins(cfg)
+
+
+def test_guest_end_to_end_and_rollback(tmp_path):
+    guest = tmp_path / "guest.py"
+    guest.write_text(GUEST_SRC)
+
+    store = ObjectStore()
+    engine = SchedulerEngine(store)
+    svc = SchedulerService(engine)
+    svc.restart_scheduler(_cfg_with_guest(guest))
+    assert "MyGuest" in engine.plugin_config.enabled
+
+    for n in make_nodes(3, seed=30):
+        store.create("nodes", n)
+    pod = make_pods(1, seed=31)[0]
+    store.create("pods", pod)
+    assert engine.schedule_pending() == 1
+    got = store.get("pods", pod["metadata"]["name"], pod["metadata"].get("namespace"))
+    # guest vetoes all but node 0, and its message lands in filter-result
+    assert got["spec"]["nodeName"] == "node-00000"
+    fr = json.loads(got["metadata"]["annotations"][ann.FILTER_RESULT])
+    assert fr["node-00001"]["MyGuest"] == "guest says no"
+
+    # a broken guest path fails the restart and rolls back (scheduler.go:102-108)
+    with pytest.raises(Exception):
+        svc.restart_scheduler(_cfg_with_guest(tmp_path / "missing.py"))
+    assert "MyGuest" in engine.plugin_config.enabled
+    assert svc.get_config()["profiles"][0]["pluginConfig"][0]["args"]["guestURL"] == str(guest)
