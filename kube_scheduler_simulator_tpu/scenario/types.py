@@ -1,0 +1,26 @@
+"""Scenario phases and step phases (KEP-140,
+keps/140-scenario-based-simulation/README.md ScenarioPhase/StepPhase)."""
+
+PHASE_PENDING = "Pending"
+PHASE_RUNNING = "Running"
+PHASE_PAUSED = "Paused"      # all operations done but no DoneOperation yet
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+PHASE_UNKNOWN = "Unknown"
+
+STEP_OPERATING = "Operating"
+STEP_OPERATING_COMPLETED = "OperatingCompleted"
+STEP_CONTROLLER_RUNNING = "ControllerRunning"
+STEP_CONTROLLER_COMPLETED = "ControllerCompleted"
+STEP_COMPLETED = "Finished"
+
+# resource-kind mapping for operation objects (kind -> store resource)
+KIND_TO_RESOURCE = {
+    "Namespace": "namespaces",
+    "PriorityClass": "priorityclasses",
+    "StorageClass": "storageclasses",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+    "Node": "nodes",
+    "PersistentVolume": "persistentvolumes",
+    "Pod": "pods",
+}

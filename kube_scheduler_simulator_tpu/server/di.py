@@ -16,6 +16,7 @@ import threading
 from ..cluster.store import ADDED, MODIFIED, ObjectStore
 from ..config.config import SimulatorConfiguration
 from ..framework.engine import SchedulerEngine
+from ..scenario.runner import ScenarioService
 from ..scheduler.service import SchedulerService
 from ..services.importer import OneShotImporter
 from ..services.recorder import RecorderService
@@ -96,6 +97,7 @@ class DIContainer:
         initial_scheduler_cfg = self.cfg.initial_scheduler_config()
         self.scheduler_service = SchedulerService(self.engine, initial_scheduler_cfg)
         self.snapshot_service = SnapshotService(self.store, self.scheduler_service)
+        self.scenario_service = ScenarioService(self.store, self.engine)
         self.reset_service = ResetService(self.store, self.scheduler_service)
         self.watcher_service = ResourceWatcherService(self.store)
 

@@ -146,6 +146,8 @@ def _make_handler(di: DIContainer):
                     return self._list_watch(url)
                 elif path.startswith("/api/v1/extender/") and method == "POST":
                     return self._extender(path)
+                elif path == "/api/v1/scenarios" or path.startswith("/api/v1/scenarios/"):
+                    return self._scenarios(method, path)
                 else:
                     m = re.fullmatch(r"/api/v1/([a-z]+)(?:/([^/]+))?(?:/([^/]+))?", path)
                     if m and m.group(1) in RESOURCES:
@@ -212,6 +214,27 @@ def _make_handler(di: DIContainer):
             except IndexError as e:
                 return self._json(400, {"message": str(e)})
             return self._json(200, result)
+
+        def _scenarios(self, method: str, path: str):
+            """KEP-140 scenario API (the Scenario CRD surface; the
+            reference's CRD is scaffold-only, scenario_types.go:27-64)."""
+            svc = di.scenario_service
+            name = path[len("/api/v1/scenarios/"):] if path != "/api/v1/scenarios" else ""
+            try:
+                if method == "GET" and not name:
+                    return self._json(200, {"items": svc.list()})
+                if method == "GET":
+                    return self._json(200, svc.get(name))
+                if method == "POST" and not name:
+                    return self._json(201, svc.create(self._body() or {}))
+                if method == "DELETE" and name:
+                    svc.delete(name)
+                    return self._json(200)
+            except KeyError:
+                return self._json(404, {"message": f"scenario {name!r} not found"})
+            except ValueError as e:
+                return self._json(400, {"message": str(e)})
+            return self._json(405, {"message": "method not allowed"})
 
         def _resource_crud(self, method: str, m, url):
             resource = m.group(1)

@@ -1,0 +1,129 @@
+"""Scenario-based simulation (KEP-140): step clock, operations, timeline,
+phases — against the live engine and over the HTTP API."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+from kube_scheduler_simulator_tpu.scenario import ScenarioService, merge_patch
+
+
+def _scenario(ops, name="s1"):
+    return {"metadata": {"name": name}, "spec": {"operations": ops}}
+
+
+def _pod(name):
+    return {"kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": name}, "spec": {"containers": [
+                {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}]}}
+
+
+def test_merge_patch_rfc7386():
+    assert merge_patch({"a": 1, "b": {"c": 2}}, {"b": {"c": None, "d": 3}}) == \
+        {"a": 1, "b": {"d": 3}}
+    assert merge_patch({"a": 1}, {"a": [1, 2]}) == {"a": [1, 2]}
+    assert merge_patch("x", {"a": 1}) == {"a": 1}
+
+
+def test_scenario_steps_schedule_and_succeed():
+    store = ObjectStore()
+    engine = SchedulerEngine(store)
+    svc = ScenarioService(store, engine)
+
+    node = make_nodes(1, seed=40)[0]
+    ops = [
+        {"step": 0, "createOperation": {"object": node}},
+        {"step": 0, "createOperation": {"object": _pod("p0")}},
+        {"step": 1, "createOperation": {"object": _pod("p1")}},
+        {"step": 1, "doneOperation": {}},
+    ]
+    svc.create(_scenario(ops), run=False)
+    sc = svc.run("s1")
+
+    assert sc["status"]["phase"] == "Succeeded"
+    tl = sc["status"]["scenarioResult"]["timeline"]
+    # step 0: node + pod creates + a generated PodScheduled event
+    kinds0 = [next(k for k in e if k not in ("id", "step")) for e in tl["0"]]
+    assert kinds0.count("create") == 2 and "podScheduled" in kinds0
+    sched0 = [e for e in tl["0"] if "podScheduled" in e][0]
+    assert sched0["podScheduled"]["pod"] == "default/p0"
+    assert sched0["podScheduled"]["node"] == node["metadata"]["name"]
+    # step 1: create + done + another PodScheduled
+    assert any("done" in e for e in tl["1"])
+    assert any("podScheduled" in e for e in tl["1"])
+    # both pods actually bound in the store
+    for pname in ("p0", "p1"):
+        assert store.get("pods", pname, "default")["spec"].get("nodeName")
+
+
+def test_scenario_patch_delete_and_paused():
+    store = ObjectStore()
+    svc = ScenarioService(store)  # no engine: pure state manipulation
+    node = make_nodes(1, seed=41)[0]
+    ops = [
+        {"step": 0, "createOperation": {"object": node}},
+        {"step": 1, "patchOperation": {
+            "typeMeta": {"kind": "Node"},
+            "objectMeta": {"name": node["metadata"]["name"]},
+            "patch": json.dumps({"metadata": {"labels": {"zone": "z9"}}}),
+        }},
+        {"step": 2, "deleteOperation": {
+            "typeMeta": {"kind": "Node"},
+            "objectMeta": {"name": node["metadata"]["name"]},
+        }},
+    ]
+    svc.create(_scenario(ops), run=False)
+    sc = svc.run("s1")
+    # no doneOperation -> Paused (more operations may be added)
+    assert sc["status"]["phase"] == "Paused"
+    tl = sc["status"]["scenarioResult"]["timeline"]
+    assert tl["1"][0]["patch"]["result"]["metadata"]["labels"]["zone"] == "z9"
+    assert "delete" in tl["2"][0]
+    assert store.list("nodes")[0] == []
+
+
+def test_scenario_invalid_operation_fails():
+    store = ObjectStore()
+    svc = ScenarioService(store)
+    svc.create(_scenario([{"step": 0}]), run=False)  # no op field set
+    sc = svc.run("s1")
+    assert sc["status"]["phase"] == "Failed"
+    assert "exactly one" in sc["status"]["message"]
+
+    svc.create(_scenario([{"step": 0, "createOperation": {"object": _pod("x")},
+                           "doneOperation": {}}], name="s2"), run=False)
+    assert svc.run("s2")["status"]["phase"] == "Failed"
+
+
+def test_scenario_http_api():
+    from kube_scheduler_simulator_tpu.config.config import SimulatorConfiguration
+    from kube_scheduler_simulator_tpu.server.di import DIContainer
+    from kube_scheduler_simulator_tpu.server.server import SimulatorServer
+
+    di = DIContainer(SimulatorConfiguration(port=0))
+    srv = SimulatorServer(di, port=0)
+    srv.start(block=False)
+    try:
+        base = f"http://127.0.0.1:{srv.port}/api/v1/scenarios"
+        node = make_nodes(1, seed=42)[0]
+        body = json.dumps(_scenario([
+            {"step": 0, "createOperation": {"object": node}},
+            {"step": 0, "createOperation": {"object": _pod("hp")}},
+            {"step": 0, "doneOperation": {}},
+        ], name="web")).encode()
+        req = urllib.request.Request(base, data=body, method="POST",
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 201
+        di.scenario_service.wait("web")
+        with urllib.request.urlopen(f"{base}/web", timeout=10) as r:
+            sc = json.load(r)
+        assert sc["status"]["phase"] == "Succeeded"
+        with urllib.request.urlopen(base, timeout=10) as r:
+            assert len(json.load(r)["items"]) == 1
+    finally:
+        srv.shutdown()
