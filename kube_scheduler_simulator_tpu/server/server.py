@@ -124,6 +124,8 @@ def _make_handler(di: DIContainer):
             url = urlparse(self.path)
             path = url.path.rstrip("/")
             try:
+                if path in ("", "/", "/ui") and method == "GET":
+                    return self._index()
                 if path == "/api/v1/schedulerconfiguration":
                     if method == "GET":
                         return self._json(200, di.scheduler_service.get_config())
@@ -214,6 +216,19 @@ def _make_handler(di: DIContainer):
             except IndexError as e:
                 return self._json(400, {"message": str(e)})
             return self._json(200, result)
+
+        def _index(self):
+            """Serve the web UI (the reference runs a separate Nuxt app on
+            :3000, compose.yml:43-52; here the same server hosts it)."""
+            from ..web import index_html
+
+            body = index_html()
+            self.send_response(200)
+            self._cors()
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def _scenarios(self, method: str, path: str):
             """KEP-140 scenario API (the Scenario CRD surface; the

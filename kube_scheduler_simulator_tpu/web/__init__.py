@@ -1,0 +1,19 @@
+"""Web UI: a dependency-free single-page app served by the simulator.
+
+Capability parity with the reference's Nuxt 2 frontend (reference:
+web/ — resource tables and editors per kind, scheduler-config editor,
+snapshot export/import, reset, a live watch stream consumer
+(web/api/v1/watcher.ts:11-12), and the scheduling-result annotation
+tables (web/components/lib/util.ts:30-44)).  Documented divergences:
+served by the simulator server itself at `/` instead of a separate
+Node process on :3000, and the manifest editor speaks JSON rather than
+monaco YAML.
+"""
+
+from pathlib import Path
+
+STATIC_DIR = Path(__file__).parent
+
+
+def index_html() -> bytes:
+    return (STATIC_DIR / "index.html").read_bytes()

@@ -134,3 +134,15 @@ def test_extender_route_without_extenders(server):
 def test_unknown_route_404(server):
     code, _ = req(server, "GET", "/api/v1/nosuch")
     assert code == 404
+
+
+def test_web_ui_served(server):
+    url = f"http://127.0.0.1:{server.port}/"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/html")
+        body = resp.read().decode()
+    # the SPA's load-bearing hooks: live watch, result tables, config panel
+    for needle in ("listwatchresources", "finalscore-result", "schedulerconfiguration",
+                   "watchLoop", "api/v1/scenarios"):
+        assert needle in body, needle
