@@ -20,6 +20,7 @@ import time
 
 from .replay import replay
 from ..cluster.store import Conflict, NotFound, ObjectStore
+from ..utils.tracing import TRACER
 from ..plugins.registry import PluginSetConfig
 from ..state.compile import compile_workload
 from ..store.decode import decode_pod_result
@@ -81,6 +82,8 @@ class SchedulerEngine:
         for _ in range(8):  # preemption retry bound; one wave normally
             bound, preempted = self._schedule_wave()
             n_bound += bound
+            if preempted:
+                TRACER.count("preemption_waves_total")
             if not preempted:
                 break
         return n_bound
@@ -118,39 +121,45 @@ class SchedulerEngine:
             "pvs": self.store.list("persistentvolumes")[0],
             "storageclasses": self.store.list("storageclasses")[0],
         }
-        cw = compile_workload(
-            nodes, pending, self.plugin_config, bound_pods=bound, volumes=volumes
-        )
+        with TRACER.span("compile_workload", pods=len(pending), nodes=len(nodes)):
+            cw = compile_workload(
+                nodes, pending, self.plugin_config, bound_pods=bound, volumes=volumes
+            )
         if self.extender_service is not None and self.extender_service.extenders:
             return self._schedule_with_extenders(cw, pending)
 
-        rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)))
+        with TRACER.span("device_replay", pods=len(pending), nodes=len(nodes)):
+            rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)))
         postfilter_on = bool(self.plugin_config.postfilters())
 
         n_bound = 0
         any_preempted = False
-        for i, pod in enumerate(pending):
-            meta = pod.get("metadata") or {}
-            ns, name = meta.get("namespace") or "default", meta.get("name", "")
-            annotations = decode_pod_result(rr, i)
-            self.result_store.put_decoded(ns, name, annotations)
-            for hook in self.plugin_extenders:
-                hook.after_cycle(pod, annotations, self.result_store)
-            sel = int(rr.selected[i])
-            if sel >= 0:
-                self._bind(ns, name, cw.node_table.names[sel])
-                n_bound += 1
-            else:
-                # PreFilter-rejected pods skip preemption: the static
-                # rejects are UnschedulableAndUnresolvable upstream, and
-                # ReadWriteOncePod preemption (preempting the PVC holder)
-                # is not modeled — documented divergence
-                if postfilter_on and int(rr.prefilter_reject[i]) == 0:
-                    any_preempted |= self._run_postfilter(
-                        cw, rr.filter_codes[i], i, pod, ns, name
-                    )
-                self._mark_unschedulable(ns, name)
-            self.reflector.reflect(ns, name)
+        with TRACER.span("commit_and_reflect", pods=len(pending)):
+            for i, pod in enumerate(pending):
+                meta = pod.get("metadata") or {}
+                ns, name = meta.get("namespace") or "default", meta.get("name", "")
+                annotations = decode_pod_result(rr, i)
+                self.result_store.put_decoded(ns, name, annotations)
+                for hook in self.plugin_extenders:
+                    hook.after_cycle(pod, annotations, self.result_store)
+                sel = int(rr.selected[i])
+                if sel >= 0:
+                    self._bind(ns, name, cw.node_table.names[sel])
+                    n_bound += 1
+                else:
+                    # PreFilter-rejected pods skip preemption: the static
+                    # rejects are UnschedulableAndUnresolvable upstream, and
+                    # ReadWriteOncePod preemption (preempting the PVC holder)
+                    # is not modeled — documented divergence
+                    if postfilter_on and int(rr.prefilter_reject[i]) == 0:
+                        any_preempted |= self._run_postfilter(
+                            cw, rr.filter_codes[i], i, pod, ns, name
+                        )
+                    self._mark_unschedulable(ns, name)
+                self.reflector.reflect(ns, name)
+        TRACER.count("pods_scheduled_total", n_bound)
+        TRACER.count("pods_unschedulable_total", len(pending) - n_bound)
+        TRACER.count("scheduling_waves_total")
         return n_bound, any_preempted
 
     def _run_postfilter(self, cw, filter_codes, pod_idx, pod, ns: str, name: str) -> bool:

@@ -126,6 +126,14 @@ def _make_handler(di: DIContainer):
             try:
                 if path in ("", "/", "/ui") and method == "GET":
                     return self._index()
+                if path == "/metrics" and method == "GET":
+                    return self._metrics_text()
+                if path == "/api/v1/metrics" and method == "GET":
+                    from ..utils.tracing import TRACER
+
+                    return self._json(200, TRACER.summary())
+                if path == "/api/v1/profile" and method == "POST":
+                    return self._profile()
                 if path == "/api/v1/schedulerconfiguration":
                     if method == "GET":
                         return self._json(200, di.scheduler_service.get_config())
@@ -216,6 +224,37 @@ def _make_handler(di: DIContainer):
             except IndexError as e:
                 return self._json(400, {"message": str(e)})
             return self._json(200, result)
+
+        def _metrics_text(self):
+            from ..utils.tracing import TRACER
+
+            body = TRACER.prometheus_text().encode()
+            self.send_response(200)
+            self._cors()
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _profile(self):
+            """POST /api/v1/profile {"action": "start", "logDir": ...} /
+            {"action": "stop"} — XLA profile capture around live
+            scheduling (additive observability, SURVEY.md §5)."""
+            from ..utils.tracing import TRACER
+
+            body = self._body() or {}
+            action = body.get("action")
+            try:
+                if action == "start":
+                    log_dir = body.get("logDir") or "/tmp/kss-tpu-profile"
+                    TRACER.start_xla_profile(log_dir)
+                    return self._json(200, {"profiling": True, "logDir": log_dir})
+                if action == "stop":
+                    d = TRACER.stop_xla_profile()
+                    return self._json(200, {"profiling": False, "logDir": d})
+            except RuntimeError as e:
+                return self._json(409, {"message": str(e)})
+            return self._json(400, {"message": "action must be start or stop"})
 
         def _index(self):
             """Serve the web UI (the reference runs a separate Nuxt app on

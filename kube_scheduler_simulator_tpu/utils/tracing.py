@@ -1,0 +1,123 @@
+"""Tracing and metrics for the scheduling engine.
+
+Additive over the reference (SURVEY.md §5: the reference has no tracing
+beyond the per-Pod annotation record; the upstream scheduler only
+blank-imports Prometheus registration, cmd/scheduler/scheduler.go:9-11).
+Here the TPU path gets real observability:
+
+- span timings (compile, device eval, bind, reflect, full wave) in a
+  bounded ring buffer with per-name aggregates;
+- counters (pods scheduled/unschedulable, preemptions, waves);
+- Prometheus text exposition + JSON, served at /metrics and
+  /api/v1/metrics by the simulator server;
+- optional XLA profile capture via jax.profiler (trace start/stop to a
+  directory TensorBoard/xprof can read).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+_PREFIX = "kss_tpu"
+
+
+class Tracer:
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._agg: dict[str, dict] = {}
+        self._counters: dict[str, float] = {}
+        self._profile_dir: str | None = None
+
+    # ------------------------------------------------------------- spans
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._events.append(
+                    {"name": name, "t": time.time(), "seconds": dt, **attrs}
+                )
+                a = self._agg.setdefault(
+                    name, {"count": 0, "total_seconds": 0.0, "max_seconds": 0.0}
+                )
+                a["count"] += 1
+                a["total_seconds"] += dt
+                a["max_seconds"] = max(a["max_seconds"], dt)
+
+    def count(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    # ------------------------------------------------------------ export
+
+    def events(self, limit: int = 200) -> list[dict]:
+        with self._lock:
+            evs = list(self._events)
+        return evs[-limit:]
+
+    def summary(self) -> dict:
+        with self._lock:
+            spans = {
+                k: {**v, "avg_seconds": v["total_seconds"] / max(v["count"], 1)}
+                for k, v in self._agg.items()
+            }
+            return {"spans": spans, "counters": dict(self._counters)}
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (the observable analogue of the
+        upstream scheduler's /metrics)."""
+        s = self.summary()
+        out = []
+        for name, v in sorted(s["counters"].items()):
+            m = f"{_PREFIX}_{name}"
+            out.append(f"# TYPE {m} counter")
+            out.append(f"{m} {v}")
+        for name, a in sorted(s["spans"].items()):
+            m = f"{_PREFIX}_span_{name}"
+            out.append(f"# TYPE {m}_seconds_total counter")
+            out.append(f"{m}_seconds_total {a['total_seconds']}")
+            out.append(f"# TYPE {m}_count counter")
+            out.append(f"{m}_count {a['count']}")
+            out.append(f"# TYPE {m}_seconds_max gauge")
+            out.append(f"{m}_seconds_max {a['max_seconds']}")
+        return "\n".join(out) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._agg.clear()
+            self._counters.clear()
+
+    # -------------------------------------------------------- XLA profile
+
+    def start_xla_profile(self, log_dir: str) -> None:
+        import jax
+
+        if self._profile_dir is not None:
+            raise RuntimeError(f"profile already running into {self._profile_dir}")
+        jax.profiler.start_trace(log_dir)
+        self._profile_dir = log_dir
+
+    def stop_xla_profile(self) -> str:
+        import jax
+
+        if self._profile_dir is None:
+            raise RuntimeError("no profile running")
+        jax.profiler.stop_trace()
+        d, self._profile_dir = self._profile_dir, None
+        return d
+
+    @property
+    def profiling(self) -> bool:
+        return self._profile_dir is not None
+
+
+TRACER = Tracer()

@@ -1,0 +1,73 @@
+"""Tracing/metrics subsystem: span aggregation, counters, Prometheus
+exposition, engine instrumentation, HTTP endpoints."""
+
+import json
+import urllib.request
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+from kube_scheduler_simulator_tpu.utils.tracing import TRACER, Tracer
+
+
+def test_tracer_spans_and_counters():
+    t = Tracer()
+    with t.span("phase", pods=3):
+        pass
+    with t.span("phase"):
+        pass
+    t.count("things_total", 5)
+    s = t.summary()
+    assert s["spans"]["phase"]["count"] == 2
+    assert s["spans"]["phase"]["total_seconds"] >= 0
+    assert s["counters"]["things_total"] == 5
+    text = t.prometheus_text()
+    assert "kss_tpu_things_total 5" in text
+    assert "kss_tpu_span_phase_count 2" in text
+    assert t.events()[-1]["name"] == "phase"
+    t.reset()
+    assert t.summary() == {"spans": {}, "counters": {}}
+
+
+def test_engine_emits_spans_and_counts():
+    TRACER.reset()
+    store = ObjectStore()
+    engine = SchedulerEngine(store)
+    for n in make_nodes(2, seed=70):
+        store.create("nodes", n)
+    for p in make_pods(3, seed=71):
+        store.create("pods", p)
+    engine.schedule_pending()
+    s = TRACER.summary()
+    for span in ("compile_workload", "device_replay", "commit_and_reflect"):
+        assert s["spans"][span]["count"] >= 1, span
+    assert s["counters"]["pods_scheduled_total"] == 3
+    assert s["counters"]["scheduling_waves_total"] >= 1
+
+
+def test_metrics_http_endpoints():
+    from kube_scheduler_simulator_tpu.config.config import SimulatorConfiguration
+    from kube_scheduler_simulator_tpu.server.di import DIContainer
+    from kube_scheduler_simulator_tpu.server.server import SimulatorServer
+
+    di = DIContainer(SimulatorConfiguration(port=0), start_scheduler=False)
+    srv = SimulatorServer(di, port=0)
+    srv.start(block=False)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/api/v1/metrics", timeout=10) as r:
+            s = json.load(r)
+            assert "spans" in s and "counters" in s
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            r.read()
+        req = urllib.request.Request(
+            base + "/api/v1/profile", data=json.dumps({"action": "nope"}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.shutdown()
