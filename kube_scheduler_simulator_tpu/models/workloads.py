@@ -198,11 +198,14 @@ BASELINE_CONFIGS = {
 }
 
 
-def baseline_config(idx: int, scale: float = 1.0, seed: int = 0):
+def baseline_config(idx: int, scale: float = 1.0, seed: int = 0,
+                    node_scale: float | None = None):
     """-> (nodes, pods, PluginSetConfig). scale shrinks pod/node counts for
-    tests and CPU-baseline measurement."""
+    tests and CPU-baseline measurement; node_scale (default: scale)
+    overrides the node-axis factor separately — the CPU baseline keeps
+    node_scale=1.0 so per-cycle cost reflects the real cluster size."""
     c = BASELINE_CONFIGS[idx]
-    n_nodes = max(int(c["nodes"] * scale), 2)
+    n_nodes = max(int(c["nodes"] * (scale if node_scale is None else node_scale)), 2)
     n_pods = max(int(c["pods"] * scale), 1)
     nodes = make_nodes(
         n_nodes, seed=seed,
