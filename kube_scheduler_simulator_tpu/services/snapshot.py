@@ -23,6 +23,7 @@ import copy
 from dataclasses import dataclass
 
 from ..cluster.store import AlreadyExists, ApiError, ObjectStore
+from ..utils.errgroup import SemaphoredErrGroup
 
 # JSON field -> store resource, in the apply order of the reference's Load
 _FIELDS = [
@@ -102,11 +103,26 @@ class SnapshotService:
                     raise
                 errors.append(str(e))
 
-        for field, resource in _FIELDS:
-            for obj in snapshot.get(field) or []:
-                name = (obj.get("metadata") or {}).get("name", "")
-                if resource == "namespaces" and _ignored_namespace(name):
+        # the reference's barrier structure (snapshot.go:154-192):
+        # namespaces ∥ → {pcs, scs, pvcs, nodes, pods} ∥ → pvs (which
+        # re-resolve PVC UIDs, so PVCs must exist first), each group a
+        # bounded-parallel fan-out
+        groups = [
+            {"namespaces"},
+            {"priorityclasses", "storageclasses", "persistentvolumeclaims",
+             "nodes", "pods"},
+            {"persistentvolumes"},
+        ]
+        for group in groups:
+            eg = SemaphoredErrGroup()
+            for field, resource in _FIELDS:
+                if resource not in group:
                     continue
-                if resource == "priorityclasses" and _ignored_priority_class(name):
-                    continue
-                apply(resource, obj)
+                for obj in snapshot.get(field) or []:
+                    name = (obj.get("metadata") or {}).get("name", "")
+                    if resource == "namespaces" and _ignored_namespace(name):
+                        continue
+                    if resource == "priorityclasses" and _ignored_priority_class(name):
+                        continue
+                    eg.go(apply, resource, obj)
+            eg.wait()

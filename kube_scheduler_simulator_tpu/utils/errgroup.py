@@ -1,0 +1,47 @@
+"""Bounded concurrent fan-out with first-error propagation.
+
+Capability parity with the reference's SemaphoredErrGroup (reference:
+simulator/util/semaphored_errgroup.go:17-41 — an errgroup whose Go()
+acquires one of GOMAXPROCS semaphore permits), used for snapshot
+list/apply fan-out and etcd restore (snapshot.go:103-136,
+reset/reset.go:63-78)."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class SemaphoredErrGroup:
+    def __init__(self, limit: int | None = None):
+        self._sem = threading.Semaphore(limit or os.cpu_count() or 4)
+        self._threads: list[threading.Thread] = []
+        self._err_lock = threading.Lock()
+        self._first_err: BaseException | None = None
+
+    def go(self, fn, *args, **kwargs) -> None:
+        """Run fn concurrently, holding one permit for its duration."""
+
+        def run():
+            try:
+                fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — errgroup captures all
+                with self._err_lock:
+                    if self._first_err is None:
+                        self._first_err = e
+            finally:
+                self._sem.release()
+
+        self._sem.acquire()
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def wait(self) -> None:
+        """Join everything; re-raise the first error (errgroup.Wait)."""
+        for t in self._threads:
+            t.join()
+        self._threads.clear()
+        if self._first_err is not None:
+            err, self._first_err = self._first_err, None
+            raise err
