@@ -1,0 +1,20 @@
+"""Example guest plugin (docs/integrate-your-scheduler.md): enable by
+declaring it in scheduler.yaml pluginConfig with guestURL + multiPoint."""
+
+from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+
+
+class Plugin(CustomPlugin):
+    default_weight = 1
+
+    def filter(self, pod, node):
+        # reject nodes labeled quarantine=true
+        labels = (node.get("metadata") or {}).get("labels") or {}
+        if str(labels.get("quarantine", "")).lower() == "true":
+            return "node is quarantined"
+        return None
+
+    def score(self, pod, node):
+        # prefer nodes with more allocatable pods
+        alloc = ((node.get("status") or {}).get("allocatable") or {})
+        return int(str(alloc.get("pods", "0")))
