@@ -108,14 +108,14 @@ class _SlimWorkload:
         self.n_nodes = cw.n_nodes
 
 
-def _scan_for(cw: CompiledWorkload, chunk: int):
-    key = _workload_scan_key(cw, chunk)
+def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1):
+    key = (*_workload_scan_key(cw, chunk), unroll)
     scan_jit = _SCAN_CACHE.get(key)
     if scan_jit is None:
         step = build_step(_SlimWorkload(cw))
 
         def scan_chunk(carry, xs_chunk):
-            return jax.lax.scan(step, carry, xs_chunk)
+            return jax.lax.scan(step, carry, xs_chunk, unroll=unroll)
 
         scan_jit = jax.jit(scan_chunk, donate_argnums=(0,))
         if len(_SCAN_CACHE) >= _SCAN_CACHE_MAX:
@@ -124,15 +124,19 @@ def _scan_for(cw: CompiledWorkload, chunk: int):
     return scan_jit
 
 
-def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True) -> ReplayResult:
+def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
+           unroll: int = 1) -> ReplayResult:
     """Run the full queue; returns host-side result arrays.
 
     collect=False skips device->host transfer of the per-node tensors
     (keeps selected/feasible only) — the benchmark's pure-throughput mode.
+    unroll: lax.scan unroll factor — trades compile time for lower
+    per-iteration overhead (the step's ops are tiny [N] vector ops, so
+    fixed per-op cost dominates; unrolling lets XLA pipeline iterations).
     """
     p = cw.n_pods
     chunk = min(chunk, max(p, 1))
-    scan_jit = _scan_for(cw, chunk)
+    scan_jit = _scan_for(cw, chunk, unroll)
 
     # copy: the scan donates its carry argument, and cw.init_carry must
     # survive for subsequent replays of the same compiled workload

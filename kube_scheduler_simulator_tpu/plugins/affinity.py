@@ -27,7 +27,11 @@ import numpy as np
 
 from .base import default_normalize_score
 from ..state.nodes import NodeTable
-from ..state.selectors import node_selector_matches, node_selector_term_matches
+from ..state.selectors import (
+    node_selector_matches,
+    node_selector_term_matches,
+    spec_key,
+)
 
 NAME = "NodeAffinity"
 ERR_REASON = "node(s) didn't match Pod's node affinity/selector"
@@ -48,6 +52,8 @@ def build(table: NodeTable, pods: list[dict]) -> NodeAffinityXS:
     filter_skip = np.zeros(p, dtype=bool)
     score_skip = np.zeros(p, dtype=bool)
 
+    req_rows: dict[str, np.ndarray] = {}   # unique spec -> [N] row
+    pref_rows: dict[str, np.ndarray] = {}
     for i, pod in enumerate(pods):
         spec = pod.get("spec") or {}
         node_sel = spec.get("nodeSelector") or {}
@@ -58,24 +64,36 @@ def build(table: NodeTable, pods: list[dict]) -> NodeAffinityXS:
         if not node_sel and not required:
             filter_skip[i] = True
         else:
-            for j in range(n):
-                ok = True
-                if node_sel:
-                    ok = all(labels[j].get(k) == str(v) for k, v in node_sel.items())
-                if ok and required:
-                    ok = node_selector_matches(required, labels[j], table.names[j])
-                required_ok[i, j] = ok
+            key = spec_key(node_sel, required)
+            row = req_rows.get(key)
+            if row is None:
+                row = np.ones(n, dtype=bool)
+                for j in range(n):
+                    ok = True
+                    if node_sel:
+                        ok = all(labels[j].get(k) == str(v) for k, v in node_sel.items())
+                    if ok and required:
+                        ok = node_selector_matches(required, labels[j], table.names[j])
+                    row[j] = ok
+                req_rows[key] = row
+            required_ok[i] = row
 
         if not preferred:
             score_skip[i] = True
         else:
-            for j in range(n):
-                s = 0
-                for term in preferred:
-                    w = int(term.get("weight", 0))
-                    if node_selector_term_matches(term.get("preference") or {}, labels[j], table.names[j]):
-                        s += w
-                pref_raw[i, j] = s
+            key = spec_key(preferred)
+            row = pref_rows.get(key)
+            if row is None:
+                row = np.zeros(n, dtype=np.int32)
+                for j in range(n):
+                    s = 0
+                    for term in preferred:
+                        w = int(term.get("weight", 0))
+                        if node_selector_term_matches(term.get("preference") or {}, labels[j], table.names[j]):
+                            s += w
+                    row[j] = s
+                pref_rows[key] = row
+            pref_raw[i] = row
 
     return NodeAffinityXS(
         required_ok=jnp.asarray(required_ok),

@@ -29,7 +29,7 @@ import numpy as np
 
 from .base import default_normalize_score
 from ..state.nodes import NodeTable, NO_EXECUTE, NO_SCHEDULE, PREFER_NO_SCHEDULE
-from ..state.selectors import tolerations_tolerate
+from ..state.selectors import spec_key, tolerations_tolerate
 
 NAME_TAINT = "TaintToleration"
 NAME_UNSCHED = "NodeUnschedulable"
@@ -58,17 +58,26 @@ def build_taints(table: NodeTable, pods: list[dict]) -> TaintXS:
     n, p = table.n, len(pods)
     code = np.zeros((p, n), dtype=np.int16)
     prefer = np.zeros((p, n), dtype=np.int16)
+    rows: dict[str, tuple[np.ndarray, np.ndarray]] = {}  # unique tolerations -> rows
     for i, pod in enumerate(pods):
         tols = (pod.get("spec") or {}).get("tolerations") or []
-        tols_prefer = [t for t in tols if (t.get("effect") or "") in ("", PREFER_NO_SCHEDULE)]
-        for j in range(n):
-            for ti, (key, value, eff) in enumerate(table.taints[j]):
-                if eff in (NO_SCHEDULE, NO_EXECUTE):
-                    if code[i, j] == 0 and not tolerations_tolerate(tols, key, value, eff):
-                        code[i, j] = 1 + ti
-                elif eff == PREFER_NO_SCHEDULE:
-                    if not tolerations_tolerate(tols_prefer, key, value, eff):
-                        prefer[i, j] += 1
+        cache_key = spec_key(tols)
+        cached = rows.get(cache_key)
+        if cached is None:
+            tols_prefer = [t for t in tols if (t.get("effect") or "") in ("", PREFER_NO_SCHEDULE)]
+            crow = np.zeros(n, dtype=np.int16)
+            prow = np.zeros(n, dtype=np.int16)
+            for j in range(n):
+                for ti, (key, value, eff) in enumerate(table.taints[j]):
+                    if eff in (NO_SCHEDULE, NO_EXECUTE):
+                        if crow[j] == 0 and not tolerations_tolerate(tols, key, value, eff):
+                            crow[j] = 1 + ti
+                    elif eff == PREFER_NO_SCHEDULE:
+                        if not tolerations_tolerate(tols_prefer, key, value, eff):
+                            prow[j] += 1
+            cached = (crow, prow)
+            rows[cache_key] = cached
+        code[i], prefer[i] = cached
     return TaintXS(filter_code=jnp.asarray(code), prefer_count=jnp.asarray(prefer))
 
 

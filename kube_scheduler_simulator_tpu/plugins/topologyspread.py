@@ -48,6 +48,7 @@ from ..state.nodes import NodeTable
 from ..state.selectors import (
     label_selector_matches,
     node_selector_matches,
+    spec_key,
 )
 
 NAME = "PodTopologySpread"
@@ -150,9 +151,20 @@ def build(table: NodeTable, pods: list[dict]):
     eligible = np.ones((p, n), dtype=bool)
     filter_skip = np.ones(p, dtype=bool)
     score_skip = np.ones(p, dtype=bool)
+    eligible_rows: dict[str, np.ndarray] = {}  # unique selector spec -> [N]
     for i, slots in enumerate(per_pod):
         if any(c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" for _, c in slots):
-            eligible[i] = _node_affinity_eligible(pods[i], labels, table.names)
+            pspec = pods[i].get("spec") or {}
+            ek = spec_key(
+                pspec.get("nodeSelector") or {},
+                (((pspec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
+                    "requiredDuringSchedulingIgnoredDuringExecution"),
+            )
+            row = eligible_rows.get(ek)
+            if row is None:
+                row = _node_affinity_eligible(pods[i], labels, table.names)
+                eligible_rows[ek] = row
+            eligible[i] = row
         for m, (cid, c) in enumerate(slots):
             c_id_arr[i, m] = cid
             max_skew[i, m] = int(c.get("maxSkew", 1))

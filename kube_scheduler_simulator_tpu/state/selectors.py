@@ -92,6 +92,15 @@ def label_selector_matches(selector: dict | None, labels: dict[str, str]) -> boo
     return True
 
 
+def spec_key(*parts) -> str:
+    """Canonical cache key for selector/toleration specs.  Pods stamped
+    from one template share these specs, so builders memoize per-node
+    match rows per unique spec instead of re-matching per (pod, node)."""
+    import json
+
+    return json.dumps(parts, sort_keys=True, separators=(",", ":"))
+
+
 def object_matches_label_selector(selector: dict | None, obj: dict) -> bool:
     """label_selector_matches against an object's metadata.labels, with
     values stringified the way the apiserver stores them."""
