@@ -82,10 +82,14 @@ class SchedulerEngine:
         for _ in range(8):  # preemption retry bound; one wave normally
             bound, preempted = self._schedule_wave()
             n_bound += bound
+            TRACER.count("pods_scheduled_total", bound)
+            TRACER.count("scheduling_waves_total")
             if preempted:
                 TRACER.count("preemption_waves_total")
             if not preempted:
                 break
+        # count unschedulable once per pass, not per retry wave
+        TRACER.count("pods_unschedulable_total", len(self.pending_pods()))
         return n_bound
 
     def _schedule_wave(self) -> tuple[int, bool]:
@@ -157,9 +161,6 @@ class SchedulerEngine:
                         )
                     self._mark_unschedulable(ns, name)
                 self.reflector.reflect(ns, name)
-        TRACER.count("pods_scheduled_total", n_bound)
-        TRACER.count("pods_unschedulable_total", len(pending) - n_bound)
-        TRACER.count("scheduling_waves_total")
         return n_bound, any_preempted
 
     def _run_postfilter(self, cw, filter_codes, pod_idx, pod, ns: str, name: str) -> bool:
@@ -213,9 +214,24 @@ class SchedulerEngine:
         names = cw.node_table.names
         name_to_idx = {nm: j for j, nm in enumerate(names)}
         postfilter_on = bool(cw.config.postfilters())
+        extender_span = TRACER.span("extender_phased_wave", pods=len(pending))
+        extender_span.__enter__()
+        try:
+            return self._extender_pod_loop(
+                cw, pending, eval_fn, bind_fn, carry, names, name_to_idx,
+                postfilter_on)
+        finally:
+            extender_span.__exit__(None, None, None)
+
+    def _extender_pod_loop(self, cw, pending, eval_fn, bind_fn, carry, names,
+                           name_to_idx, postfilter_on) -> tuple[int, bool]:
+        import jax
+        import numpy as np
+
+        from .replay import ReplayResult
+
         n_bound = 0
         any_preempted = False
-
         for i, pod in enumerate(pending):
             sl = jax.tree.map(lambda a: a[i] if hasattr(a, "ndim") and a.ndim else a, cw.xs)
             out = eval_fn(carry, sl)

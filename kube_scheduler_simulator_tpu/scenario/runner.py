@@ -37,6 +37,10 @@ from .types import (
 
 SIMULATOR_VERSION = "kube-scheduler-simulator-tpu/0.1"
 
+
+class _Cancelled(Exception):
+    """The scenario was deleted (or replaced) while its worker ran."""
+
 _OP_FIELDS = ("createOperation", "patchOperation", "deleteOperation", "doneOperation")
 
 
@@ -85,6 +89,11 @@ class ScenarioService:
         self._lock = threading.Lock()
         self._scenarios: dict[str, dict] = {}
         self._threads: dict[str, threading.Thread] = {}
+        # generation token per live scenario: a worker only writes status/
+        # timeline while its token is still current, so deleting a running
+        # scenario (and recreating the name) orphans the old worker
+        # instead of letting it corrupt the new one
+        self._gens: dict[str, object] = {}
 
     # ------------------------------------------------------------- CRUD
 
@@ -107,9 +116,12 @@ class ScenarioService:
                 },
             }
             self._scenarios[name] = sc
+            token = object()
+            self._gens[name] = token
+            if run:
+                t = threading.Thread(target=self.run, args=(name, token), daemon=True)
+                self._threads[name] = t
         if run:
-            t = threading.Thread(target=self.run, args=(name,), daemon=True)
-            self._threads[name] = t
             t.start()
         return copy.deepcopy(sc)
 
@@ -129,6 +141,10 @@ class ScenarioService:
             if name not in self._scenarios:
                 raise KeyError(name)
             del self._scenarios[name]
+            # invalidating the token also cancels the worker at its next
+            # step boundary
+            self._gens.pop(name, None)
+            self._threads.pop(name, None)
 
     def wait(self, name: str, timeout: float | None = 60) -> dict:
         t = self._threads.get(name)
@@ -138,38 +154,45 @@ class ScenarioService:
 
     # ------------------------------------------------------------- run
 
-    def run(self, name: str) -> dict:
+    def run(self, name: str, token: object | None = None) -> dict:
         """Execute the scenario to completion (synchronously)."""
         with self._lock:
             sc = self._scenarios.get(name)
             if sc is None:
                 raise KeyError(name)
+            if token is None:
+                token = self._gens.get(name)
             ops = copy.deepcopy((sc.get("spec") or {}).get("operations") or [])
             status = sc["status"]
             status["phase"] = PHASE_RUNNING
 
         try:
-            done = self._run_steps(name, ops)
+            done = self._run_steps(name, token, ops)
+        except _Cancelled:
+            return {}
         except Exception as e:
-            self._set_status(name, phase=PHASE_FAILED, message=str(e))
+            self._set_status(name, token, phase=PHASE_FAILED, message=str(e))
             return self.get(name)
         self._set_status(
-            name,
+            name, token,
             phase=PHASE_SUCCEEDED if done else PHASE_PAUSED,
             message=None if done else
             "all operations finished without a doneOperation; "
             "operations can still be added",
         )
-        return self.get(name)
+        try:
+            return self.get(name)
+        except KeyError:
+            return {}
 
     # ------------------------------------------------------------ steps
 
-    def _set_status(self, name: str, phase=None, message=None,
+    def _set_status(self, name: str, token, phase=None, message=None,
                     step=None, step_phase=None):
         with self._lock:
             sc = self._scenarios.get(name)
-            if sc is None:
-                return
+            if sc is None or self._gens.get(name) is not token:
+                return  # deleted or replaced: the stale worker stays silent
             st = sc["status"]
             if phase is not None:
                 st["phase"] = phase
@@ -179,50 +202,57 @@ class ScenarioService:
             if step_phase is not None:
                 st["stepStatus"]["phase"] = step_phase
 
-    def _append_timeline(self, name: str, major: int, event: dict):
+    def _append_timeline(self, name: str, token, major: int, event: dict):
         with self._lock:
             sc = self._scenarios.get(name)
-            if sc is None:
+            if sc is None or self._gens.get(name) is not token:
                 return
             tl = sc["status"]["scenarioResult"]["timeline"]
             tl.setdefault(str(major), []).append(event)
 
-    def _run_steps(self, name: str, ops: list[dict]) -> bool:
+    def _check_live(self, name: str, token) -> None:
+        with self._lock:
+            if self._gens.get(name) is not token:
+                raise _Cancelled(name)
+
+    def _run_steps(self, name: str, token, ops: list[dict]) -> bool:
         by_step: dict[int, list[dict]] = {}
         for i, op in enumerate(ops):
             op.setdefault("id", f"op-{i}")
             by_step.setdefault(int(op.get("step") or 0), []).append(op)
 
         for major in sorted(by_step):
+            self._check_live(name, token)  # cancelled by delete()
             minor = 0
-            self._set_status(name, step={"major": major, "minor": minor},
+            self._set_status(name, token, step={"major": major, "minor": minor},
                              step_phase=STEP_OPERATING)
             done_requested = False
             for op in by_step[major]:
+                self._check_live(name, token)
                 field = _op_kind(op)  # raises -> scenario Failed
                 if field == "doneOperation":
                     done_requested = True
-                    self._append_timeline(name, major, {
+                    self._append_timeline(name, token, major, {
                         "id": op["id"],
                         "step": {"major": major, "minor": minor},
                         "done": {"operation": op["doneOperation"]},
                     })
                     continue
-                minor += self._apply_op(name, major, minor, op, field)
+                minor += self._apply_op(name, token, major, minor, op, field)
 
             # SimulationController (the scheduler) runs to quiescence
             if self.engine is not None:
-                self._set_status(name, step_phase=STEP_CONTROLLER_RUNNING)
-                minor = self._run_controller(name, major, minor)
-                self._set_status(name, step_phase=STEP_CONTROLLER_COMPLETED)
+                self._set_status(name, token, step_phase=STEP_CONTROLLER_RUNNING)
+                minor = self._run_controller(name, token, major, minor)
+                self._set_status(name, token, step_phase=STEP_CONTROLLER_COMPLETED)
 
-            self._set_status(name, step={"major": major, "minor": minor},
+            self._set_status(name, token, step={"major": major, "minor": minor},
                              step_phase=STEP_COMPLETED)
             if done_requested:
                 return True
         return False
 
-    def _apply_op(self, name, major, minor, op, field) -> int:
+    def _apply_op(self, name, token, major, minor, op, field) -> int:
         """Apply one create/patch/delete operation; returns 1 if a resource
         changed (MinorStep advances on every resource operation)."""
         body = op[field]
@@ -230,7 +260,7 @@ class ScenarioService:
             obj = body.get("object") or {}
             resource = _resource_for(obj)
             result = self.store.create(resource, obj)
-            self._append_timeline(name, major, {
+            self._append_timeline(name, token, major, {
                 "id": op["id"], "step": {"major": major, "minor": minor},
                 "create": {"operation": body, "result": result},
             })
@@ -250,20 +280,20 @@ class ScenarioService:
                 new["metadata"]["namespace"] = cur["metadata"]["namespace"]
             new["metadata"]["resourceVersion"] = cur["metadata"].get("resourceVersion")
             result = self.store.update(resource, new)
-            self._append_timeline(name, major, {
+            self._append_timeline(name, token, major, {
                 "id": op["id"], "step": {"major": major, "minor": minor},
                 "patch": {"operation": body, "result": result},
             })
             return 1
         # deleteOperation
         self.store.delete(resource, meta.get("name"), meta.get("namespace"))
-        self._append_timeline(name, major, {
+        self._append_timeline(name, token, major, {
             "id": op["id"], "step": {"major": major, "minor": minor},
             "delete": {"operation": body},
         })
         return 1
 
-    def _run_controller(self, name, major, minor) -> int:
+    def _run_controller(self, name, token, major, minor) -> int:
         """Run the scheduler until it can no longer bind anything; emit a
         generated PodScheduled timeline event per newly-bound pod (the
         KEP's generated timeline entries)."""
@@ -280,7 +310,7 @@ class ScenarioService:
         for p in self.store.list("pods")[0]:
             key = (p["metadata"].get("namespace") or "default", p["metadata"]["name"])
             if (p.get("spec") or {}).get("nodeName") and key not in before:
-                self._append_timeline(name, major, {
+                self._append_timeline(name, token, major, {
                     "id": f"generated-{major}-{minor}",
                     "step": {"major": major, "minor": minor},
                     "podScheduled": {

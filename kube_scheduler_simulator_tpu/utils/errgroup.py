@@ -9,39 +9,30 @@ reset/reset.go:63-78)."""
 from __future__ import annotations
 
 import os
-import threading
+from concurrent.futures import Future, ThreadPoolExecutor
 
 
 class SemaphoredErrGroup:
     def __init__(self, limit: int | None = None):
-        self._sem = threading.Semaphore(limit or os.cpu_count() or 4)
-        self._threads: list[threading.Thread] = []
-        self._err_lock = threading.Lock()
-        self._first_err: BaseException | None = None
+        self._pool = ThreadPoolExecutor(max_workers=limit or os.cpu_count() or 4)
+        self._futures: list[Future] = []
 
     def go(self, fn, *args, **kwargs) -> None:
-        """Run fn concurrently, holding one permit for its duration."""
-
-        def run():
-            try:
-                fn(*args, **kwargs)
-            except BaseException as e:  # noqa: BLE001 — errgroup captures all
-                with self._err_lock:
-                    if self._first_err is None:
-                        self._first_err = e
-            finally:
-                self._sem.release()
-
-        self._sem.acquire()
-        t = threading.Thread(target=run, daemon=True)
-        t.start()
-        self._threads.append(t)
+        """Submit fn; at most `limit` run at once (pool-bounded, so a
+        100k-object snapshot does not spawn 100k OS threads)."""
+        self._futures.append(self._pool.submit(fn, *args, **kwargs))
 
     def wait(self) -> None:
-        """Join everything; re-raise the first error (errgroup.Wait)."""
-        for t in self._threads:
-            t.join()
-        self._threads.clear()
-        if self._first_err is not None:
-            err, self._first_err = self._first_err, None
-            raise err
+        """Block until all submitted work finishes; re-raise the FIRST
+        error in submission order (errgroup.Wait)."""
+        futures, self._futures = self._futures, []
+        first_err: BaseException | None = None
+        for f in futures:
+            try:
+                f.result()
+            except BaseException as e:  # noqa: BLE001 — errgroup captures all
+                if first_err is None:
+                    first_err = e
+        self._pool.shutdown(wait=True)
+        if first_err is not None:
+            raise first_err

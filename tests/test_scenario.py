@@ -99,6 +99,50 @@ def test_scenario_invalid_operation_fails():
     assert svc.run("s2")["status"]["phase"] == "Failed"
 
 
+def test_scenario_delete_cancels_and_recreate_is_clean():
+    """Deleting a running scenario orphans its worker: the old thread
+    neither applies further operations nor writes into a recreated
+    same-name scenario."""
+    import threading
+    import time
+
+    store = ObjectStore()
+    svc = ScenarioService(store)
+    gate = threading.Event()
+
+    class GateStore:
+        """Store proxy whose create blocks until released."""
+        def __getattr__(self, a):
+            return getattr(store, a)
+        def create(self, resource, obj):
+            gate.wait(5)
+            return store.create(resource, obj)
+
+    svc.store = GateStore()
+    node1 = make_nodes(2, seed=43)[0]
+    node2 = make_nodes(2, seed=43)[1]
+    svc.create(_scenario([
+        {"step": 0, "createOperation": {"object": node1}},
+        {"step": 1, "createOperation": {"object": node2}},
+    ], name="doomed"))
+    t = svc._threads["doomed"]
+    svc.delete("doomed")          # while the worker blocks in step 0
+    fresh = svc.create(_scenario([], name="doomed"), run=False)
+    gate.set()
+    t.join(10)
+    final = svc.run("doomed")
+    # the recreated scenario is untouched by the old worker
+    assert final["status"]["phase"] == "Paused"
+    assert final["status"]["scenarioResult"]["timeline"] == {}
+    # the old worker stopped at the first step boundary: step-1 node never
+    # created (step-0's in-flight create may have completed)
+    deadline = time.time() + 2
+    while time.time() < deadline:
+        time.sleep(0.05)
+    names = [n["metadata"]["name"] for n in store.list("nodes")[0]]
+    assert node2["metadata"]["name"] not in names
+
+
 def test_scenario_http_api():
     from kube_scheduler_simulator_tpu.config.config import SimulatorConfiguration
     from kube_scheduler_simulator_tpu.server.di import DIContainer
