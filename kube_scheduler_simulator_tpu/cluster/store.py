@@ -114,6 +114,39 @@ class ObjectStore:
         obj.setdefault("kind", kind)
         obj.setdefault("apiVersion", API_VERSIONS.get(resource, "v1"))
 
+    # the apiserver's built-in PriorityClasses (scheduling.k8s.io)
+    _BUILTIN_PRIORITY_CLASSES = {
+        "system-cluster-critical": 2000000000,
+        "system-node-critical": 2000001000,
+    }
+
+    def _admit_pod_priority(self, obj: dict) -> None:
+        """Priority admission analogue: resolve .spec.priority from
+        priorityClassName (or the globalDefault class) at create time,
+        the way the reference's kube-apiserver does for pods the
+        simulator imports or users post.  Caller holds the lock."""
+        spec = obj.setdefault("spec", {})
+        if spec.get("priority") is not None:
+            return
+        name = spec.get("priorityClassName") or ""
+        if name:
+            if name in self._BUILTIN_PRIORITY_CLASSES:
+                spec["priority"] = self._BUILTIN_PRIORITY_CLASSES[name]
+                return
+            pc = self._objects["priorityclasses"].get(name)
+            if pc is None:
+                e = ApiError(f'no PriorityClass with name "{name}" was found')
+                e.status = 400
+                e.reason = "Invalid"
+                raise e
+            spec["priority"] = int(pc.get("value") or 0)
+            return
+        for pc in self._objects["priorityclasses"].values():
+            if pc.get("globalDefault"):
+                spec["priorityClassName"] = pc["metadata"]["name"]
+                spec["priority"] = int(pc.get("value") or 0)
+                return
+
     # ----------------------------------------------------------- CRUD
 
     def create(self, resource: str, obj: dict) -> dict:
@@ -128,6 +161,8 @@ class ObjectStore:
         with self._lock:
             if key in self._objects[resource]:
                 raise AlreadyExists(f"{resource} \"{key}\" already exists")
+            if resource == "pods":
+                self._admit_pod_priority(obj)
             rv = self._next_rv()
             meta["uid"] = meta.get("uid") or str(uuid.uuid4())
             meta["resourceVersion"] = str(rv)

@@ -1,0 +1,72 @@
+"""Priority admission analogue: .spec.priority resolved from
+priorityClassName / globalDefault at pod create, as the reference's
+kube-apiserver does before the scheduler ever sees the pod."""
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import ApiError, ObjectStore
+
+
+def _pod(name, **spec):
+    return {"kind": "Pod", "metadata": {"name": name},
+            "spec": {"containers": [{"name": "c"}], **spec}}
+
+
+def test_priority_resolved_from_class():
+    s = ObjectStore()
+    s.create("priorityclasses", {"metadata": {"name": "high"}, "value": 9000})
+    p = s.create("pods", _pod("a", priorityClassName="high"))
+    assert p["spec"]["priority"] == 9000
+
+
+def test_explicit_priority_wins():
+    s = ObjectStore()
+    s.create("priorityclasses", {"metadata": {"name": "high"}, "value": 9000})
+    p = s.create("pods", _pod("b", priorityClassName="high", priority=5))
+    assert p["spec"]["priority"] == 5
+
+
+def test_missing_class_rejected():
+    s = ObjectStore()
+    with pytest.raises(ApiError, match="no PriorityClass"):
+        s.create("pods", _pod("c", priorityClassName="nope"))
+
+
+def test_builtin_classes():
+    s = ObjectStore()
+    p = s.create("pods", _pod("d", priorityClassName="system-node-critical"))
+    assert p["spec"]["priority"] == 2000001000
+
+
+def test_global_default_applies():
+    s = ObjectStore()
+    s.create("priorityclasses", {"metadata": {"name": "dflt"}, "value": 7,
+                                 "globalDefault": True})
+    p = s.create("pods", _pod("e"))
+    assert p["spec"]["priority"] == 7
+    assert p["spec"]["priorityClassName"] == "dflt"
+    # pods created BEFORE any default class exists stay unset
+    s2 = ObjectStore()
+    p2 = s2.create("pods", _pod("f"))
+    assert "priority" not in p2["spec"]
+
+
+def test_priority_orders_scheduling_queue():
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.models.workloads import make_nodes
+
+    s = ObjectStore()
+    s.create("priorityclasses", {"metadata": {"name": "vip"}, "value": 100})
+    # one-cpu node: only the higher-priority pod fits
+    s.create("nodes", {"metadata": {"name": "n1"},
+                       "status": {"allocatable": {"cpu": "1", "memory": "4Gi",
+                                                  "pods": "10"}}})
+    s.create("pods", _pod("low", containers=[{  # noqa: PIE804
+        "name": "c", "resources": {"requests": {"cpu": "1"}}}]))
+    s.create("pods", {"kind": "Pod", "metadata": {"name": "vip-pod"},
+                      "spec": {"priorityClassName": "vip", "containers": [
+                          {"name": "c", "resources": {"requests": {"cpu": "1"}}}]}})
+    engine = SchedulerEngine(s)
+    engine.schedule_pending()
+    assert s.get("pods", "vip-pod", "default")["spec"].get("nodeName") == "n1"
+    assert not s.get("pods", "low", "default")["spec"].get("nodeName")
