@@ -192,6 +192,22 @@ class ObjectStore:
                     f"Operation cannot be fulfilled on {resource} \"{key}\": "
                     "the object has been modified"
                 )
+            if resource == "pods":
+                # apiserver validation: spec.nodeName is write-once (only
+                # the empty->set transition of binding is allowed); this
+                # is what actually protects the simulator's placement
+                # authority from synced source-cluster updates
+                cur_node = (cur.get("spec") or {}).get("nodeName") or ""
+                new_node = (obj.get("spec") or {}).get("nodeName") or ""
+                if cur_node and new_node != cur_node:
+                    e = ApiError(
+                        f'Pod "{key}" is invalid: spec: Forbidden: pod '
+                        "updates may not change fields other than allowed ones "
+                        f"(spec.nodeName {cur_node!r} -> {new_node!r})"
+                    )
+                    e.status = 422
+                    e.reason = "Invalid"
+                    raise e
             rv = self._next_rv()
             meta["uid"] = cur["metadata"]["uid"]
             meta["resourceVersion"] = str(rv)

@@ -83,16 +83,12 @@ class ResourceApplier:
         return obj
 
     def _filter_scheduled_pod(self, resource: str, obj: dict) -> bool:
-        try:
-            cur = self.store.get(
-                "pods",
-                obj["metadata"].get("name", ""),
-                obj["metadata"].get("namespace"),
-            )
-        except NotFound:
-            return True
-        # skip updates to pods the simulator already scheduled
-        return not ((cur.get("spec") or {}).get("nodeName"))
+        # skip updates carrying a scheduled pod: placement in the
+        # simulator belongs to the simulator's own scheduler.  The
+        # reference filters on the INCOMING object's nodeName
+        # (resource.go:82-99 filterPodsForUpdating), not the destination's
+        # — a source-side bind must never leak into the simulator
+        return not ((obj.get("spec") or {}).get("nodeName"))
 
     # ----------------------------------------------------------- apply
 

@@ -146,6 +146,41 @@ def test_standalone_scheduler_over_http(sim):
         json.loads(anns[ann.FINAL_SCORE_RESULT])
 
 
+def test_remote_watch_reconnect_resumes_without_duplicates(sim):
+    """After a dropped stream, the client reconnects with per-kind
+    *LastResourceVersion params: pre-drop objects are NOT re-delivered as
+    ADDED, and post-drop events still arrive."""
+    srv, remote = sim
+    node = make_nodes(2, seed=62)[0]
+    remote.create("nodes", node)
+    q = remote.watch("nodes")
+    rv, et, obj = q.get(timeout=10)
+    assert et == "ADDED"
+
+    remote._abort_stream()  # simulate a dropped connection
+    time.sleep(1.0)         # reconnect loop (0.5s backoff)
+
+    node2 = make_nodes(2, seed=62)[1]
+    remote.create("nodes", node2)
+    events = []
+    deadline = time.time() + 10
+    while time.time() < deadline and len(events) < 1:
+        try:
+            events.append(q.get(timeout=0.5))
+        except Exception:
+            pass
+    # drain briefly to catch any duplicate re-listing
+    deadline = time.time() + 1.5
+    while time.time() < deadline:
+        try:
+            events.append(q.get(timeout=0.3))
+        except Exception:
+            pass
+    names = [e[2]["metadata"]["name"] for e in events if e[1] == "ADDED"]
+    assert node2["metadata"]["name"] in names
+    assert node["metadata"]["name"] not in names, "pre-drop object re-delivered"
+
+
 def test_recorder_over_remote(sim, tmp_path):
     srv, remote = sim
     path = tmp_path / "record.jsonl"
