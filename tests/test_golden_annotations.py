@@ -1,0 +1,86 @@
+"""Golden annotation fixtures: exact JSON strings for a hand-computed
+cluster, pinning the wire format itself (the parity suite only proves
+the tensor path and the sequential oracle agree with EACH OTHER).
+
+Hand-derivation (upstream v1.32 semantics):
+  node-a 2cpu/4Gi, node-b 4cpu/8Gi; pod requests 1cpu/2Gi.
+  NodeResourcesFit LeastAllocated = mean over resources of
+    (allocatable-requested)*100/allocatable -> a: (50+50)/2=50,
+    b: (75+75)/2=75.
+  BalancedAllocation: cpu/mem fractions equal on both -> std 0 -> 100.
+  Scores marshal as strconv.FormatInt strings (store.go:474,501); maps
+  marshal compact with sorted keys (Go encoding/json).
+"""
+
+import json
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+GOLDEN = {
+    ann.PRE_FILTER_STATUS_RESULT: '{"NodeResourcesFit":"success"}',
+    ann.PRE_FILTER_RESULT: "{}",
+    ann.FILTER_RESULT:
+        '{"node-a":{"NodeResourcesFit":"passed"},"node-b":{"NodeResourcesFit":"passed"}}',
+    ann.POST_FILTER_RESULT: "{}",
+    ann.PRE_SCORE_RESULT:
+        '{"NodeResourcesBalancedAllocation":"success","NodeResourcesFit":"success"}',
+    ann.SCORE_RESULT:
+        '{"node-a":{"NodeResourcesBalancedAllocation":"100","NodeResourcesFit":"50"},'
+        '"node-b":{"NodeResourcesBalancedAllocation":"100","NodeResourcesFit":"75"}}',
+    ann.FINAL_SCORE_RESULT:
+        '{"node-a":{"NodeResourcesBalancedAllocation":"100","NodeResourcesFit":"50"},'
+        '"node-b":{"NodeResourcesBalancedAllocation":"100","NodeResourcesFit":"75"}}',
+    ann.RESERVE_RESULT: "{}",
+    ann.PERMIT_STATUS_RESULT: "{}",
+    ann.PERMIT_TIMEOUT_RESULT: "{}",
+    ann.PRE_BIND_RESULT: "{}",
+    ann.BIND_RESULT: '{"DefaultBinder":"success"}',
+    ann.SELECTED_NODE: "node-b",
+}
+
+
+def test_golden_annotation_strings():
+    store = ObjectStore()
+    store.create("nodes", {"metadata": {"name": "node-a"},
+                           "status": {"allocatable": {"cpu": "2", "memory": "4Gi",
+                                                      "pods": "10"}}})
+    store.create("nodes", {"metadata": {"name": "node-b"},
+                           "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                                      "pods": "10"}}})
+    engine = SchedulerEngine(store)
+    engine.set_plugin_config(PluginSetConfig(
+        enabled=["NodeResourcesFit", "NodeResourcesBalancedAllocation"]))
+    store.create("pods", {"metadata": {"name": "p1"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "2Gi"}}}]}})
+    assert engine.schedule_pending() == 1
+
+    anns = store.get("pods", "p1", "default")["metadata"]["annotations"]
+    for key, want in GOLDEN.items():
+        assert anns[key] == want, f"{key}\n  got:  {anns[key]}\n  want: {want}"
+
+    # result-history holds exactly these blobs as its first record
+    hist = json.loads(anns[ann.RESULT_HISTORY])
+    assert len(hist) == 1
+    for key, want in GOLDEN.items():
+        assert hist[0][key] == want, f"history {key}"
+
+
+def test_golden_unschedulable_filter_message():
+    """Infeasible pod records the upstream Insufficient-cpu message and
+    an empty selected-node."""
+    store = ObjectStore()
+    store.create("nodes", {"metadata": {"name": "node-a"},
+                           "status": {"allocatable": {"cpu": "2", "memory": "4Gi",
+                                                      "pods": "10"}}})
+    engine = SchedulerEngine(store)
+    engine.set_plugin_config(PluginSetConfig(enabled=["NodeResourcesFit"]))
+    store.create("pods", {"metadata": {"name": "big"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "16", "memory": "2Gi"}}}]}})
+    assert engine.schedule_pending() == 0
+    anns = store.get("pods", "big", "default")["metadata"]["annotations"]
+    fr = json.loads(anns[ann.FILTER_RESULT])
+    assert fr["node-a"]["NodeResourcesFit"] == "Insufficient cpu"
+    assert anns[ann.SELECTED_NODE] == ""
