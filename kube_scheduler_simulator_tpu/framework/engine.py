@@ -16,6 +16,7 @@ update, which also carries their result annotations out.
 
 from __future__ import annotations
 
+import copy
 import time
 
 from .replay import replay
@@ -49,7 +50,8 @@ class SchedulerEngine:
     def set_plugin_config(self, cfg: PluginSetConfig) -> None:
         # validates by constructing; the service uses this for rollback
         self.plugin_config = PluginSetConfig(
-            enabled=list(cfg.enabled), weights=dict(cfg.weights), custom=dict(cfg.custom)
+            enabled=list(cfg.enabled), weights=dict(cfg.weights),
+            custom=dict(cfg.custom), args=copy.deepcopy(cfg.args),
         )
 
     def set_extenders(self, extender_service) -> None:

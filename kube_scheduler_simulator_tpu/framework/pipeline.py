@@ -104,10 +104,20 @@ def _score_one(name: str, cw: CompiledWorkload, carry, sl, feasible):
         raw = sl[name].scores.astype(jnp.int64)
         return raw, raw  # custom NormalizeScore unsupported (build_custom rejects)
     if name == "NodeResourcesFit":
-        raw = noderesources.fit_score(cw.statics["core"], sl["core"], carry["core"])
+        from ..plugins.fitscoring import parse_fit_strategy
+
+        raw = noderesources.fit_score(
+            cw.statics["core"], sl["core"], carry["core"],
+            strategy=parse_fit_strategy(cw.config.args.get(name)),
+            schema=getattr(cw, "schema", None))
         return raw, raw  # no ScoreExtensions
     if name == "NodeResourcesBalancedAllocation":
-        raw = noderesources.balanced_score(cw.statics["core"], sl["core"], carry["core"])
+        from ..plugins.fitscoring import parse_balanced_resources
+
+        raw = noderesources.balanced_score(
+            cw.statics["core"], sl["core"], carry["core"],
+            resources=parse_balanced_resources(cw.config.args.get(name)),
+            schema=getattr(cw, "schema", None))
         return raw, raw  # no ScoreExtensions
     if name == "ImageLocality":
         raw = imagelocality.score_kernel(sl["ImageLocality"])

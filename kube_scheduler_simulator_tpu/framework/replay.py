@@ -87,11 +87,15 @@ def _workload_scan_key(cw: CompiledWorkload, chunk: int):
         for tree in (cw.xs, cw.init_carry)
         for path_leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
     )
+    import json
+
     cfg = cw.config
     cfg_sig = (
         tuple(cfg.enabled),
         tuple(sorted((n, cfg.weight(n)) for n in cfg.scorers())),
         tuple((n, id(p)) for n, p in sorted(cfg.custom.items())),
+        json.dumps(cfg.args, sort_keys=True, default=str),
+        tuple(cw.schema.columns),
     )
     return (h.hexdigest(), shapes, cfg_sig, chunk)
 
@@ -100,12 +104,13 @@ class _SlimWorkload:
     """Just the fields build_step bakes into the jitted scan — cached
     closures must not pin per-pod xs tensors or pod manifests."""
 
-    __slots__ = ("config", "statics", "n_nodes")
+    __slots__ = ("config", "statics", "n_nodes", "schema")
 
     def __init__(self, cw: CompiledWorkload):
         self.config = cw.config
         self.statics = cw.statics
         self.n_nodes = cw.n_nodes
+        self.schema = cw.schema
 
 
 def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1):

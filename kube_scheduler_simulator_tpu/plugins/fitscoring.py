@@ -1,0 +1,146 @@
+"""NodeResourcesFit / NodeResourcesBalancedAllocation scoringStrategy.
+
+Upstream v1.32 semantics (pkg/scheduler/framework/plugins/noderesources):
+  * resource_allocation.go score():   node = Σ score_r·w_r  //  Σ w_r
+  * least_allocated.go:  (cap-req)·100/cap, 0 when req>cap or cap==0
+  * most_allocated.go:   req·100/cap,       0 when req>cap or cap==0
+  * requested_to_capacity_ratio.go: shape points (utilization 0-100,
+    score 0-10 scaled ×10 at build); rawScore = broken-linear(utilization)
+    with utilization = req·100/cap, and rawScore(100) when cap==0 or
+    req>cap.  All arithmetic int64 with Go truncating division.
+  * cpu/memory use the non-zero-defaulted request accumulators
+    (GetNonzeroRequests); every other resource uses raw requests.
+  * balanced_allocation.go: per-resource fractions min(req/cap, 1)
+    (resources with cap==0 skipped); std = |f0-f1|/2 for two fractions,
+    population-σ for more; score = int64((1-std)·100).
+
+The simulator feeds these from KubeSchedulerConfiguration pluginConfig
+args, which the reference passes through to the upstream plugins
+(SURVEY.md §2.1 scheduler config helpers).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100
+MAX_CUSTOM_PRIORITY_SCORE = 10
+
+DEFAULT_RESOURCES = (("cpu", 1), ("memory", 1))
+
+LEAST_ALLOCATED = "LeastAllocated"
+MOST_ALLOCATED = "MostAllocated"
+REQUESTED_TO_CAPACITY_RATIO = "RequestedToCapacityRatio"
+
+
+class FitStrategy(NamedTuple):
+    stype: str
+    resources: tuple[tuple[str, int], ...]   # (name, weight)
+    shape: tuple[tuple[int, int], ...]       # (utilization, score×10) ascending
+
+    @property
+    def weight_sum(self) -> int:
+        return max(sum(w for _, w in self.resources), 1)
+
+
+def parse_fit_strategy(args: dict | None) -> FitStrategy:
+    ss = (args or {}).get("scoringStrategy") or {}
+    stype = ss.get("type") or LEAST_ALLOCATED
+    res = tuple(
+        (r.get("name") or "", int(r.get("weight") or 1))
+        for r in (ss.get("resources") or [])
+    ) or DEFAULT_RESOURCES
+    shape = tuple(
+        (int(p.get("utilization") or 0),
+         int(p.get("score") or 0) * (MAX_NODE_SCORE // MAX_CUSTOM_PRIORITY_SCORE))
+        for p in ((ss.get("requestedToCapacityRatio") or {}).get("shape") or [])
+    )
+    if stype == REQUESTED_TO_CAPACITY_RATIO and not shape:
+        raise ValueError("RequestedToCapacityRatio strategy needs a shape")
+    return FitStrategy(stype, res, shape)
+
+
+def parse_balanced_resources(args: dict | None) -> tuple[str, ...]:
+    ss = (args or {}).get("scoringStrategy") or {}
+    res = tuple((r.get("name") or "") for r in (ss.get("resources") or []))
+    return res or ("cpu", "memory")
+
+
+# ----------------------------------------------------------- scalar (oracle)
+
+def _broken_linear_int(shape: tuple[tuple[int, int], ...], p: int) -> int:
+    for i, (u, s) in enumerate(shape):
+        if p <= u:
+            if i == 0:
+                return s
+            up, sp = shape[i - 1]
+            return sp + _trunc_div((s - sp) * (p - up), (u - up))
+    return shape[-1][1]
+
+
+def _trunc_div(a: int, b: int) -> int:
+    """Go integer division (truncates toward zero)."""
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
+
+
+def score_resource(strategy: FitStrategy, requested: int, capacity: int) -> int:
+    if strategy.stype == REQUESTED_TO_CAPACITY_RATIO:
+        if capacity == 0 or requested > capacity:
+            return _broken_linear_int(strategy.shape, MAX_NODE_SCORE)
+        return _broken_linear_int(
+            strategy.shape, requested * MAX_NODE_SCORE // capacity)
+    if capacity == 0 or requested > capacity:
+        return 0
+    if strategy.stype == MOST_ALLOCATED:
+        return requested * MAX_NODE_SCORE // capacity
+    return (capacity - requested) * MAX_NODE_SCORE // capacity
+
+
+def balanced_std(fractions: list[float]) -> float:
+    if len(fractions) == 2:
+        return abs(fractions[0] - fractions[1]) / 2.0
+    if len(fractions) > 2:
+        mean = sum(fractions) / len(fractions)
+        var = sum((f - mean) ** 2 for f in fractions) / len(fractions)
+        return var ** 0.5
+    return 0.0
+
+
+# ----------------------------------------------------------- tensor (device)
+
+def _jnp_trunc_div(a, b):
+    q = jnp.abs(a) // jnp.abs(b)
+    return jnp.where((a >= 0) == (b >= 0), q, -q)
+
+
+def _broken_linear_vec(shape: tuple[tuple[int, int], ...], p):
+    out = jnp.full_like(p, shape[-1][1])
+    for i in range(len(shape) - 1, -1, -1):
+        u, s = shape[i]
+        if i == 0:
+            val = jnp.full_like(p, s)
+        else:
+            up, sp = shape[i - 1]
+            val = sp + _jnp_trunc_div((s - sp) * (p - up), jnp.int64(u - up))
+        out = jnp.where(p <= u, val, out)
+    return out
+
+
+def score_resource_vec(strategy: FitStrategy, requested, capacity):
+    """[N] int64 per-resource score; strategy is trace-time static."""
+    requested = requested.astype(jnp.int64)
+    capacity = capacity.astype(jnp.int64)
+    if strategy.stype == REQUESTED_TO_CAPACITY_RATIO:
+        over = (capacity == 0) | (requested > capacity)
+        util = jnp.where(
+            over, MAX_NODE_SCORE,
+            requested * MAX_NODE_SCORE // jnp.maximum(capacity, 1))
+        return _broken_linear_vec(strategy.shape, util)
+    ok = (capacity > 0) & (requested <= capacity)
+    cap = jnp.maximum(capacity, 1)
+    if strategy.stype == MOST_ALLOCATED:
+        return jnp.where(ok, requested * MAX_NODE_SCORE // cap, 0)
+    return jnp.where(ok, (capacity - requested) * MAX_NODE_SCORE // cap, 0)

@@ -32,6 +32,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
+from . import fitscoring
 from .base import MAX_NODE_SCORE
 from ..state.resources import CPU, MEMORY, ResourceSchema
 
@@ -82,27 +83,74 @@ def decode_fit_filter(code: int, schema: ResourceSchema) -> str:
     return ", ".join(reasons)
 
 
-def fit_score(static: FitStatic, pod: FitPodXS, carry) -> jnp.ndarray:
-    """LeastAllocated over cpu+memory (default strategy resources, weight 1
-    each), using the non-zero requested accumulators."""
-    alloc = static.allocatable[:, (CPU, MEMORY)]              # [N, 2]
-    req = carry.nonzero + pod.nonzero[None, :]                # [N, 2]
-    ok = (req <= alloc) & (alloc > 0)
-    per = jnp.where(ok, (alloc - req) * MAX_NODE_SCORE // jnp.maximum(alloc, 1), 0)
-    # weighted mean; default weights are 1,1 -> sum // 2
-    return jnp.sum(per, axis=1) // 2
+def _resource_req_alloc(static: FitStatic, pod: FitPodXS, carry, name: str,
+                        schema: ResourceSchema | None):
+    """-> (requested [N], allocatable [N]) for one scored resource.
+    cpu/memory use the non-zero-defaulted accumulators (upstream
+    GetNonzeroRequests); others the raw request accumulators."""
+    if name == "cpu":
+        return carry.nonzero[:, 0] + pod.nonzero[0], static.allocatable[:, CPU]
+    if name == "memory":
+        return carry.nonzero[:, 1] + pod.nonzero[1], static.allocatable[:, MEMORY]
+    if schema is not None and name in schema.columns:
+        c = schema.columns.index(name)
+        return carry.requested[:, c] + pod.requests[c], static.allocatable[:, c]
+    # untracked resource: requested 0 against capacity 0 (upstream sees
+    # zeroes too; the weight still enters the weighted mean)
+    n = static.allocatable.shape[0]
+    return jnp.zeros(n, dtype=jnp.int64), jnp.zeros(n, dtype=jnp.int64)
 
 
-def balanced_score(static: FitStatic, pod: FitPodXS, carry) -> jnp.ndarray:
-    alloc = static.allocatable[:, (CPU, MEMORY)].astype(jnp.float64)
-    req = (carry.nonzero + pod.nonzero[None, :]).astype(jnp.float64)
-    frac = jnp.minimum(req / jnp.maximum(alloc, 1.0), 1.0)    # [N, 2]
-    std = jnp.abs(frac[:, 0] - frac[:, 1]) / 2.0
-    score = ((1.0 - std) * MAX_NODE_SCORE).astype(jnp.int64)  # trunc, as Go int64()
-    # a node with zero allocatable in either resource: upstream skips such
-    # resources; with cpu+memory both always >0 on real nodes this is moot,
-    # but guard against alloc==0 producing garbage.
-    return jnp.where(jnp.all(alloc > 0, axis=1), score, 0)
+def fit_score(static: FitStatic, pod: FitPodXS, carry,
+              strategy: fitscoring.FitStrategy | None = None,
+              schema: ResourceSchema | None = None) -> jnp.ndarray:
+    """scoringStrategy-driven score (resource_allocation.go score():
+    weighted mean of per-resource scores, int64 division).  Default:
+    LeastAllocated over cpu+memory, weight 1 each."""
+    if strategy is None:
+        strategy = fitscoring.FitStrategy(
+            fitscoring.LEAST_ALLOCATED, fitscoring.DEFAULT_RESOURCES, ())
+    n = static.allocatable.shape[0]
+    total = jnp.zeros(n, dtype=jnp.int64)
+    for name, w in strategy.resources:
+        req, alloc = _resource_req_alloc(static, pod, carry, name, schema)
+        total = total + fitscoring.score_resource_vec(strategy, req, alloc) * w
+    return total // strategy.weight_sum
+
+
+def balanced_score(static: FitStatic, pod: FitPodXS, carry,
+                   resources: tuple[str, ...] = ("cpu", "memory"),
+                   schema: ResourceSchema | None = None) -> jnp.ndarray:
+    """balanced_allocation.go: std of per-resource utilization fractions
+    (cap==0 resources skipped), score = int64((1-std)·100)."""
+    fracs = []
+    masks = []
+    for name in resources:
+        req, alloc = _resource_req_alloc(static, pod, carry, name, schema)
+        a = alloc.astype(jnp.float64)
+        f = jnp.minimum(req.astype(jnp.float64) / jnp.maximum(a, 1.0), 1.0)
+        fracs.append(f)
+        masks.append(a > 0)
+    f = jnp.stack(fracs, axis=1)       # [N, K]
+    m = jnp.stack(masks, axis=1)       # [N, K] cap>0
+    cnt = jnp.sum(m, axis=1)
+    if len(resources) == 2:
+        # both present -> |f0-f1|/2; one missing -> single fraction, std 0
+        both = cnt == 2
+        std = jnp.where(both, jnp.abs(f[:, 0] - f[:, 1]) / 2.0, 0.0)
+    else:
+        fm = jnp.where(m, f, 0.0)
+        denom = jnp.maximum(cnt, 1).astype(jnp.float64)
+        mean = jnp.sum(fm, axis=1) / denom
+        var = jnp.sum(jnp.where(m, (f - mean[:, None]) ** 2, 0.0), axis=1) / denom
+        # exactly two present fractions a,b (positions unknown):
+        # |a-b| = sqrt(2·Σf² - (Σf)²)
+        s1 = jnp.sum(fm, axis=1)
+        s2 = jnp.sum(jnp.where(m, f * f, 0.0), axis=1)
+        two_std = jnp.sqrt(jnp.maximum(2.0 * s2 - s1 * s1, 0.0)) / 2.0
+        std = jnp.where(cnt > 2, jnp.sqrt(var),
+                        jnp.where(cnt == 2, two_std, 0.0))
+    return ((1.0 - std) * MAX_NODE_SCORE).astype(jnp.int64)
 
 
 def core_bind_update(carry, pod: FitPodXS, sel: jnp.ndarray):

@@ -96,6 +96,10 @@ class PluginSetConfig:
     enabled: list[str] = field(default_factory=default_plugin_names)
     weights: dict[str, int] = field(default_factory=dict)
     custom: dict[str, object] = field(default_factory=dict)
+    # per-plugin pluginConfig args (KubeSchedulerConfiguration
+    # profiles[].pluginConfig[].args), e.g. NodeResourcesFit
+    # scoringStrategy or InterPodAffinity hardPodAffinityWeight
+    args: dict[str, dict] = field(default_factory=dict)
 
     def __post_init__(self):
         order = {n: i for i, n in enumerate(DEFAULT_ORDER)}

@@ -352,29 +352,40 @@ class SequentialScheduler:
             return not any(c.get("whenUnsatisfiable", "DoNotSchedule") == "ScheduleAnyway" for c in cs)
         return False
 
+    def _req_alloc_for(self, rname: str, req, nz, j) -> tuple[int, int]:
+        """(requested incl. this pod, allocatable) for one scored resource;
+        cpu/memory use the non-zero accumulators, others raw requests."""
+        if rname == "cpu":
+            return self.nonzero[j][0] + int(nz[0]), int(self.table.allocatable[j][CPU])
+        if rname == "memory":
+            return self.nonzero[j][1] + int(nz[1]), int(self.table.allocatable[j][MEMORY])
+        if rname in self.schema.columns:
+            c = self.schema.columns.index(rname)
+            return int(self.requested[j][c]) + int(req[c]), int(self.table.allocatable[j][c])
+        return 0, 0
+
     def _score(self, name, pod, req, nz, j) -> int:
         if self.config.is_custom(name):
             return int(self.config.custom[name].score(pod, self.node_manifests[j]))
         if name == "NodeResourcesFit":
+            from ..plugins.fitscoring import parse_fit_strategy, score_resource
+
+            strategy = parse_fit_strategy(self.config.args.get(name))
             total = 0
-            for c, col in ((CPU, 0), (MEMORY, 1)):
-                alloc = int(self.table.allocatable[j][c])
-                r = self.nonzero[j][col] + int(nz[col])
-                if alloc <= 0 or r > alloc:
-                    s = 0
-                else:
-                    s = (alloc - r) * MAX_NODE_SCORE // alloc
-                total += s
-            return total // 2
+            for rname, w in strategy.resources:
+                r, alloc = self._req_alloc_for(rname, req, nz, j)
+                total += score_resource(strategy, r, alloc) * w
+            return total // strategy.weight_sum
         if name == "NodeResourcesBalancedAllocation":
+            from ..plugins.fitscoring import balanced_std, parse_balanced_resources
+
             fracs = []
-            for c, col in ((CPU, 0), (MEMORY, 1)):
-                alloc = int(self.table.allocatable[j][c])
+            for rname in parse_balanced_resources(self.config.args.get(name)):
+                r, alloc = self._req_alloc_for(rname, req, nz, j)
                 if alloc <= 0:
-                    return 0
-                fracs.append(min(float(self.nonzero[j][col] + int(nz[col])) / float(alloc), 1.0))
-            std = abs(fracs[0] - fracs[1]) / 2.0
-            return int((1.0 - std) * MAX_NODE_SCORE)
+                    continue  # upstream skips cap==0 resources
+                fracs.append(min(float(r) / float(alloc), 1.0))
+            return int((1.0 - balanced_std(fracs)) * MAX_NODE_SCORE)
         if name == "NodeAffinity":
             pref = (((_spec(pod).get("affinity") or {}).get("nodeAffinity")) or {}).get(
                 "preferredDuringSchedulingIgnoredDuringExecution"
@@ -691,7 +702,8 @@ class SequentialScheduler:
         for term, w in self._pod_terms(pod, "podAntiAffinity", True):
             counts, _ = self._term_counts_by_domain(term, ns)
             own.append((term.get("topologyKey", ""), counts, -w))
-        hard_w = 1  # args.hardPodAffinityWeight default
+        hard_w = int((self.config.args.get("InterPodAffinity") or {})
+                     .get("hardPodAffinityWeight") or 1)
         sym: dict[tuple[str, str], int] = {}
         for ap, aj in self.assigned:
             ans = _meta(ap).get("namespace") or "default"

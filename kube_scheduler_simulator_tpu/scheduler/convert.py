@@ -151,4 +151,10 @@ def parse_plugin_set(cfg: dict | None) -> PluginSetConfig:
             weights[name] = w if w != 0 else 1
     for d in score.get("disabled") or []:
         weights.pop((d.get("name") or "").removesuffix(WRAPPED_SUFFIX), None)
-    return PluginSetConfig(enabled=enabled, weights=weights)
+
+    args: dict[str, dict] = {}
+    for pc in (profiles[0].get("pluginConfig") or []) if profiles else []:
+        name = (pc.get("name") or "").removesuffix(WRAPPED_SUFFIX)
+        if name and pc.get("args"):
+            args[name] = pc["args"]
+    return PluginSetConfig(enabled=enabled, weights=weights, args=args)

@@ -197,7 +197,12 @@ def compile_workload(
         # share the same term ids; then slice the per-pod xs back to the
         # queue and fold the bound rows into the initial carry.
         bound_manifests = [bp for bp, _ in bound_pods]
-        st, x_all, carry = interpod.build(table, pods + bound_manifests)
+        st, x_all, carry = interpod.build(
+            table, pods + bound_manifests,
+            hard_weight=int((config.args.get("InterPodAffinity") or {})
+                            .get("hardPodAffinityWeight")
+                            or interpod.DEFAULT_HARD_POD_AFFINITY_WEIGHT),
+        )
         statics["InterPodAffinity"] = st
         xs["InterPodAffinity"] = interpod.InterPodXS(
             *[v[:p] for v in x_all]

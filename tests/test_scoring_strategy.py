@@ -1,0 +1,142 @@
+"""scoringStrategy pluginConfig args: LeastAllocated weights,
+MostAllocated, RequestedToCapacityRatio, balanced-allocation resources,
+InterPodAffinity hardPodAffinityWeight — tensor path vs sequential
+oracle parity plus hand-computed goldens."""
+
+import json
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+from kube_scheduler_simulator_tpu.plugins.fitscoring import (
+    FitStrategy, parse_fit_strategy, score_resource)
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store import annotations as ann
+from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+
+def _nodes():
+    return [
+        {"metadata": {"name": "node-a"},
+         "status": {"allocatable": {"cpu": "2", "memory": "4Gi", "pods": "10"}}},
+        {"metadata": {"name": "node-b"},
+         "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}}},
+    ]
+
+
+def _pod():
+    return [{"kind": "Pod", "metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "2Gi"}}}]}}]
+
+
+def _run(cfg):
+    rr = replay(compile_workload(_nodes(), _pod(), cfg), chunk=2)
+    scores = json.loads(decode_pod_result(rr, 0)[ann.SCORE_RESULT])
+    return scores, rr
+
+
+def _assert_parity(nodes, pods, cfg):
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=max(len(pods), 1))
+    for i, (sa, ss) in enumerate(seq):
+        da = decode_pod_result(rr, i)
+        assert int(rr.selected[i]) == ss
+        for k in sa:
+            assert da[k] == sa[k], f"pod {i} {k}"
+
+
+def test_score_resource_scalar_goldens():
+    least = FitStrategy("LeastAllocated", (("cpu", 1),), ())
+    most = FitStrategy("MostAllocated", (("cpu", 1),), ())
+    assert score_resource(least, 500, 2000) == 75
+    assert score_resource(most, 500, 2000) == 25
+    assert score_resource(least, 3000, 2000) == 0
+    # shape: score already x10 after parsing; raw (u=0,s=0),(u=100,s=10)
+    r2c = parse_fit_strategy({"scoringStrategy": {
+        "type": "RequestedToCapacityRatio",
+        "resources": [{"name": "cpu", "weight": 1}],
+        "requestedToCapacityRatio": {"shape": [
+            {"utilization": 0, "score": 0}, {"utilization": 100, "score": 10}]}}})
+    assert score_resource(r2c, 500, 2000) == 25   # util 25 -> 25
+    assert score_resource(r2c, 3000, 2000) == 100  # over capacity -> f(100)
+
+
+def test_most_allocated_prefers_packed_node():
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"], args={
+        "NodeResourcesFit": {"scoringStrategy": {"type": "MostAllocated"}}})
+    scores, rr = _run(cfg)
+    # node-a util: cpu 50, mem 50 -> 50; node-b: 25 -> selected node-a
+    assert scores["node-a"]["NodeResourcesFit"] == "50"
+    assert scores["node-b"]["NodeResourcesFit"] == "25"
+    assert rr.selected_node_name(0) == "node-a"
+
+
+def test_least_allocated_custom_weights():
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"], args={
+        "NodeResourcesFit": {"scoringStrategy": {
+            "type": "LeastAllocated",
+            "resources": [{"name": "cpu", "weight": 3}, {"name": "memory", "weight": 1}]}}})
+    scores, _ = _run(cfg)
+    # node-a: (50*3 + 50*1)//4 = 50; node-b: (75*3+75)//4 = 75
+    assert scores["node-a"]["NodeResourcesFit"] == "50"
+    assert scores["node-b"]["NodeResourcesFit"] == "75"
+
+
+def test_requested_to_capacity_ratio_tensor():
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"], args={
+        "NodeResourcesFit": {"scoringStrategy": {
+            "type": "RequestedToCapacityRatio",
+            "resources": [{"name": "cpu", "weight": 1}],
+            "requestedToCapacityRatio": {"shape": [
+                {"utilization": 0, "score": 10},
+                {"utilization": 100, "score": 0}]}}}})
+    scores, rr = _run(cfg)
+    # spread-out shape (prefer empty): node-a util 50 -> 50; node-b 25 -> 75
+    assert scores["node-a"]["NodeResourcesFit"] == "50"
+    assert scores["node-b"]["NodeResourcesFit"] == "75"
+    assert rr.selected_node_name(0) == "node-b"
+
+
+def test_strategy_parity_random_workload():
+    nodes = make_nodes(6, seed=90)
+    pods = make_pods(10, seed=91)
+    for args in (
+        {"NodeResourcesFit": {"scoringStrategy": {"type": "MostAllocated"}}},
+        {"NodeResourcesFit": {"scoringStrategy": {
+            "type": "LeastAllocated",
+            "resources": [{"name": "cpu", "weight": 2}, {"name": "memory", "weight": 5}]}}},
+        {"NodeResourcesFit": {"scoringStrategy": {
+            "type": "RequestedToCapacityRatio",
+            "resources": [{"name": "cpu", "weight": 1}, {"name": "memory", "weight": 2}],
+            "requestedToCapacityRatio": {"shape": [
+                {"utilization": 0, "score": 0},
+                {"utilization": 40, "score": 9},
+                {"utilization": 100, "score": 3}]}}}},
+    ):
+        cfg = PluginSetConfig(
+            enabled=["NodeResourcesFit", "NodeResourcesBalancedAllocation"],
+            args=args)
+        _assert_parity(nodes, pods, cfg)
+
+
+def test_hard_pod_affinity_weight_parity():
+    nodes = make_nodes(4, seed=92)
+    pods = make_pods(8, seed=93, with_interpod=True)
+    cfg = PluginSetConfig(
+        enabled=["NodeResourcesFit", "InterPodAffinity"],
+        args={"InterPodAffinity": {"hardPodAffinityWeight": 50}})
+    _assert_parity(nodes, pods, cfg)
+
+
+def test_args_flow_from_scheduler_config():
+    from kube_scheduler_simulator_tpu.scheduler.convert import parse_plugin_set
+
+    cfg = parse_plugin_set({"profiles": [{
+        "plugins": {"multiPoint": {"enabled": [{"name": "NodeResourcesFit"}],
+                                   "disabled": [{"name": "*"}]}},
+        "pluginConfig": [
+            {"name": "NodeResourcesFitWrapped",
+             "args": {"scoringStrategy": {"type": "MostAllocated"}}}],
+    }]})
+    assert cfg.args["NodeResourcesFit"]["scoringStrategy"]["type"] == "MostAllocated"
