@@ -44,13 +44,33 @@ class NodeAffinityXS(NamedTuple):
     score_skip: jnp.ndarray     # [P] bool (PreScore returned Skip)
 
 
-def build(table: NodeTable, pods: list[dict]) -> NodeAffinityXS:
+def build(table: NodeTable, pods: list[dict],
+          args: dict | None = None) -> NodeAffinityXS:
     n, p = table.n, len(pods)
     labels = table.labels
     required_ok = np.ones((p, n), dtype=bool)
     pref_raw = np.zeros((p, n), dtype=np.int32)
     filter_skip = np.zeros(p, dtype=bool)
     score_skip = np.zeros(p, dtype=bool)
+
+    # addedAffinity (NodeAffinityArgs): admin-configured affinity ANDed
+    # onto every pod (upstream node_affinity.go); with it present,
+    # PreFilter/PreScore never Skip
+    added = (args or {}).get("addedAffinity") or {}
+    added_req = added.get("requiredDuringSchedulingIgnoredDuringExecution")
+    added_pref = added.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+    added_req_row = None
+    if added_req:
+        added_req_row = np.array(
+            [node_selector_matches(added_req, labels[j], table.names[j])
+             for j in range(n)], dtype=bool)
+    added_pref_row = None
+    if added_pref:
+        added_pref_row = np.array(
+            [sum(int(t.get("weight", 0)) for t in added_pref
+                 if node_selector_term_matches(t.get("preference") or {},
+                                               labels[j], table.names[j]))
+             for j in range(n)], dtype=np.int32)
 
     req_rows: dict[str, np.ndarray] = {}   # unique spec -> [N] row
     pref_rows: dict[str, np.ndarray] = {}
@@ -61,7 +81,7 @@ def build(table: NodeTable, pods: list[dict]) -> NodeAffinityXS:
         required = aff.get("requiredDuringSchedulingIgnoredDuringExecution")
         preferred = aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []
 
-        if not node_sel and not required:
+        if not node_sel and not required and added_req_row is None:
             filter_skip[i] = True
         else:
             key = spec_key(node_sel, required)
@@ -76,9 +96,9 @@ def build(table: NodeTable, pods: list[dict]) -> NodeAffinityXS:
                         ok = node_selector_matches(required, labels[j], table.names[j])
                     row[j] = ok
                 req_rows[key] = row
-            required_ok[i] = row
+            required_ok[i] = row if added_req_row is None else (row & added_req_row)
 
-        if not preferred:
+        if not preferred and added_pref_row is None:
             score_skip[i] = True
         else:
             key = spec_key(preferred)
@@ -93,7 +113,7 @@ def build(table: NodeTable, pods: list[dict]) -> NodeAffinityXS:
                             s += w
                     row[j] = s
                 pref_rows[key] = row
-            pref_raw[i] = row
+            pref_raw[i] = row if added_pref_row is None else (row + added_pref_row)
 
     return NodeAffinityXS(
         required_ok=jnp.asarray(required_ok),

@@ -63,6 +63,8 @@ class SequentialScheduler:
             self.vt, bound_pods or [],
             {nm: j for j, nm in enumerate(self.table.names)},
         ))
+        self._added_affinity = (self.config.args.get("NodeAffinity") or {}).get(
+            "addedAffinity") or {}
         self.labels = self.table.labels
         self.names = self.table.names
         self.n = self.table.n
@@ -109,6 +111,10 @@ class SequentialScheduler:
             ok = all(self.labels[j].get(k) == str(v) for k, v in sel.items())
             if ok and required:
                 ok = node_selector_matches(required, self.labels[j], self.names[j])
+            added_req = self._added_affinity.get(
+                "requiredDuringSchedulingIgnoredDuringExecution")
+            if ok and added_req:
+                ok = node_selector_matches(added_req, self.labels[j], self.names[j])
             return None if ok else "node(s) didn't match Pod's node affinity/selector"
         if name == "TaintToleration":
             tols = _spec(pod).get("tolerations") or []
@@ -314,7 +320,9 @@ class SequentialScheduler:
             req = (((spec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
                 "requiredDuringSchedulingIgnoredDuringExecution"
             )
-            return not spec.get("nodeSelector") and not req
+            return (not spec.get("nodeSelector") and not req
+                    and not self._added_affinity.get(
+                        "requiredDuringSchedulingIgnoredDuringExecution"))
         if name == "PodTopologySpread":
             cs = _spec(pod).get("topologySpreadConstraints") or []
             return not any(c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" for c in cs)
@@ -346,7 +354,8 @@ class SequentialScheduler:
             pref = (((_spec(pod).get("affinity") or {}).get("nodeAffinity")) or {}).get(
                 "preferredDuringSchedulingIgnoredDuringExecution"
             )
-            return not pref
+            return not pref and not self._added_affinity.get(
+                "preferredDuringSchedulingIgnoredDuringExecution")
         if name == "PodTopologySpread":
             cs = _spec(pod).get("topologySpreadConstraints") or []
             return not any(c.get("whenUnsatisfiable", "DoNotSchedule") == "ScheduleAnyway" for c in cs)
@@ -390,6 +399,8 @@ class SequentialScheduler:
             pref = (((_spec(pod).get("affinity") or {}).get("nodeAffinity")) or {}).get(
                 "preferredDuringSchedulingIgnoredDuringExecution"
             ) or []
+            pref = pref + (self._added_affinity.get(
+                "preferredDuringSchedulingIgnoredDuringExecution") or [])
             s = 0
             for term in pref:
                 if node_selector_term_matches(term.get("preference") or {}, self.labels[j], self.names[j]):

@@ -124,7 +124,8 @@ def compile_workload(
     )
 
     if "NodeAffinity" in enabled:
-        xs["NodeAffinity"] = affinity.build(table, pods)
+        xs["NodeAffinity"] = affinity.build(
+            table, pods, args=config.args.get("NodeAffinity"))
     if "NodePorts" in enabled:
         st, x, carry = ports.build(table, pods, bound_pods)
         statics["NodePorts"] = st

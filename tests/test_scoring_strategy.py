@@ -140,3 +140,48 @@ def test_args_flow_from_scheduler_config():
              "args": {"scoringStrategy": {"type": "MostAllocated"}}}],
     }]})
     assert cfg.args["NodeResourcesFit"]["scoringStrategy"]["type"] == "MostAllocated"
+
+
+def test_added_affinity_filters_and_scores():
+    """NodeAffinityArgs.addedAffinity: ANDed required selector + added
+    preferred terms apply to EVERY pod (pods with no affinity of their
+    own included)."""
+    nodes = [
+        {"metadata": {"name": "gold", "labels": {"tier": "gold"}},
+         "status": {"allocatable": {"cpu": "8", "memory": "32Gi", "pods": "10"}}},
+        {"metadata": {"name": "plain", "labels": {"tier": "plain"}},
+         "status": {"allocatable": {"cpu": "8", "memory": "32Gi", "pods": "10"}}},
+    ]
+    pods = [{"kind": "Pod", "metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}]}}]
+    cfg = PluginSetConfig(
+        enabled=["NodeResourcesFit", "NodeAffinity"],
+        args={"NodeAffinity": {"addedAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [
+                    {"key": "tier", "operator": "In", "values": ["gold", "plain"]}]}]},
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 30, "preference": {"matchExpressions": [
+                    {"key": "tier", "operator": "In", "values": ["gold"]}]}}],
+        }}})
+    _assert_parity(nodes, pods, cfg)
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=1)
+    assert rr.selected_node_name(0) == "gold"
+    da = decode_pod_result(rr, 0)
+    scores = json.loads(da[ann.SCORE_RESULT])
+    assert scores["gold"]["NodeAffinity"] == "30"
+    assert scores["plain"]["NodeAffinity"] == "0"
+
+    # required part actually rejects
+    cfg2 = PluginSetConfig(
+        enabled=["NodeResourcesFit", "NodeAffinity"],
+        args={"NodeAffinity": {"addedAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [
+                    {"key": "tier", "operator": "In", "values": ["gold"]}]}]},
+        }}})
+    _assert_parity(nodes, pods, cfg2)
+    rr2 = replay(compile_workload(nodes, pods, cfg2), chunk=1)
+    fr = json.loads(decode_pod_result(rr2, 0)[ann.FILTER_RESULT])
+    assert fr["plain"]["NodeAffinity"] == "node(s) didn't match Pod's node affinity/selector"
+    assert rr2.selected_node_name(0) == "gold"
