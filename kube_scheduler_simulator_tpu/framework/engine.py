@@ -149,8 +149,12 @@ class SchedulerEngine:
                 for hook in self.plugin_extenders:
                     hook.after_cycle(pod, annotations, self.result_store)
                 sel = int(rr.selected[i])
+                if sel >= 0 and not self._run_custom_lifecycle(
+                        pod, ns, name, cw.node_table.names[sel]):
+                    sel = -1  # a custom Reserve/Permit/PreBind rejected
                 if sel >= 0:
                     self._bind(ns, name, cw.node_table.names[sel])
+                    self._run_custom_postbind(pod, cw.node_table.names[sel])
                     n_bound += 1
                 else:
                     # PreFilter-rejected pods skip preemption: the static
@@ -164,6 +168,77 @@ class SchedulerEngine:
                     self._mark_unschedulable(ns, name)
                 self.reflector.reflect(ns, name)
         return n_bound, any_preempted
+
+    def _custom_lifecycle_plugins(self) -> list:
+        return [
+            p for n, p in self.plugin_config.custom.items()
+            if n in self.plugin_config.enabled and getattr(p, "has_lifecycle", False)
+        ]
+
+    def _run_custom_lifecycle(self, pod, ns: str, name: str, node_name: str) -> bool:
+        """Reserve -> Permit -> PreBind -> (caller binds) -> PostBind for
+        custom plugins, upstream phase ordering (all Reserves, then all
+        Permits, then all PreBinds; Unreserve in reverse on any failure,
+        scheduleOne semantics).  Returns False when the pod must not bind.
+        A Permit "wait" is recorded then allowed (docs/SEMANTICS.md —
+        there is no async wait loop to park the pod in)."""
+        plugins = self._custom_lifecycle_plugins()
+        if not plugins:
+            return True
+        node = None
+        try:
+            node = self.store.get("nodes", node_name)
+        except NotFound:
+            pass
+        rs = self.result_store
+
+        def unreserve_all(upto: int) -> None:
+            for q in reversed(plugins[:upto]):
+                if q.has_unreserve:
+                    q.unreserve(pod, node)
+
+        for idx, p in enumerate(plugins):
+            if not p.has_reserve:
+                continue
+            msg = p.reserve(pod, node)
+            rs.add_reserve_result(ns, name, p.name,
+                                  msg if msg else ann.SUCCESS_MESSAGE)
+            if msg:
+                unreserve_all(idx + 1)
+                return False
+        for p in plugins:
+            if not p.has_permit:
+                continue
+            out = p.permit(pod, node)
+            if out is None:
+                rs.add_permit_result(ns, name, p.name, ann.SUCCESS_MESSAGE, "0s")
+            elif isinstance(out, tuple):
+                rs.add_permit_result(ns, name, p.name, ann.WAIT_MESSAGE,
+                                     str(out[1]))
+            else:
+                rs.add_permit_result(ns, name, p.name, str(out), "0s")
+                unreserve_all(len(plugins))
+                return False
+        for p in plugins:
+            if not p.has_pre_bind:
+                continue
+            msg = p.pre_bind(pod, node)
+            rs.add_pre_bind_result(ns, name, p.name,
+                                   msg if msg else ann.SUCCESS_MESSAGE)
+            if msg:
+                unreserve_all(len(plugins))
+                return False
+        return True
+
+    def _run_custom_postbind(self, pod, node_name: str) -> None:
+        """PostBind (observation only, after the successful bind)."""
+        try:
+            node = self.store.get("nodes", node_name)
+        except NotFound:
+            node = None
+        for p in self._custom_lifecycle_plugins():
+            if p.has_post_bind:
+                p.post_bind(pod, node)
 
     def _run_postfilter(self, cw, filter_codes, pod_idx, pod, ns: str, name: str) -> bool:
         """Run DefaultPreemption for an unschedulable pod; record the
@@ -322,6 +397,9 @@ class SchedulerEngine:
                 hook.after_cycle(pod, annotations, self.result_store)
 
             bind_ok = sel >= 0 and not ext_error
+            if bind_ok and not self._run_custom_lifecycle(pod, ns, name, names[sel]):
+                bind_ok = False
+                sel = -1
             if bind_ok:
                 bound_node = names[sel]
                 bind_ext = next(

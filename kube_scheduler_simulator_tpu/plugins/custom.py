@@ -47,6 +47,29 @@ class CustomPlugin:
     def normalize(self, scores: list[int]) -> list[int]:
         return list(scores)
 
+    # host-side lifecycle extension points, run around the bind of the
+    # pod's winning node (the reference wraps these for out-of-tree
+    # plugins too, wrappedplugin.go:588-752); statuses are recorded into
+    # the reserve/permit/prebind result annotations
+    def reserve(self, pod: dict, node: dict) -> str | None:  # pragma: no cover
+        """None == success; a message rejects (Unreserve runs)."""
+        raise NotImplementedError
+
+    def unreserve(self, pod: dict, node: dict) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def permit(self, pod: dict, node: dict):  # pragma: no cover
+        """None == allow; ("wait", timeout_str) records wait then allows
+        (docs/SEMANTICS.md); a message denies."""
+        raise NotImplementedError
+
+    def pre_bind(self, pod: dict, node: dict) -> str | None:  # pragma: no cover
+        """None == success; a message fails the bind."""
+        raise NotImplementedError
+
+    def post_bind(self, pod: dict, node: dict) -> None:  # pragma: no cover
+        raise NotImplementedError
+
     @property
     def has_filter(self) -> bool:
         return type(self).filter is not CustomPlugin.filter
@@ -58,6 +81,31 @@ class CustomPlugin:
     @property
     def has_normalize(self) -> bool:
         return type(self).normalize is not CustomPlugin.normalize
+
+    @property
+    def has_reserve(self) -> bool:
+        return type(self).reserve is not CustomPlugin.reserve
+
+    @property
+    def has_unreserve(self) -> bool:
+        return type(self).unreserve is not CustomPlugin.unreserve
+
+    @property
+    def has_permit(self) -> bool:
+        return type(self).permit is not CustomPlugin.permit
+
+    @property
+    def has_pre_bind(self) -> bool:
+        return type(self).pre_bind is not CustomPlugin.pre_bind
+
+    @property
+    def has_post_bind(self) -> bool:
+        return type(self).post_bind is not CustomPlugin.post_bind
+
+    @property
+    def has_lifecycle(self) -> bool:
+        return (self.has_reserve or self.has_permit or self.has_pre_bind
+                or self.has_post_bind)
 
 
 class CustomXS(NamedTuple):

@@ -17,6 +17,7 @@ values overwrite the decoded blob for the touched keys.
 
 from __future__ import annotations
 
+import json
 import threading
 
 from . import annotations as ann
@@ -152,37 +153,43 @@ class ResultStore:
                 return None
             out = dict(r.decoded)
 
-            def put(key, value):
-                # granular adds overwrite the decoded blob for their key
-                # only if any granular data exists for it
-                out[key] = value
+            def put(key, granular, nested=False):
+                """Merge granular adds OVER the decoded blob for the key:
+                a custom plugin's Reserve result must not erase an
+                in-tree plugin's decoded entry under the same key."""
+                if not granular:
+                    if key not in out:
+                        out[key] = ann.marshal({} if not isinstance(granular, str) else "")
+                    return
+                base = {}
+                if key in out:
+                    try:
+                        base = json.loads(out[key])
+                    except ValueError:
+                        base = {}
+                    if not isinstance(base, dict):
+                        base = {}
+                if nested:
+                    for node, plugins in granular.items():
+                        base.setdefault(node, {}).update(plugins)
+                else:
+                    base.update(granular)
+                out[key] = ann.marshal(base)
 
-            if r.pre_filter_result or ann.PRE_FILTER_RESULT not in out:
-                put(ann.PRE_FILTER_RESULT, ann.marshal(r.pre_filter_result))
-            if r.pre_filter_status or ann.PRE_FILTER_STATUS_RESULT not in out:
-                put(ann.PRE_FILTER_STATUS_RESULT, ann.marshal(r.pre_filter_status))
-            if r.filter or ann.FILTER_RESULT not in out:
-                put(ann.FILTER_RESULT, ann.marshal(r.filter))
-            if r.post_filter or ann.POST_FILTER_RESULT not in out:
-                put(ann.POST_FILTER_RESULT, ann.marshal(r.post_filter))
-            if r.pre_score or ann.PRE_SCORE_RESULT not in out:
-                put(ann.PRE_SCORE_RESULT, ann.marshal(r.pre_score))
-            if r.score or ann.SCORE_RESULT not in out:
-                put(ann.SCORE_RESULT, ann.marshal(r.score))
-            if r.final_score or ann.FINAL_SCORE_RESULT not in out:
-                put(ann.FINAL_SCORE_RESULT, ann.marshal(r.final_score))
-            if r.reserve or ann.RESERVE_RESULT not in out:
-                put(ann.RESERVE_RESULT, ann.marshal(r.reserve))
-            if r.permit or ann.PERMIT_STATUS_RESULT not in out:
-                put(ann.PERMIT_STATUS_RESULT, ann.marshal(r.permit))
-            if r.permit_timeout or ann.PERMIT_TIMEOUT_RESULT not in out:
-                put(ann.PERMIT_TIMEOUT_RESULT, ann.marshal(r.permit_timeout))
-            if r.prebind or ann.PRE_BIND_RESULT not in out:
-                put(ann.PRE_BIND_RESULT, ann.marshal(r.prebind))
-            if r.bind or ann.BIND_RESULT not in out:
-                put(ann.BIND_RESULT, ann.marshal(r.bind))
+            put(ann.PRE_FILTER_RESULT, r.pre_filter_result)
+            put(ann.PRE_FILTER_STATUS_RESULT, r.pre_filter_status)
+            put(ann.FILTER_RESULT, r.filter, nested=True)
+            put(ann.POST_FILTER_RESULT, r.post_filter, nested=True)
+            put(ann.PRE_SCORE_RESULT, r.pre_score)
+            put(ann.SCORE_RESULT, r.score, nested=True)
+            put(ann.FINAL_SCORE_RESULT, r.final_score, nested=True)
+            put(ann.RESERVE_RESULT, r.reserve)
+            put(ann.PERMIT_STATUS_RESULT, r.permit)
+            put(ann.PERMIT_TIMEOUT_RESULT, r.permit_timeout)
+            put(ann.PRE_BIND_RESULT, r.prebind)
+            put(ann.BIND_RESULT, r.bind)
             if r.selected_node or ann.SELECTED_NODE not in out:
-                put(ann.SELECTED_NODE, r.selected_node)
+                out[ann.SELECTED_NODE] = r.selected_node
             out.update(r.custom)
             return out
 
