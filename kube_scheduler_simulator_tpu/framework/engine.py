@@ -24,6 +24,7 @@ from ..cluster.store import Conflict, NotFound, ObjectStore
 from ..utils.tracing import TRACER
 from ..plugins.registry import PluginSetConfig
 from ..state.compile import compile_workload
+from ..store import annotations as ann
 from ..store.decode import decode_pod_result
 from ..store.reflector import StoreReflector
 from ..store.resultstore import ResultStore
@@ -46,6 +47,9 @@ class SchedulerEngine:
         self.chunk = chunk
         self.extender_service = None
         self.plugin_extenders: list = []
+        # pods parked by Permit "wait" (upstream waitingPods map analogue),
+        # keyed (namespace, name); external threads may allow()/reject()
+        self.waiting_pods: dict[tuple[str, str], "WaitingPod"] = {}
 
     def set_plugin_config(self, cfg: PluginSetConfig) -> None:
         # validates by constructing; the service uses this for rollback
@@ -79,24 +83,42 @@ class SchedulerEngine:
 
     def schedule_pending(self) -> int:
         """One scheduling wave over all pending pods (plus retry waves for
-        pods unblocked by preemption). Returns #bound."""
+        pods unblocked by preemption, and re-runs after a custom
+        Reserve/Permit/PreBind rejected a speculative placement). Returns
+        #bound."""
         n_bound = 0
-        for _ in range(8):  # preemption retry bound; one wave normally
-            bound, preempted = self._schedule_wave()
+        rejected: set[tuple[str, str]] = set()
+        max_waves = 8 + len(self.pending_pods())
+        for _ in range(max_waves):
+            bound, retry = self._schedule_wave(exclude=rejected)
             n_bound += bound
             TRACER.count("pods_scheduled_total", bound)
             TRACER.count("scheduling_waves_total")
-            if preempted:
+            if retry == "preempted":
                 TRACER.count("preemption_waves_total")
-            if not preempted:
+            if not retry:
                 break
         # count unschedulable once per pass, not per retry wave
         TRACER.count("pods_unschedulable_total", len(self.pending_pods()))
         return n_bound
 
-    def _schedule_wave(self) -> tuple[int, bool]:
-        """One scheduling wave. Returns (#bound, any preemption happened)."""
+    def _schedule_wave(self, exclude: set[tuple[str, str]] | None = None
+                       ) -> tuple[int, str | None]:
+        """One scheduling wave. Returns (#bound, retry reason or None).
+
+        retry == "preempted": preemption nominated a node, run a retry wave.
+        retry == "rejected": a custom Reserve/Permit/PreBind rejected a pod
+        AFTER the device replay speculatively folded it into the carry —
+        the rest of the wave is re-run with upstream-sequential state (the
+        rejected pod excluded), so later pods never observe the phantom
+        bind (upstream scheduleOne semantics)."""
         pending = self.pending_pods()
+        if exclude:
+            pending = [
+                p for p in pending
+                if ((p.get("metadata") or {}).get("namespace") or "default",
+                    (p.get("metadata") or {}).get("name", "")) not in exclude
+            ]
         if self.plugin_config.preenqueues():
             # SchedulingGates PreEnqueue: gated pods never enter the queue
             gated = [
@@ -111,7 +133,7 @@ class SchedulerEngine:
                     if not (p.get("spec") or {}).get("schedulingGates")
                 ]
         if not pending:
-            return 0, False
+            return 0, None
         nodes, _ = self.store.list("nodes")
         pods_all, _ = self.store.list("pods")
         bound = [
@@ -139,7 +161,7 @@ class SchedulerEngine:
         postfilter_on = bool(self.plugin_config.postfilters())
 
         n_bound = 0
-        any_preempted = False
+        retry: str | None = None
         with TRACER.span("commit_and_reflect", pods=len(pending)):
             for i, pod in enumerate(pending):
                 meta = pod.get("metadata") or {}
@@ -151,7 +173,15 @@ class SchedulerEngine:
                 sel = int(rr.selected[i])
                 if sel >= 0 and not self._run_custom_lifecycle(
                         pod, ns, name, cw.node_table.names[sel]):
-                    sel = -1  # a custom Reserve/Permit/PreBind rejected
+                    # a custom Reserve/Permit/PreBind rejected, but the
+                    # device replay already folded this pod into the carry;
+                    # abandon the rest of the wave and re-run it without
+                    # this pod so later pods see true (unbound) state
+                    self._mark_unschedulable(ns, name)
+                    self.reflector.reflect(ns, name)
+                    if exclude is not None:
+                        exclude.add((ns, name))
+                    return n_bound, "rejected"
                 if sel >= 0:
                     self._bind(ns, name, cw.node_table.names[sel])
                     self._run_custom_postbind(pod, cw.node_table.names[sel])
@@ -162,12 +192,12 @@ class SchedulerEngine:
                     # ReadWriteOncePod preemption (preempting the PVC holder)
                     # is not modeled — documented divergence
                     if postfilter_on and int(rr.prefilter_reject[i]) == 0:
-                        any_preempted |= self._run_postfilter(
-                            cw, rr.filter_codes[i], i, pod, ns, name
-                        )
+                        if self._run_postfilter(
+                                cw, rr.filter_codes[i], i, pod, ns, name):
+                            retry = "preempted"
                     self._mark_unschedulable(ns, name)
                 self.reflector.reflect(ns, name)
-        return n_bound, any_preempted
+        return n_bound, retry
 
     def _custom_lifecycle_plugins(self) -> list:
         return [
@@ -178,13 +208,23 @@ class SchedulerEngine:
     def _run_custom_lifecycle(self, pod, ns: str, name: str, node_name: str) -> bool:
         """Reserve -> Permit -> PreBind -> (caller binds) -> PostBind for
         custom plugins, upstream phase ordering (all Reserves, then all
-        Permits, then all PreBinds; Unreserve in reverse on any failure,
-        scheduleOne semantics).  Returns False when the pod must not bind.
-        A Permit "wait" is recorded then allowed (docs/SEMANTICS.md —
-        there is no async wait loop to park the pod in)."""
+        Permits, then all PreBinds; Unreserve runs for ALL reserve plugins
+        in reverse order on any failure — scheduleOne calls
+        RunReservePluginsUnreserve unconditionally over the full list).
+        Returns False when the pod must not bind.
+
+        A Permit "wait" parks the pod in self.waiting_pods with the
+        plugin's timeout (upstream waitingPods map); the plugin's optional
+        on_waiting(handle) is invoked, then the engine blocks until every
+        waiting plugin allowed, one rejected, or the timeout expired
+        (reference: wrappedplugin.go:588-620 + upstream
+        runtime/waiting_pods_map.go)."""
         plugins = self._custom_lifecycle_plugins()
         if not plugins:
             return True
+        from .waiting import WaitingPod
+        from ..utils.duration import parse_duration_seconds
+
         node = None
         try:
             node = self.store.get("nodes", node_name)
@@ -192,20 +232,21 @@ class SchedulerEngine:
             pass
         rs = self.result_store
 
-        def unreserve_all(upto: int) -> None:
-            for q in reversed(plugins[:upto]):
+        def unreserve_all() -> None:
+            for q in reversed(plugins):
                 if q.has_unreserve:
                     q.unreserve(pod, node)
 
-        for idx, p in enumerate(plugins):
+        for p in plugins:
             if not p.has_reserve:
                 continue
             msg = p.reserve(pod, node)
             rs.add_reserve_result(ns, name, p.name,
                                   msg if msg else ann.SUCCESS_MESSAGE)
             if msg:
-                unreserve_all(idx + 1)
+                unreserve_all()
                 return False
+        waits: list[tuple] = []  # (plugin, timeout_str)
         for p in plugins:
             if not p.has_permit:
                 continue
@@ -215,9 +256,34 @@ class SchedulerEngine:
             elif isinstance(out, tuple):
                 rs.add_permit_result(ns, name, p.name, ann.WAIT_MESSAGE,
                                      str(out[1]))
+                waits.append((p, str(out[1])))
             else:
                 rs.add_permit_result(ns, name, p.name, str(out), "0s")
-                unreserve_all(len(plugins))
+                unreserve_all()
+                return False
+        if waits:
+            timeouts = {}
+            for p, t in waits:
+                try:
+                    timeouts[p.name] = parse_duration_seconds(t)
+                except ValueError:
+                    timeouts[p.name] = 0.0
+            wp = WaitingPod(pod, timeouts)
+            self.waiting_pods[(ns, name)] = wp
+            try:
+                for p, _ in waits:
+                    on_waiting = getattr(p, "on_waiting", None)
+                    if callable(on_waiting):
+                        on_waiting(wp)
+                rejection = wp.wait()
+            finally:
+                self.waiting_pods.pop((ns, name), None)
+            if rejection is not None:
+                plugin_name, msg = rejection
+                timeout_str = next(
+                    (t for p, t in waits if p.name == plugin_name), "0s")
+                rs.add_permit_result(ns, name, plugin_name, msg, timeout_str)
+                unreserve_all()
                 return False
         for p in plugins:
             if not p.has_pre_bind:
@@ -226,7 +292,7 @@ class SchedulerEngine:
             rs.add_pre_bind_result(ns, name, p.name,
                                    msg if msg else ann.SUCCESS_MESSAGE)
             if msg:
-                unreserve_all(len(plugins))
+                unreserve_all()
                 return False
         return True
 
@@ -276,7 +342,7 @@ class SchedulerEngine:
         self._update_pod(ns, name, nominate)
         return True
 
-    def _schedule_with_extenders(self, cw, pending) -> tuple[int, bool]:
+    def _schedule_with_extenders(self, cw, pending) -> tuple[int, str | None]:
         """Phased path: device eval -> extender Filter/Prioritize over HTTP
         -> host selection -> device bind (the reference's extender
         round-trip, SURVEY.md §3.3, spliced into the tensor pipeline)."""
@@ -301,14 +367,14 @@ class SchedulerEngine:
             extender_span.__exit__(None, None, None)
 
     def _extender_pod_loop(self, cw, pending, eval_fn, bind_fn, carry, names,
-                           name_to_idx, postfilter_on) -> tuple[int, bool]:
+                           name_to_idx, postfilter_on) -> tuple[int, str | None]:
         import jax
         import numpy as np
 
         from .replay import ReplayResult
 
         n_bound = 0
-        any_preempted = False
+        retry: str | None = None
         for i, pod in enumerate(pending):
             sl = jax.tree.map(lambda a: a[i] if hasattr(a, "ndim") and a.ndim else a, cw.xs)
             out = eval_fn(carry, sl)
@@ -397,8 +463,12 @@ class SchedulerEngine:
                 hook.after_cycle(pod, annotations, self.result_store)
 
             bind_ok = sel >= 0 and not ext_error
+            lifecycle_rejected = False
             if bind_ok and not self._run_custom_lifecycle(pod, ns, name, names[sel]):
+                # here the carry only folds on a successful bind, so a
+                # rejection needs no wave re-run (sequential path)
                 bind_ok = False
+                lifecycle_rejected = True
                 sel = -1
             if bind_ok:
                 bound_node = names[sel]
@@ -424,15 +494,18 @@ class SchedulerEngine:
                 n_bound += 1
             else:
                 # FitError (no feasible node) runs PostFilter, like the
-                # plain path; an extender/bind failure does not (upstream
-                # only preempts on FitError).  Candidate nodes are those
-                # that failed the PLUGIN filters — extender-rejected nodes
-                # are not preemption candidates (docs/SEMANTICS.md).
-                if postfilter_on and sel < 0 and not ext_error and not pf_reject:
-                    any_preempted |= self._run_postfilter(cw, codes, i, pod, ns, name)
+                # plain path; an extender/bind failure or a lifecycle
+                # rejection does not (upstream only preempts on FitError).
+                # Candidate nodes are those that failed the PLUGIN filters
+                # — extender-rejected nodes are not preemption candidates
+                # (docs/SEMANTICS.md).
+                if (postfilter_on and sel < 0 and not ext_error
+                        and not pf_reject and not lifecycle_rejected):
+                    if self._run_postfilter(cw, codes, i, pod, ns, name):
+                        retry = "preempted"
                 self._mark_unschedulable(ns, name)
             self.reflector.reflect(ns, name)
-        return n_bound, any_preempted
+        return n_bound, retry
 
     # ------------------------------------------------------------ writes
 
