@@ -31,6 +31,7 @@ from ..store.resultstore import ResultStore
 
 RESULT_STORE_KEY = "PluginResultStoreKey"      # reference: plugins.go:23
 EXTENDER_STORE_KEY = "ExtenderResultStoreKey"  # reference: extender/service.go:24
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
 
 class SchedulerEngine:
@@ -47,16 +48,37 @@ class SchedulerEngine:
         self.chunk = chunk
         self.extender_service = None
         self.plugin_extenders: list = []
+        self.profiles: dict[str, PluginSetConfig] | None = None
         # pods parked by Permit "wait" (upstream waitingPods map analogue),
         # keyed (namespace, name); external threads may allow()/reject()
         self.waiting_pods: dict[tuple[str, str], "WaitingPod"] = {}
 
     def set_plugin_config(self, cfg: PluginSetConfig) -> None:
-        # validates by constructing; the service uses this for rollback
+        """Legacy single-profile API: one plugin set for every pod.
+        Clears any profile routing so the new config actually takes
+        effect (set_profiles is the multi-profile entry)."""
         self.plugin_config = PluginSetConfig(
             enabled=list(cfg.enabled), weights=dict(cfg.weights),
             custom=dict(cfg.custom), args=copy.deepcopy(cfg.args),
         )
+        self.profiles = None
+
+    def set_profiles(self, profiles: dict[str, PluginSetConfig] | None) -> None:
+        """Multi-profile routing: one PluginSetConfig per schedulerName,
+        config order preserved (upstream builds one framework per profile,
+        scheduler.go:141-173).  None disables routing — every pending pod
+        is scheduled with plugin_config (direct-engine / test use)."""
+        if profiles:
+            self.profiles = {
+                n: PluginSetConfig(
+                    enabled=list(c.enabled), weights=dict(c.weights),
+                    custom=dict(c.custom), args=copy.deepcopy(c.args))
+                for n, c in profiles.items()
+            }
+            # keep the legacy single-profile accessor pointing at the first
+            self.plugin_config = next(iter(self.profiles.values()))
+        else:
+            self.profiles = None
 
     def set_extenders(self, extender_service) -> None:
         """Configure webhook extenders; scheduling switches to the phased
@@ -98,13 +120,66 @@ class SchedulerEngine:
                 TRACER.count("preemption_waves_total")
             if not retry:
                 break
-        # count unschedulable once per pass, not per retry wave
-        TRACER.count("pods_unschedulable_total", len(self.pending_pods()))
+        # count unschedulable once per pass, not per retry wave (pods
+        # routed to no profile are not ours to count)
+        TRACER.count("pods_unschedulable_total", len([
+            p for p in self.pending_pods() if self._profile_of(p) is not None
+        ]))
         return n_bound
+
+    def _profile_of(self, pod: dict) -> str | None:
+        """Route a pod to a profile by spec.schedulerName (upstream
+        frameworkForPod).  An unset name maps to "default-scheduler", or
+        to the first profile when no profile carries that name; an
+        explicit name matching no profile returns None — the pod is left
+        alone, exactly as a cluster whose schedulers don't include that
+        name would."""
+        name = (pod.get("spec") or {}).get("schedulerName")
+        if self.profiles is None:
+            return "*"
+        if name is None:
+            if DEFAULT_SCHEDULER_NAME in self.profiles:
+                return DEFAULT_SCHEDULER_NAME
+            return next(iter(self.profiles))
+        return name if name in self.profiles else None
 
     def _schedule_wave(self, exclude: set[tuple[str, str]] | None = None
                        ) -> tuple[int, str | None]:
-        """One scheduling wave. Returns (#bound, retry reason or None).
+        """One scheduling wave: each profile schedules its own pods in
+        config order (binds from earlier profiles are visible to later
+        ones through the store). Returns (#bound, retry reason or None)."""
+        if self.profiles is None:
+            return self._profile_wave(self.pending_pods(), exclude)
+        # preserve GLOBAL queue order across profiles (upstream pops one
+        # shared activeQ): batch maximal runs of consecutive same-profile
+        # pods so a high-priority pod of profile B is never beaten to
+        # capacity by a lower-priority pod of profile A
+        runs: list[tuple[str, list[dict]]] = []
+        for p in self.pending_pods():
+            pname = self._profile_of(p)
+            if pname is None:
+                continue
+            if runs and runs[-1][0] == pname:
+                runs[-1][1].append(p)
+            else:
+                runs.append((pname, [p]))
+        total, retry = 0, None
+        for pname, mine in runs:
+            saved = self.plugin_config
+            self.plugin_config = self.profiles[pname]
+            try:
+                bound, r = self._profile_wave(mine, exclude)
+            finally:
+                self.plugin_config = saved
+            total += bound
+            retry = retry or r
+        return total, retry
+
+    def _profile_wave(self, pending: list[dict],
+                      exclude: set[tuple[str, str]] | None = None
+                      ) -> tuple[int, str | None]:
+        """One wave over the given pending pods with the current
+        plugin_config. Returns (#bound, retry reason or None).
 
         retry == "preempted": preemption nominated a node, run a retry wave.
         retry == "rejected": a custom Reserve/Permit/PreBind rejected a pod
@@ -112,7 +187,6 @@ class SchedulerEngine:
         the rest of the wave is re-run with upstream-sequential state (the
         rejected pod excluded), so later pods never observe the phantom
         bind (upstream scheduleOne semantics)."""
-        pending = self.pending_pods()
         if exclude:
             pending = [
                 p for p in pending

@@ -35,14 +35,16 @@ MOST_ALLOCATED = "MostAllocated"
 REQUESTED_TO_CAPACITY_RATIO = "RequestedToCapacityRatio"
 
 
+# resources handled natively by calculateResourceAllocatableRequest;
+# everything else is a scalar resource bypassed when the pod doesn't
+# request it
+NATIVE_RESOURCES = ("cpu", "memory", "ephemeral-storage")
+
+
 class FitStrategy(NamedTuple):
     stype: str
     resources: tuple[tuple[str, int], ...]   # (name, weight)
     shape: tuple[tuple[int, int], ...]       # (utilization, score×10) ascending
-
-    @property
-    def weight_sum(self) -> int:
-        return max(sum(w for _, w in self.resources), 1)
 
 
 def parse_fit_strategy(args: dict | None) -> FitStrategy:
@@ -63,9 +65,17 @@ def parse_fit_strategy(args: dict | None) -> FitStrategy:
 
 
 def parse_balanced_resources(args: dict | None) -> tuple[str, ...]:
-    ss = (args or {}).get("scoringStrategy") or {}
-    res = tuple((r.get("name") or "") for r in (ss.get("resources") or []))
-    return res or ("cpu", "memory")
+    """NodeResourcesBalancedAllocationArgs carries `resources` at the TOP
+    level (upstream wire format, reference
+    simulator/scheduler/plugin/plugins_test.go:922-929); a scoringStrategy
+    wrapper is accepted as a fallback for configs written against the
+    NodeResourcesFitArgs shape."""
+    a = args or {}
+    res = a.get("resources")
+    if res is None:
+        res = (a.get("scoringStrategy") or {}).get("resources") or []
+    names = tuple((r.get("name") or "") for r in res)
+    return names or ("cpu", "memory")
 
 
 # ----------------------------------------------------------- scalar (oracle)

@@ -84,45 +84,92 @@ def decode_fit_filter(code: int, schema: ResourceSchema) -> str:
 
 
 def _resource_req_alloc(static: FitStatic, pod: FitPodXS, carry, name: str,
-                        schema: ResourceSchema | None):
+                        schema: ResourceSchema | None,
+                        use_requested: bool = False):
     """-> (requested [N], allocatable [N]) for one scored resource.
     cpu/memory use the non-zero-defaulted accumulators (upstream
-    GetNonzeroRequests); others the raw request accumulators."""
+    GetNonzeroRequests / NodeInfo.NonZeroRequested) unless use_requested
+    (upstream resourceAllocationScorer.useRequested, true for
+    RequestedToCapacityRatio) selects the raw ones; ephemeral-storage and
+    scalar resources always read the raw accumulators
+    (calculateResourceAllocatableRequest reads nodeInfo.Requested for
+    them explicitly)."""
     if name == "cpu":
+        if use_requested:
+            return carry.requested[:, CPU] + pod.requests[CPU], static.allocatable[:, CPU]
         return carry.nonzero[:, 0] + pod.nonzero[0], static.allocatable[:, CPU]
     if name == "memory":
+        if use_requested:
+            return carry.requested[:, MEMORY] + pod.requests[MEMORY], static.allocatable[:, MEMORY]
         return carry.nonzero[:, 1] + pod.nonzero[1], static.allocatable[:, MEMORY]
     if schema is not None and name in schema.columns:
         c = schema.columns.index(name)
         return carry.requested[:, c] + pod.requests[c], static.allocatable[:, c]
-    # untracked resource: requested 0 against capacity 0 (upstream sees
-    # zeroes too; the weight still enters the weighted mean)
+    # untracked resource: requested 0 against capacity 0 — the zero
+    # capacity makes _resource_active exclude it everywhere, like
+    # upstream's allocatable==0 skip
     n = static.allocatable.shape[0]
     return jnp.zeros(n, dtype=jnp.int64), jnp.zeros(n, dtype=jnp.int64)
+
+
+def _resource_active(static: FitStatic, pod: FitPodXS, name: str,
+                     alloc, schema: ResourceSchema | None):
+    """[N] bool — does this resource participate in the weighted mean on
+    each node?  Upstream resource_allocation.go skips a resource whose
+    allocatable is 0 (`continue` before the scorer), and
+    calculateResourceAllocatableRequest returns (0,0) — also skipped —
+    for scalar (extended) resources the pod does not request."""
+    active = alloc > 0
+    if name not in fitscoring.NATIVE_RESOURCES:
+        if schema is not None and name in schema.columns:
+            c = schema.columns.index(name)
+            active = active & (pod.requests[c] > 0)
+        else:
+            active = jnp.zeros_like(active)
+    return active
 
 
 def fit_score(static: FitStatic, pod: FitPodXS, carry,
               strategy: fitscoring.FitStrategy | None = None,
               schema: ResourceSchema | None = None) -> jnp.ndarray:
-    """scoringStrategy-driven score (resource_allocation.go score():
-    weighted mean of per-resource scores, int64 division).  Default:
-    LeastAllocated over cpu+memory, weight 1 each."""
+    """scoringStrategy-driven weighted mean of per-resource scores, with
+    inactive resources excluded from the weight sum per node and 0 when
+    every resource is inactive (upstream leastResourceScorer /
+    mostResourceScorer / requestedToCapacityRatioScorer).  Least/Most use
+    truncating int64 division; RequestedToCapacityRatio additionally
+    drops resources whose resourceScore is 0 from the weight sum and
+    rounds the mean to nearest (math.Round).  Default: LeastAllocated
+    over cpu+memory, weight 1 each."""
     if strategy is None:
         strategy = fitscoring.FitStrategy(
             fitscoring.LEAST_ALLOCATED, fitscoring.DEFAULT_RESOURCES, ())
+    rtcr = strategy.stype == fitscoring.REQUESTED_TO_CAPACITY_RATIO
     n = static.allocatable.shape[0]
     total = jnp.zeros(n, dtype=jnp.int64)
+    wsum = jnp.zeros(n, dtype=jnp.int64)
     for name, w in strategy.resources:
-        req, alloc = _resource_req_alloc(static, pod, carry, name, schema)
-        total = total + fitscoring.score_resource_vec(strategy, req, alloc) * w
-    return total // strategy.weight_sum
+        req, alloc = _resource_req_alloc(static, pod, carry, name, schema,
+                                         use_requested=rtcr)
+        active = _resource_active(static, pod, name, alloc, schema)
+        s = fitscoring.score_resource_vec(strategy, req, alloc)
+        if rtcr:
+            active = active & (s > 0)
+        total = total + jnp.where(active, s * jnp.int64(w), 0)
+        wsum = wsum + jnp.where(active, jnp.int64(w), 0)
+    if rtcr:
+        # round half away from zero; scores are non-negative here
+        return jnp.where(
+            wsum > 0, (2 * total + wsum) // jnp.maximum(2 * wsum, 1), 0)
+    return jnp.where(wsum > 0, total // jnp.maximum(wsum, 1), 0)
 
 
 def balanced_score(static: FitStatic, pod: FitPodXS, carry,
                    resources: tuple[str, ...] = ("cpu", "memory"),
                    schema: ResourceSchema | None = None) -> jnp.ndarray:
     """balanced_allocation.go: std of per-resource utilization fractions
-    (cap==0 resources skipped), score = int64((1-std)·100)."""
+    (cap==0 resources and unrequested scalar resources skipped, same
+    calculateResourceAllocatableRequest bypass as fit_score),
+    score = int64((1-std)·100)."""
     fracs = []
     masks = []
     for name in resources:
@@ -130,7 +177,7 @@ def balanced_score(static: FitStatic, pod: FitPodXS, carry,
         a = alloc.astype(jnp.float64)
         f = jnp.minimum(req.astype(jnp.float64) / jnp.maximum(a, 1.0), 1.0)
         fracs.append(f)
-        masks.append(a > 0)
+        masks.append(_resource_active(static, pod, name, alloc, schema))
     f = jnp.stack(fracs, axis=1)       # [N, K]
     m = jnp.stack(masks, axis=1)       # [N, K] cap>0
     cnt = jnp.sum(m, axis=1)

@@ -361,12 +361,33 @@ class SequentialScheduler:
             return not any(c.get("whenUnsatisfiable", "DoNotSchedule") == "ScheduleAnyway" for c in cs)
         return False
 
-    def _req_alloc_for(self, rname: str, req, nz, j) -> tuple[int, int]:
+    def _resource_active(self, rname: str, req, alloc: int) -> bool:
+        """Upstream resource_allocation.go skips resources with zero
+        allocatable, and calculateResourceAllocatableRequest bypasses
+        scalar (extended) resources the pod does not request."""
+        if alloc <= 0:
+            return False
+        from ..plugins.fitscoring import NATIVE_RESOURCES
+
+        if rname in NATIVE_RESOURCES:
+            return True
+        if rname in self.schema.columns:
+            return int(req[self.schema.columns.index(rname)]) > 0
+        return False
+
+    def _req_alloc_for(self, rname: str, req, nz, j,
+                       use_requested: bool = False) -> tuple[int, int]:
         """(requested incl. this pod, allocatable) for one scored resource;
-        cpu/memory use the non-zero accumulators, others raw requests."""
+        cpu/memory use the non-zero accumulators unless use_requested
+        (upstream useRequested=true for RequestedToCapacityRatio), others
+        always raw requests."""
         if rname == "cpu":
+            if use_requested:
+                return int(self.requested[j][CPU]) + int(req[CPU]), int(self.table.allocatable[j][CPU])
             return self.nonzero[j][0] + int(nz[0]), int(self.table.allocatable[j][CPU])
         if rname == "memory":
+            if use_requested:
+                return int(self.requested[j][MEMORY]) + int(req[MEMORY]), int(self.table.allocatable[j][MEMORY])
             return self.nonzero[j][1] + int(nz[1]), int(self.table.allocatable[j][MEMORY])
         if rname in self.schema.columns:
             c = self.schema.columns.index(rname)
@@ -377,22 +398,35 @@ class SequentialScheduler:
         if self.config.is_custom(name):
             return int(self.config.custom[name].score(pod, self.node_manifests[j]))
         if name == "NodeResourcesFit":
-            from ..plugins.fitscoring import parse_fit_strategy, score_resource
+            from ..plugins.fitscoring import (
+                REQUESTED_TO_CAPACITY_RATIO, parse_fit_strategy, score_resource)
 
             strategy = parse_fit_strategy(self.config.args.get(name))
-            total = 0
+            rtcr = strategy.stype == REQUESTED_TO_CAPACITY_RATIO
+            total, wsum = 0, 0
             for rname, w in strategy.resources:
-                r, alloc = self._req_alloc_for(rname, req, nz, j)
-                total += score_resource(strategy, r, alloc) * w
-            return total // strategy.weight_sum
+                r, alloc = self._req_alloc_for(rname, req, nz, j,
+                                               use_requested=rtcr)
+                if not self._resource_active(rname, req, alloc):
+                    continue  # excluded from the weight sum too
+                s = score_resource(strategy, r, alloc)
+                if rtcr and s <= 0:
+                    continue  # RTCR drops zero-score resources entirely
+                total += s * w
+                wsum += w
+            if wsum <= 0:
+                return 0
+            if rtcr:  # math.Round: half away from zero (non-negative here)
+                return (2 * total + wsum) // (2 * wsum)
+            return total // wsum
         if name == "NodeResourcesBalancedAllocation":
             from ..plugins.fitscoring import balanced_std, parse_balanced_resources
 
             fracs = []
             for rname in parse_balanced_resources(self.config.args.get(name)):
                 r, alloc = self._req_alloc_for(rname, req, nz, j)
-                if alloc <= 0:
-                    continue  # upstream skips cap==0 resources
+                if not self._resource_active(rname, req, alloc):
+                    continue
                 fracs.append(min(float(r) / float(alloc), 1.0))
             return int((1.0 - balanced_std(fracs)) * MAX_NODE_SCORE)
         if name == "NodeAffinity":

@@ -119,14 +119,39 @@ def convert_configuration_for_simulator(cfg: dict) -> dict:
 
 
 def parse_plugin_set(cfg: dict | None) -> PluginSetConfig:
-    """User config -> tensor pipeline plugin set.
+    """User config -> tensor pipeline plugin set for the FIRST profile
+    (legacy single-profile entry; parse_profiles handles all of them)."""
+    cfg = cfg or {}
+    profiles = cfg.get("profiles") or []
+    return parse_profile(profiles[0] if profiles else {})
+
+
+def parse_profiles(cfg: dict | None) -> dict[str, PluginSetConfig]:
+    """All profiles, keyed by schedulerName in config order (the upstream
+    scheduler builds one framework per profile and routes each pod by
+    spec.schedulerName; reference
+    simulator/scheduler/scheduler.go:141-173 rewrites every profile)."""
+    cfg = cfg or {}
+    profiles = cfg.get("profiles") or [{}]
+    out: dict[str, PluginSetConfig] = {}
+    for i, profile in enumerate(profiles):
+        name = profile.get("schedulerName") or (
+            DEFAULT_SCHEDULER_NAME if i == 0 else f"profile-{i}")
+        if name in out:
+            # upstream validation rejects duplicate schedulerNames
+            raise ValueError(f"duplicated profile schedulerName {name!r}")
+        out[name] = parse_profile(profile)
+    return out
+
+
+def parse_profile(profile: dict | None) -> PluginSetConfig:
+    """One profile -> tensor pipeline plugin set.
 
     Unknown (not-yet-tensorized) plugins are ignored; weights follow
     getScorePluginWeight: explicit weight, else 1 when configured enabled
     with weight 0, else the upstream default weight."""
-    cfg = cfg or {}
-    profiles = cfg.get("profiles") or []
-    plugins = (profiles[0].get("plugins") or {}) if profiles else {}
+    profile = profile or {}
+    plugins = profile.get("plugins") or {}
     mp = plugins.get("multiPoint") or {}
     score = plugins.get("score") or {}
 
@@ -153,7 +178,7 @@ def parse_plugin_set(cfg: dict | None) -> PluginSetConfig:
         weights.pop((d.get("name") or "").removesuffix(WRAPPED_SUFFIX), None)
 
     args: dict[str, dict] = {}
-    for pc in (profiles[0].get("pluginConfig") or []) if profiles else []:
+    for pc in profile.get("pluginConfig") or []:
         name = (pc.get("name") or "").removesuffix(WRAPPED_SUFFIX)
         if name and pc.get("args"):
             args[name] = pc["args"]

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import copy
 
-from .convert import default_scheduler_config, parse_plugin_set
+from .convert import default_scheduler_config, parse_profiles
 
 
 class SchedulerService:
@@ -32,7 +32,7 @@ class SchedulerService:
         # living for the process lifetime like compiled-in customs
         self._guest_plugins: dict[str, object] = {}
         if engine is not None:
-            engine.set_plugin_config(parse_plugin_set(self._current))
+            self._apply_profiles(self._current)
             self._apply_extenders(self._current)
 
     def register_custom_plugins(self, plugins: list) -> None:
@@ -56,17 +56,28 @@ class SchedulerService:
             from .guest import collect_guest_plugins
 
             self._guest_plugins = collect_guest_plugins(cfg)
-            plugin_set = self._with_customs(parse_plugin_set(cfg))
+            profile_sets = self._parse_all(cfg)  # validates even engine-less
             if self.engine is not None:
-                self.engine.set_plugin_config(plugin_set)
+                self.engine.set_profiles(profile_sets)
                 self._apply_extenders(cfg)
             self._current = copy.deepcopy(cfg)
         except Exception:
             self._guest_plugins = old_guests
             if self.engine is not None:
-                self.engine.set_plugin_config(self._with_customs(parse_plugin_set(old)))
+                self._apply_profiles(old)
                 self._apply_extenders(old)
             raise
+
+    def _parse_all(self, cfg: dict) -> dict:
+        """Every profile feeds the engine's router; custom/guest plugins
+        (compiled-in WithPlugin factories upstream) join every profile."""
+        return {
+            name: self._with_customs(ps)
+            for name, ps in parse_profiles(cfg).items()
+        }
+
+    def _apply_profiles(self, cfg: dict) -> None:
+        self.engine.set_profiles(self._parse_all(cfg))
 
     def _with_customs(self, plugin_set):
         for name, p in {**self._custom_plugins, **self._guest_plugins}.items():

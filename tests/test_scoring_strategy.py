@@ -129,6 +129,167 @@ def test_hard_pod_affinity_weight_parity():
     _assert_parity(nodes, pods, cfg)
 
 
+def _gpu_nodes():
+    return [
+        {"metadata": {"name": "node-gpu"},
+         "status": {"allocatable": {"cpu": "2", "memory": "4Gi", "pods": "10",
+                                    "nvidia.com/gpu": "4"}}},
+        {"metadata": {"name": "node-plain"},
+         "status": {"allocatable": {"cpu": "2", "memory": "4Gi", "pods": "10"}}},
+    ]
+
+
+_GPU_STRATEGY = {"NodeResourcesFit": {"scoringStrategy": {
+    "type": "LeastAllocated",
+    "resources": [{"name": "cpu", "weight": 1}, {"name": "memory", "weight": 1},
+                  {"name": "nvidia.com/gpu", "weight": 3}]}}}
+
+
+def test_unrequested_extended_resource_excluded_from_weight_sum():
+    """resource_allocation.go: a scalar resource the pod does not request
+    is bypassed — its weight must not enter the denominator (and a node
+    without the resource must not score it at all)."""
+    pods = _pod()  # requests cpu 1, memory 2Gi, no gpu
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"], args=_GPU_STRATEGY)
+    rr = replay(compile_workload(_gpu_nodes(), pods, cfg), chunk=1)
+    scores = json.loads(decode_pod_result(rr, 0)[ann.SCORE_RESULT])
+    # (50·1 + 50·1) // 2 = 50 on BOTH nodes; with the bug the gpu node
+    # got (50+50+100·3)//5 = 80
+    assert scores["node-gpu"]["NodeResourcesFit"] == "50"
+    assert scores["node-plain"]["NodeResourcesFit"] == "50"
+    _assert_parity(_gpu_nodes(), pods, cfg)
+
+
+def test_requested_extended_resource_scored_where_present():
+    nodes = _gpu_nodes() + [
+        {"metadata": {"name": "node-gpu2"},
+         "status": {"allocatable": {"cpu": "2", "memory": "4Gi", "pods": "10",
+                                    "nvidia.com/gpu": "2"}}}]
+    pods = [{"kind": "Pod", "metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {
+            "cpu": "1", "memory": "2Gi", "nvidia.com/gpu": "1"}}}]}}]
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"], args=_GPU_STRATEGY)
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=1)
+    da = decode_pod_result(rr, 0)
+    scores = json.loads(da[ann.SCORE_RESULT])
+    # node-gpu:  (50·1 + 50·1 + 75·3) // 5 = 65   (gpu 1 of 4 -> 75)
+    # node-gpu2: (50·1 + 50·1 + 50·3) // 5 = 50   (gpu 1 of 2 -> 50)
+    assert scores["node-gpu"]["NodeResourcesFit"] == "65"
+    assert scores["node-gpu2"]["NodeResourcesFit"] == "50"
+    fr = json.loads(da[ann.FILTER_RESULT])
+    assert "Insufficient nvidia.com/gpu" in fr["node-plain"]["NodeResourcesFit"]
+    _assert_parity(nodes, pods, cfg)
+
+
+def test_rtcr_rounds_to_nearest_and_drops_zero_scores():
+    """requestedToCapacityRatioScorer: int64(math.Round(score/weightSum))
+    — not truncation — and a resourceScore of 0 excludes that resource's
+    weight from the sum (unlike Least/MostAllocated)."""
+    nodes = [
+        {"metadata": {"name": "node-a"},
+         "status": {"allocatable": {"cpu": "2", "memory": "20Gi", "pods": "10"}}},
+        {"metadata": {"name": "node-b"},
+         "status": {"allocatable": {"cpu": "64", "memory": "2Gi", "pods": "10"}}},
+    ]
+    pods = [{"kind": "Pod", "metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}]}}]
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"], args={
+        "NodeResourcesFit": {"scoringStrategy": {
+            "type": "RequestedToCapacityRatio",
+            "resources": [{"name": "cpu", "weight": 1}, {"name": "memory", "weight": 1}],
+            "requestedToCapacityRatio": {"shape": [
+                {"utilization": 0, "score": 0}, {"utilization": 100, "score": 10}]}}}})
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=1)
+    scores = json.loads(decode_pod_result(rr, 0)[ann.SCORE_RESULT])
+    # node-a: cpu util 50 -> 50, mem util 1Gi/20Gi = 5 -> 5;
+    #   round((50+5)/2) = round(27.5) = 28 (truncation would give 27)
+    assert scores["node-a"]["NodeResourcesFit"] == "28"
+    # node-b: cpu util 1000m*100//64000 = 1 -> 1; mem util 50 -> 50;
+    #   round(51/2) = 26 — but drop-zero matters with scores of 0:
+    assert scores["node-b"]["NodeResourcesFit"] == "26"
+    _assert_parity(nodes, pods, cfg)
+
+    # zero-score drop: cpu resourceScore 0 must not dilute the mean
+    nodes2 = [
+        {"metadata": {"name": "node-a"},
+         "status": {"allocatable": {"cpu": "200", "memory": "2Gi", "pods": "10"}}},
+        {"metadata": {"name": "node-b"},
+         "status": {"allocatable": {"cpu": "200", "memory": "4Gi", "pods": "10"}}},
+    ]
+    pods2 = [{"kind": "Pod", "metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}]}}]
+    rr2 = replay(compile_workload(nodes2, pods2, cfg), chunk=1)
+    scores2 = json.loads(decode_pod_result(rr2, 0)[ann.SCORE_RESULT])
+    # cpu util 1000*100//200000 = 0 -> score 0 -> dropped;
+    # node-a mem util 50 -> 50/1 = 50 (diluted would be 25)
+    assert scores2["node-a"]["NodeResourcesFit"] == "50"
+    assert scores2["node-b"]["NodeResourcesFit"] == "25"
+    _assert_parity(nodes2, pods2, cfg)
+
+
+def test_rtcr_uses_raw_requests_not_nonzero_defaults():
+    """RTCR is built with useRequested=true upstream: the raw Requested
+    accumulators and raw pod requests — no 100m/200Mi non-zero defaults."""
+    nodes = [
+        {"metadata": {"name": "node-a"},
+         "status": {"allocatable": {"cpu": "2", "memory": "4Gi", "pods": "10"}}},
+        {"metadata": {"name": "node-b"},
+         "status": {"allocatable": {"cpu": "2", "memory": "8Gi", "pods": "10"}}},
+    ]
+    # no cpu request at all: raw cpu requested stays 0 -> util 0
+    pods = [{"kind": "Pod", "metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"memory": "1Gi"}}}]}}]
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"], args={
+        "NodeResourcesFit": {"scoringStrategy": {
+            "type": "RequestedToCapacityRatio",
+            "resources": [{"name": "cpu", "weight": 1}, {"name": "memory", "weight": 1}],
+            "requestedToCapacityRatio": {"shape": [
+                {"utilization": 0, "score": 10}, {"utilization": 100, "score": 0}]}}}})
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=1)
+    scores = json.loads(decode_pod_result(rr, 0)[ann.SCORE_RESULT])
+    # node-a: cpu raw util 0 -> 100 (nonzero default 100m would give 95);
+    #   mem util 25 -> 75; round((100+75)/2) = 88
+    assert scores["node-a"]["NodeResourcesFit"] == "88"
+    # node-b: mem util 12 -> 88; round((100+88)/2) = 94
+    assert scores["node-b"]["NodeResourcesFit"] == "94"
+    _assert_parity(nodes, pods, cfg)
+
+
+def test_balanced_allocation_top_level_resources_wire_format():
+    """NodeResourcesBalancedAllocationArgs carries `resources` at the top
+    level (no scoringStrategy wrapper) — reference
+    plugins_test.go:922-929; previously these were silently ignored."""
+    from kube_scheduler_simulator_tpu.plugins.fitscoring import parse_balanced_resources
+
+    assert parse_balanced_resources({"resources": [
+        {"name": "cpu", "weight": 1}, {"name": "nvidia.com/gpu", "weight": 1},
+    ]}) == ("cpu", "nvidia.com/gpu")
+    # fallback shape still honored, default when absent
+    assert parse_balanced_resources({"scoringStrategy": {"resources": [
+        {"name": "cpu"}]}}) == ("cpu",)
+    assert parse_balanced_resources(None) == ("cpu", "memory")
+
+    nodes = _gpu_nodes() + [
+        {"metadata": {"name": "node-gpu2"},
+         "status": {"allocatable": {"cpu": "2", "memory": "4Gi", "pods": "10",
+                                    "nvidia.com/gpu": "2"}}}]
+    pods = [{"kind": "Pod", "metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {
+            "cpu": "1", "memory": "2Gi", "nvidia.com/gpu": "2"}}}]}}]
+    cfg = PluginSetConfig(
+        enabled=["NodeResourcesFit", "NodeResourcesBalancedAllocation"],
+        args={"NodeResourcesBalancedAllocation": {"resources": [
+            {"name": "cpu", "weight": 1}, {"name": "memory", "weight": 1},
+            {"name": "nvidia.com/gpu", "weight": 1}]}})
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=1)
+    scores = json.loads(decode_pod_result(rr, 0)[ann.SCORE_RESULT])
+    # fractions on node-gpu: cpu 0.5, mem 0.5, gpu 0.5 -> std 0 -> 100;
+    # node-gpu2: gpu fraction 1.0 -> population std of (.5,.5,1) -> 76
+    assert scores["node-gpu"]["NodeResourcesBalancedAllocation"] == "100"
+    assert scores["node-gpu2"]["NodeResourcesBalancedAllocation"] == "76"
+    _assert_parity(nodes, pods, cfg)
+
+
 def test_args_flow_from_scheduler_config():
     from kube_scheduler_simulator_tpu.scheduler.convert import parse_plugin_set
 
