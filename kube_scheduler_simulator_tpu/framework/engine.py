@@ -47,7 +47,10 @@ class SchedulerEngine:
         self.plugin_config = plugin_config or PluginSetConfig()
         self.chunk = chunk
         self.extender_service = None
-        self.plugin_extenders: list = []
+        # plugin name -> PluginExtender (the reference's WithPluginExtenders
+        # registry); a bare list is accepted as anonymous after_cycle
+        # observers for backward compatibility
+        self.plugin_extenders: dict | list = {}
         self.profiles: dict[str, PluginSetConfig] | None = None
         # pods parked by Permit "wait" (upstream waitingPods map analogue),
         # keyed (namespace, name); external threads may allow()/reject()
@@ -88,6 +91,34 @@ class SchedulerEngine:
             self.reflector.add_result_store(extender_service.result_store, EXTENDER_STORE_KEY)
         else:
             self.reflector.result_stores.pop(EXTENDER_STORE_KEY, None)
+
+    # ------------------------------------------------------------ hooks
+
+    def _extenders_map(self) -> dict:
+        pe = self.plugin_extenders
+        if isinstance(pe, dict):
+            return pe
+        return {f"_observer{i}": e for i, e in enumerate(pe or [])}
+
+    def _cycle_hooks(self) -> dict:
+        """Extenders whose plugin is enabled and that intercept the
+        filter/score/normalize points — these force the host path."""
+        from ..scheduler.debuggable import intercepts_cycle
+
+        enabled = set(self.plugin_config.enabled)
+        return {
+            name: ext for name, ext in self._extenders_map().items()
+            if name in enabled and intercepts_cycle(ext)
+        }
+
+    def _needs_host_path(self) -> bool:
+        if self.extender_service is not None and self.extender_service.extenders:
+            return True
+        cfg = self.plugin_config
+        for name in cfg.enabled:
+            if cfg.is_custom(name) and getattr(cfg.custom[name], "has_normalize", False):
+                return True
+        return bool(self._cycle_hooks())
 
     # ------------------------------------------------------------ run
 
@@ -227,8 +258,8 @@ class SchedulerEngine:
             cw = compile_workload(
                 nodes, pending, self.plugin_config, bound_pods=bound, volumes=volumes
             )
-        if self.extender_service is not None and self.extender_service.extenders:
-            return self._schedule_with_extenders(cw, pending)
+        if self._needs_host_path():
+            return self._schedule_host_path(cw, pending)
 
         with TRACER.span("device_replay", pods=len(pending), nodes=len(nodes)):
             rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)))
@@ -242,7 +273,7 @@ class SchedulerEngine:
                 ns, name = meta.get("namespace") or "default", meta.get("name", "")
                 annotations = decode_pod_result(rr, i)
                 self.result_store.put_decoded(ns, name, annotations)
-                for hook in self.plugin_extenders:
+                for hook in self._extenders_map().values():
                     hook.after_cycle(pod, annotations, self.result_store)
                 sel = int(rr.selected[i])
                 if sel >= 0 and not self._run_custom_lifecycle(
@@ -297,8 +328,10 @@ class SchedulerEngine:
         if not plugins:
             return True
         from .waiting import WaitingPod
+        from ..scheduler.debuggable import has_hook
         from ..utils.duration import parse_duration_seconds
 
+        emap = self._extenders_map()
         node = None
         try:
             node = self.store.get("nodes", node_name)
@@ -314,9 +347,16 @@ class SchedulerEngine:
         for p in plugins:
             if not p.has_reserve:
                 continue
+            ext = emap.get(p.name)
+            if ext is not None and has_hook(ext, "before_reserve"):
+                if ext.before_reserve(pod, node) is not None:
+                    unreserve_all()  # plugin skipped, nothing recorded
+                    return False
             msg = p.reserve(pod, node)
             rs.add_reserve_result(ns, name, p.name,
                                   msg if msg else ann.SUCCESS_MESSAGE)
+            if ext is not None and has_hook(ext, "after_reserve"):
+                msg = ext.after_reserve(pod, node, msg)  # framework outcome
             if msg:
                 unreserve_all()
                 return False
@@ -324,15 +364,26 @@ class SchedulerEngine:
         for p in plugins:
             if not p.has_permit:
                 continue
+            ext = emap.get(p.name)
+            if ext is not None and has_hook(ext, "before_permit"):
+                if ext.before_permit(pod, node) is not None:
+                    unreserve_all()
+                    return False
             out = p.permit(pod, node)
             if out is None:
                 rs.add_permit_result(ns, name, p.name, ann.SUCCESS_MESSAGE, "0s")
             elif isinstance(out, tuple):
                 rs.add_permit_result(ns, name, p.name, ann.WAIT_MESSAGE,
                                      str(out[1]))
-                waits.append((p, str(out[1])))
             else:
                 rs.add_permit_result(ns, name, p.name, str(out), "0s")
+            if ext is not None and has_hook(ext, "after_permit"):
+                out = ext.after_permit(pod, node, out)  # framework outcome
+            if out is None:
+                pass
+            elif isinstance(out, tuple):
+                waits.append((p, str(out[1])))
+            else:
                 unreserve_all()
                 return False
         if waits:
@@ -362,9 +413,16 @@ class SchedulerEngine:
         for p in plugins:
             if not p.has_pre_bind:
                 continue
+            ext = emap.get(p.name)
+            if ext is not None and has_hook(ext, "before_pre_bind"):
+                if ext.before_pre_bind(pod, node) is not None:
+                    unreserve_all()
+                    return False
             msg = p.pre_bind(pod, node)
             rs.add_pre_bind_result(ns, name, p.name,
                                    msg if msg else ann.SUCCESS_MESSAGE)
+            if ext is not None and has_hook(ext, "after_pre_bind"):
+                msg = ext.after_pre_bind(pod, node, msg)  # framework outcome
             if msg:
                 unreserve_all()
                 return False
@@ -372,13 +430,19 @@ class SchedulerEngine:
 
     def _run_custom_postbind(self, pod, node_name: str) -> None:
         """PostBind (observation only, after the successful bind)."""
+        emap = self._extenders_map()
         try:
             node = self.store.get("nodes", node_name)
         except NotFound:
             node = None
         for p in self._custom_lifecycle_plugins():
             if p.has_post_bind:
+                ext = emap.get(p.name)
+                if ext is not None:
+                    getattr(ext, "before_post_bind", lambda *a: None)(pod, node)
                 p.post_bind(pod, node)
+                if ext is not None:
+                    getattr(ext, "after_post_bind", lambda *a: None)(pod, node)
 
     def _run_postfilter(self, cw, filter_codes, pod_idx, pod, ns: str, name: str) -> bool:
         """Run DefaultPreemption for an unschedulable pod; record the
@@ -416,36 +480,203 @@ class SchedulerEngine:
         self._update_pod(ns, name, nominate)
         return True
 
-    def _schedule_with_extenders(self, cw, pending) -> tuple[int, str | None]:
-        """Phased path: device eval -> extender Filter/Prioritize over HTTP
-        -> host selection -> device bind (the reference's extender
-        round-trip, SURVEY.md §3.3, spliced into the tensor pipeline)."""
+    def _schedule_host_path(self, cw, pending) -> tuple[int, str | None]:
+        """Host-interleaved path: device eval -> plugin-extender hooks +
+        extender Filter/Prioritize over HTTP -> host selection -> device
+        bind.  Taken when webhook extenders are configured (the
+        reference's round-trip, SURVEY.md §3.3), when a plugin extender
+        intercepts an extension point (wrappedplugin.go:159-171 Before/
+        After hooks), or when a custom plugin has NormalizeScore
+        (arbitrary Python can't run inside the device scan)."""
         import jax
-        import numpy as np
 
         from .pipeline import build_phased
-        from .replay import ReplayResult
 
         eval_fn, bind_fn = build_phased(cw)
         carry = jax.tree.map(lambda a: a, cw.init_carry)
         names = cw.node_table.names
         name_to_idx = {nm: j for j, nm in enumerate(names)}
         postfilter_on = bool(cw.config.postfilters())
-        extender_span = TRACER.span("extender_phased_wave", pods=len(pending))
-        extender_span.__enter__()
-        try:
-            return self._extender_pod_loop(
+        with TRACER.span("host_path_wave", pods=len(pending)):
+            return self._host_pod_loop(
                 cw, pending, eval_fn, bind_fn, carry, names, name_to_idx,
                 postfilter_on)
-        finally:
-            extender_span.__exit__(None, None, None)
 
-    def _extender_pod_loop(self, cw, pending, eval_fn, bind_fn, carry, names,
-                           name_to_idx, postfilter_on) -> tuple[int, str | None]:
+    def _webhook_filter(self, pod, names, name_to_idx, feasible) -> bool:
+        """Extender filter verbs narrow `feasible` in place; returns True
+        on an unignorable extender error."""
+        import numpy as np
+
+        extenders = self.extender_service.extenders if self.extender_service else []
+        for idx, ext in enumerate(extenders):
+            if not ext.filter_verb or not feasible.any():
+                continue
+            node_names = [names[j] for j in np.flatnonzero(feasible)]
+            args = {"Pod": pod, "NodeNames": node_names}
+            try:
+                result = self.extender_service.handle("filter", idx, args)
+            except Exception:
+                if ext.ignorable:
+                    continue
+                return True
+            # nodeCacheCapable extenders answer with NodeNames; the
+            # default contract answers with a full Nodes list.  Per-node
+            # FailedNodes reasons travel in the recorded
+            # extender-filter-result annotation (handle() stored the
+            # whole response).
+            kept = result.get("NodeNames") or result.get("nodeNames")
+            if kept is None:
+                nodes_obj = result.get("Nodes") or result.get("nodes")
+                if nodes_obj is not None:
+                    kept = [
+                        ((item.get("metadata") or {}).get("name", ""))
+                        for item in (nodes_obj.get("Items") or nodes_obj.get("items") or [])
+                    ]
+            if kept is None:
+                continue  # extender restricted nothing
+            keep_mask = np.zeros(len(names), bool)
+            for nm in kept:
+                j = name_to_idx.get(nm)
+                if j is not None:
+                    keep_mask[j] = True
+            feasible &= keep_mask
+        return False
+
+    def _webhook_prioritize(self, pod, names, name_to_idx, feasible, total) -> None:
+        import numpy as np
+
+        extenders = self.extender_service.extenders if self.extender_service else []
+        for idx, ext in enumerate(extenders):
+            if not ext.prioritize_verb or feasible.sum() <= 1:
+                continue
+            node_names = [names[j] for j in np.flatnonzero(feasible)]
+            try:
+                plist = self.extender_service.handle(
+                    "prioritize", idx, {"Pod": pod, "NodeNames": node_names}
+                )
+            except Exception:
+                continue
+            for entry in plist or []:
+                j = name_to_idx.get(entry.get("Host") or entry.get("host", ""))
+                if j is not None:
+                    total[j] += int(entry.get("Score") or entry.get("score") or 0) * ext.weight
+
+    def _hooked_filter_phase(self, cw, pod, pod_idx, codes, names, hooks):
+        """Run Before/After filter hooks per node with the reference's
+        recording contract: Before-failure skips the plugin (no record for
+        it or anything after it on that node) and fails the node;
+        After-rewrites change the framework outcome only (an own-failure
+        rewritten to success lets LATER plugins run and record).
+        Returns (eff_feasible [N] bool, filter_map for the record)."""
+        import numpy as np
+
+        from ..scheduler.debuggable import has_hook
+        from ..store.decode import decode_filter_message
+
+        fskip = cw.host["filter_skip"]
+        active = []  # (filter idx, name, before hook or None, after hook or None)
+        for f, nm in enumerate(cw.config.filters()):
+            if fskip[nm][pod_idx]:
+                continue
+            ext = hooks.get(nm)
+            active.append((
+                f, nm,
+                ext.before_filter if ext is not None and has_hook(ext, "before_filter") else None,
+                ext.after_filter if ext is not None and has_hook(ext, "after_filter") else None,
+            ))
+        n = len(names)
+        eff_feasible = np.ones(n, bool)
+        filter_map: dict[str, dict[str, str]] = {}
+        for j in range(n):
+            entry: dict[str, str] = {}
+            for f, nm, before, after in active:
+                if before is not None and before(pod, names[j]) is not None:
+                    eff_feasible[j] = False
+                    break  # plugin skipped: no record from here on
+                own = int(codes[f, j])
+                own_msg = None if own == 0 else decode_filter_message(
+                    nm, own, j, cw.host)
+                entry[nm] = (ann.PASSED_FILTER_MESSAGE if own_msg is None
+                             else own_msg)
+                fw_msg = after(pod, names[j], own_msg) if after is not None else own_msg
+                if fw_msg is not None:
+                    eff_feasible[j] = False
+                    break
+            if entry:
+                filter_map[names[j]] = entry
+        return eff_feasible, filter_map
+
+    def _hooked_score_phase(self, cw, carry, sl, pod, pod_idx, raw, names,
+                            feasible, hooks):
+        """AfterScore rewrites + host renormalization + AfterNormalize.
+        Returns (record_final [S,N], total [N], cycle_error: bool).
+
+        Records per the reference: score-result keeps the device originals;
+        finalscore-result = normalize(AfterScore-modified raws) x weight
+        (the store's AddNormalizedScoreResult runs before AfterNormalize);
+        the framework total additionally reflects AfterNormalize."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .pipeline import renormalize
+        from ..scheduler.debuggable import has_hook
+
+        sskip = cw.host["score_skip"]
+        score_names = cw.config.scorers()
+        n = len(names)
+        feas_idx = np.flatnonzero(feasible)
+        eff_raw = np.array(raw, dtype=np.int64, copy=True)
+        record_final = np.zeros_like(eff_raw)
+        total = np.zeros(n, dtype=np.int64)
+        feas_j = jnp.asarray(feasible)
+        for s, nm in enumerate(score_names):
+            if sskip[nm][pod_idx]:
+                continue
+            ext = hooks.get(nm)
+            if ext is not None and has_hook(ext, "before_score"):
+                for j in feas_idx:
+                    if ext.before_score(pod, names[j]) is not None:
+                        return record_final, total, True  # cycle errors
+            if ext is not None and has_hook(ext, "after_score"):
+                for j in feas_idx:
+                    eff_raw[s, j] = int(ext.after_score(
+                        pod, names[j], int(eff_raw[s, j])))
+            normed = np.asarray(renormalize(
+                nm, cw, carry, sl, jnp.asarray(eff_raw[s]), feas_j),
+                dtype=np.int64)
+            w = cw.config.weight(nm)
+            record_final[s] = normed * w
+            fw_norm = np.array(normed, copy=True)
+            if ext is not None and has_hook(ext, "after_normalize"):
+                ret = ext.after_normalize(
+                    pod, {names[j]: int(fw_norm[j]) for j in feas_idx})
+                if ret is not None:
+                    for node_name, v in ret.items():
+                        j = names.index(node_name) if node_name in names else -1
+                        if j >= 0:
+                            fw_norm[j] = int(v)
+            total += np.where(feasible, fw_norm * w, 0)
+        return record_final, total, False
+
+    def _host_pod_loop(self, cw, pending, eval_fn, bind_fn, carry, names,
+                       name_to_idx, postfilter_on) -> tuple[int, str | None]:
         import jax
         import numpy as np
 
         from .replay import ReplayResult
+
+        from ..scheduler.debuggable import has_hook
+
+        hooks = self._cycle_hooks()
+        custom_norm = any(
+            cw.config.is_custom(nm) and getattr(cw.config.custom[nm], "has_normalize", False)
+            for nm in cw.config.enabled
+        )
+        rescore = bool(hooks) or custom_norm
+        has_filter_hooks = any(
+            has_hook(ext, "before_filter") or has_hook(ext, "after_filter")
+            for ext in hooks.values()
+        )
 
         n_bound = 0
         retry: str | None = None
@@ -455,68 +686,39 @@ class SchedulerEngine:
             codes = np.asarray(out.filter_codes)
             fskip = cw.host["filter_skip"]
             active = [f for f, nm in enumerate(cw.config.filters()) if not fskip[nm][i]]
-            feasible = codes[active].max(axis=0) == 0 if active else np.ones(len(names), bool)
+
             pf_reject = int(out.prefilter_reject)
+            hook_filter_map = None
             if pf_reject:
-                # PreFilter aborted the cycle: no extender round-trip either
-                feasible[:] = False
+                # PreFilter aborted the cycle: Filter never runs upstream,
+                # so neither do Before/After filter hooks, nor extenders
+                feasible = np.zeros(len(names), bool)
+            elif has_filter_hooks:
+                feasible, hook_filter_map = self._hooked_filter_phase(
+                    cw, pod, i, codes, names, hooks)
+            else:
+                feasible = codes[active].max(axis=0) == 0 if active else np.ones(len(names), bool)
 
             meta = pod.get("metadata") or {}
             ns, name = meta.get("namespace") or "default", meta.get("name", "")
-            ext_error = False
-            for idx, ext in enumerate(self.extender_service.extenders):
-                if not ext.filter_verb or not feasible.any():
-                    continue
-                node_names = [names[j] for j in np.flatnonzero(feasible)]
-                args = {"Pod": pod, "NodeNames": node_names}
-                try:
-                    result = self.extender_service.handle("filter", idx, args)
-                except Exception:
-                    if ext.ignorable:
-                        continue
-                    ext_error = True
-                    break
-                # nodeCacheCapable extenders answer with NodeNames; the
-                # default contract answers with a full Nodes list.  Per-node
-                # FailedNodes reasons travel in the recorded
-                # extender-filter-result annotation (handle() stored the
-                # whole response).
-                kept = result.get("NodeNames") or result.get("nodeNames")
-                if kept is None:
-                    nodes_obj = result.get("Nodes") or result.get("nodes")
-                    if nodes_obj is not None:
-                        kept = [
-                            ((item.get("metadata") or {}).get("name", ""))
-                            for item in (nodes_obj.get("Items") or nodes_obj.get("items") or [])
-                        ]
-                if kept is None:
-                    continue  # extender restricted nothing
-                keep_mask = np.zeros(len(names), bool)
-                for nm in kept:
-                    j = name_to_idx.get(nm)
-                    if j is not None:
-                        keep_mask[j] = True
-                feasible &= keep_mask
+            ext_error = self._webhook_filter(pod, names, name_to_idx, feasible)
 
-            total = np.asarray(out.score_final).sum(axis=0).astype(np.int64)
-            for idx, ext in enumerate(self.extender_service.extenders):
-                if not ext.prioritize_verb or feasible.sum() <= 1:
-                    continue
-                node_names = [names[j] for j in np.flatnonzero(feasible)]
-                try:
-                    plist = self.extender_service.handle(
-                        "prioritize", idx, {"Pod": pod, "NodeNames": node_names}
-                    )
-                except Exception:
-                    continue
-                for entry in plist or []:
-                    j = name_to_idx.get(entry.get("Host") or entry.get("host", ""))
-                    if j is not None:
-                        total[j] += int(entry.get("Score") or entry.get("score") or 0) * ext.weight
+            cycle_error = False
+            record_final = np.asarray(out.score_final)
+            if rescore and not ext_error and int(feasible.sum()) > 1:
+                record_final, total, cycle_error = self._hooked_score_phase(
+                    cw, carry, sl, pod, i, np.asarray(out.score_raw), names,
+                    feasible, hooks)
+            else:
+                total = np.asarray(out.score_final).sum(axis=0).astype(np.int64)
+            if not cycle_error:
+                self._webhook_prioritize(pod, names, name_to_idx, feasible, total)
 
             count = int(feasible.sum())
             sel = -1
-            if count == 1:
+            if cycle_error:
+                pass  # RunScorePlugins error: the cycle fails outright
+            elif count == 1:
                 sel = int(np.flatnonzero(feasible)[0])
             elif count > 1:
                 masked = np.where(feasible, total, -1)
@@ -526,14 +728,19 @@ class SchedulerEngine:
                 cw=cw,
                 filter_codes=codes[None],
                 score_raw=np.asarray(out.score_raw)[None],
-                score_final=np.asarray(out.score_final)[None],
+                score_final=np.asarray(record_final)[None],
                 selected=np.asarray([sel], dtype=np.int32),
                 feasible_count=np.asarray([count], dtype=np.int32),
                 prefilter_reject=np.asarray([pf_reject], dtype=np.int32),
             )
-            annotations = decode_pod_result(rr1, 0, feasible_override=feasible, host_index=i)
+            annotations = decode_pod_result(
+                rr1, 0,
+                feasible_override=(np.zeros_like(feasible) if cycle_error else feasible),
+                host_index=i)
+            if hook_filter_map is not None and not pf_reject:
+                annotations[ann.FILTER_RESULT] = ann.marshal(hook_filter_map)
             self.result_store.put_decoded(ns, name, annotations)
-            for hook in self.plugin_extenders:
+            for hook in self._extenders_map().values():
                 hook.after_cycle(pod, annotations, self.result_store)
 
             bind_ok = sel >= 0 and not ext_error
@@ -546,8 +753,9 @@ class SchedulerEngine:
                 sel = -1
             if bind_ok:
                 bound_node = names[sel]
+                extenders = self.extender_service.extenders if self.extender_service else []
                 bind_ext = next(
-                    (k for k, e in enumerate(self.extender_service.extenders) if e.bind_verb),
+                    (k for k, e in enumerate(extenders) if e.bind_verb),
                     None,
                 )
                 if bind_ext is not None:
@@ -568,13 +776,14 @@ class SchedulerEngine:
                 n_bound += 1
             else:
                 # FitError (no feasible node) runs PostFilter, like the
-                # plain path; an extender/bind failure or a lifecycle
-                # rejection does not (upstream only preempts on FitError).
-                # Candidate nodes are those that failed the PLUGIN filters
-                # — extender-rejected nodes are not preemption candidates
-                # (docs/SEMANTICS.md).
+                # plain path; an extender/bind failure, a scoring-cycle
+                # error, or a lifecycle rejection does not (upstream only
+                # preempts on FitError).  Candidate nodes are those that
+                # failed the PLUGIN filters — extender-rejected nodes are
+                # not preemption candidates (docs/SEMANTICS.md).
                 if (postfilter_on and sel < 0 and not ext_error
-                        and not pf_reject and not lifecycle_rejected):
+                        and not pf_reject and not lifecycle_rejected
+                        and not cycle_error):
                     if self._run_postfilter(cw, codes, i, pod, ns, name):
                         retry = "preempted"
                 self._mark_unschedulable(ns, name)

@@ -102,7 +102,10 @@ def _score_one(name: str, cw: CompiledWorkload, carry, sl, feasible):
     """-> (raw int64 [N], normalized int64 [N])."""
     if cw.config.is_custom(name):
         raw = sl[name].scores.astype(jnp.int64)
-        return raw, raw  # custom NormalizeScore unsupported (build_custom rejects)
+        # a custom NormalizeScore cannot run inside the scan; the engine
+        # routes such configs to the host path (engine._needs_host_path)
+        # and replay() refuses them (framework/replay.py guard)
+        return raw, raw
     if name == "NodeResourcesFit":
         from ..plugins.fitscoring import parse_fit_strategy
 
@@ -142,6 +145,41 @@ def _score_one(name: str, cw: CompiledWorkload, carry, sl, feasible):
         )
         return raw, interpod.normalize(raw, feasible)
     raise ValueError(f"no score kernel for {name}")
+
+
+def renormalize(name: str, cw, carry, sl, raw, feasible):
+    """Host-side NormalizeScore recompute for one plugin: [N] raw scores
+    (possibly hook-modified) + feasibility -> [N] normalized.  Used by the
+    host-interleaved path when AfterScore hooks or hook-changed
+    feasibility invalidate the device's fused normalization, and for
+    custom plugins' NormalizeScore (arbitrary Python cannot run inside the
+    device scan; upstream wraps out-of-tree ScoreExtensions the same as
+    in-tree, wrappedplugin.go:388-415)."""
+    import numpy as np
+
+    if cw.config.is_custom(name):
+        plugin = cw.config.custom[name]
+        if getattr(plugin, "has_normalize", False):
+            raw_np = np.asarray(raw)
+            feas = np.asarray(feasible)
+            idx = np.flatnonzero(feas)
+            vals = plugin.normalize([int(raw_np[j]) for j in idx])
+            out = np.zeros_like(raw_np)
+            out[idx] = np.asarray(list(vals), dtype=out.dtype)
+            return jnp.asarray(out)
+        return raw
+    if name == "NodeAffinity":
+        return affinity.normalize(raw, feasible)
+    if name == "TaintToleration":
+        return taints.taint_normalize(raw, feasible)
+    if name == "InterPodAffinity":
+        return interpod.normalize(raw, feasible)
+    if name == "PodTopologySpread":
+        _, ignored = topologyspread.score_kernel(
+            cw.statics["PodTopologySpread"], sl["PodTopologySpread"],
+            carry["PodTopologySpread"])
+        return topologyspread.normalize(raw, ignored, feasible)
+    return raw  # no ScoreExtensions
 
 
 def _eval_phase(cw: CompiledWorkload, carry, sl, weights, filter_names, score_names):

@@ -139,7 +139,7 @@ class Preemptor:
         cw = compile_workload(
             nodes, [pod], self.plugin_config, bound_pods=bound, volumes=self._volumes
         )
-        rr = replay(cw, chunk=1)
+        rr = replay(cw, chunk=1, filter_only=True)
         try:
             j = cw.node_table.names.index(node_name)
         except ValueError:

@@ -130,7 +130,7 @@ def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1):
 
 
 def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
-           unroll: int = 1) -> ReplayResult:
+           unroll: int = 1, filter_only: bool = False) -> ReplayResult:
     """Run the full queue; returns host-side result arrays.
 
     collect=False skips device->host transfer of the per-node tensors
@@ -138,7 +138,19 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
     unroll: lax.scan unroll factor — trades compile time for lower
     per-iteration overhead (the step's ops are tiny [N] vector ops, so
     fixed per-op cost dominates; unrolling lets XLA pipeline iterations).
+    filter_only: the caller only consumes filter codes / prefilter rejects
+    (preemption's fit oracle) — skips the custom-NormalizeScore guard,
+    whose divergence touches scoring alone.
     """
+    if not filter_only:
+        for name in cw.config.enabled:
+            if cw.config.is_custom(name) and getattr(
+                    cw.config.custom[name], "has_normalize", False):
+                raise ValueError(
+                    f"custom plugin {name} has NormalizeScore: the batched "
+                    "scan cannot run it — schedule through the engine (it "
+                    "routes to the host-interleaved path) or use "
+                    "build_phased directly")
     p = cw.n_pods
     chunk = min(chunk, max(p, 1))
     scan_jit = _scan_for(cw, chunk, unroll)

@@ -114,13 +114,12 @@ class CustomXS(NamedTuple):
 
 
 def build_custom(plugin: CustomPlugin, table, pods: list[dict], node_manifests: list[dict]):
-    """-> (CustomXS, msg_table) — messages interned per plugin."""
-    if plugin.has_normalize:
-        raise ValueError(
-            f"custom plugin {plugin.name}: NormalizeScore extensions are not "
-            "supported in the tensor pipeline yet (arbitrary Python cannot "
-            "run inside the device scan); drop normalize() or open an issue"
-        )
+    """-> (CustomXS, msg_table) — messages interned per plugin.
+
+    A plugin with normalize() compiles like any other; its NormalizeScore
+    runs host-side (pipeline.renormalize) on the host-interleaved path —
+    the engine routes such configs there, and replay() refuses them so the
+    batched scan can't silently skip the normalization."""
     n, p = table.n, len(pods)
     codes = np.zeros((p, n), dtype=np.int32)
     scores = np.zeros((p, n), dtype=np.int64)

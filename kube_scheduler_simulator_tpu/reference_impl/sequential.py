@@ -473,7 +473,15 @@ class SequentialScheduler:
 
     def _normalize(self, name, scores: dict[int, int], pod) -> dict[int, int]:
         if self.config.is_custom(name):
-            return dict(scores)  # custom NormalizeScore unsupported (see custom.py)
+            plugin = self.config.custom[name]
+            if getattr(plugin, "has_normalize", False):
+                # upstream passes the feasible nodes' NodeScoreList in
+                # node order (wrappedplugin.go:388-415 wraps out-of-tree
+                # ScoreExtensions identically to in-tree ones)
+                idx = sorted(scores)
+                vals = list(plugin.normalize([int(scores[j]) for j in idx]))
+                return {j: int(v) for j, v in zip(idx, vals)}
+            return dict(scores)
         if name in ("NodeResourcesFit", "NodeResourcesBalancedAllocation", "ImageLocality",
                     "VolumeBinding"):
             return dict(scores)  # no ScoreExtensions

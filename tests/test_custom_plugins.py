@@ -54,23 +54,73 @@ def test_custom_plugin_parity():
         assert s % 2 == 0
 
 
-def test_custom_normalize_rejected():
-    class BadPlugin(CustomPlugin):
-        name = "Bad"
+class HalfNormalize(CustomPlugin):
+    """Scores the node index; NormalizeScore halves every score."""
 
-        def score(self, pod, node):
-            return 1
+    name = "HalfNormalize"
+    default_weight = 3
 
-        def normalize(self, scores):
-            return scores
+    def score(self, pod, node):
+        return int(node["metadata"]["name"].rsplit("-", 1)[1]) * 10
 
-    nodes = make_nodes(2, seed=22)
-    from kube_scheduler_simulator_tpu.state.nodes import build_node_table
-    from kube_scheduler_simulator_tpu.state.resources import ResourceSchema
+    def normalize(self, scores):
+        return [s // 2 for s in scores]
 
-    table = build_node_table(nodes, ResourceSchema())
+
+def test_custom_normalize_requires_host_path():
+    """replay() (the batched scan) cannot run Python NormalizeScore and
+    must refuse, pointing at the engine's host-interleaved path."""
+    nodes = make_nodes(3, seed=22)
+    pods = make_pods(2, seed=23)
+    cfg = PluginSetConfig(
+        enabled=["NodeResourcesFit", "HalfNormalize"],
+        custom={"HalfNormalize": HalfNormalize()},
+    )
     with pytest.raises(ValueError, match="NormalizeScore"):
-        build_custom(BadPlugin(), table, [], nodes)
+        replay(compile_workload(nodes, pods, cfg), chunk=2)
+
+
+def test_custom_normalize_scheduled_and_recorded():
+    """The engine routes custom-NormalizeScore configs to the host path;
+    finalscore-result = normalize(raw) x weight and the oracle agrees."""
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+
+    nodes = make_nodes(4, seed=24)
+    pods = make_pods(3, seed=25)
+    cfg = PluginSetConfig(
+        enabled=["NodeResourcesFit", "HalfNormalize"],
+        custom={"HalfNormalize": HalfNormalize()},
+    )
+    store = ObjectStore()
+    for n in nodes:
+        store.create("nodes", n)
+    for p in pods:
+        store.create("pods", p)
+    engine = SchedulerEngine(store, plugin_config=cfg)
+    assert engine._needs_host_path()
+    n_bound = engine.schedule_pending()
+
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    assert n_bound == sum(1 for _, s in seq if s >= 0)
+    for i, (sa, ss) in enumerate(seq):
+        pod = store.get("pods", pods[i]["metadata"]["name"])
+        annos = pod["metadata"]["annotations"]
+        for k in (ann.SCORE_RESULT, ann.FINAL_SCORE_RESULT, ann.FILTER_RESULT,
+                  ann.SELECTED_NODE):
+            assert annos.get(k) == sa[k], f"pod {i} {k}"
+        got = pod["spec"].get("nodeName") or ""
+        want = nodes[ss]["metadata"]["name"] if ss >= 0 else ""
+        assert got == want
+    # the record really shows halved scores: raw = idx*10, final = idx*5*w
+    fs = json.loads(store.get("pods", pods[0]["metadata"]["name"])
+                    ["metadata"]["annotations"][ann.FINAL_SCORE_RESULT])
+    sc = json.loads(store.get("pods", pods[0]["metadata"]["name"])
+                    ["metadata"]["annotations"][ann.SCORE_RESULT])
+    for node_name, entry in fs.items():
+        idx = int(node_name.rsplit("-", 1)[1])
+        assert sc[node_name]["HalfNormalize"] == str(idx * 10)
+        assert entry["HalfNormalize"] == str((idx * 10 // 2) * 3)
 
 
 def test_new_scheduler_command_with_plugin_and_extender():

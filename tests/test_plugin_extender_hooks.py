@@ -1,0 +1,306 @@
+"""Per-extension-point plugin-extender Before/After hooks — the
+reference's PluginExtenders contract (wrappedplugin.go:159-171, ordering
+tested in wrappedplugin_test.go):
+
+  * Before* runs before the original plugin; a non-success short-circuits
+    — the plugin never runs and NOTHING is recorded for it;
+  * the store records the ORIGINAL plugin's result;
+  * After* rewrites what the framework sees (placement), not the record.
+"""
+
+import json
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.scheduler.debuggable import PluginExtender
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+
+class IndexScore(CustomPlugin):
+    """Filter passes everywhere; score = node index * 10."""
+
+    name = "IndexScore"
+    default_weight = 1
+
+    def filter(self, pod, node):
+        return None
+
+    def score(self, pod, node):
+        return int(node["metadata"]["name"].rsplit("-", 1)[1]) * 10
+
+
+def _nodes(n):
+    return [
+        {"metadata": {"name": f"node-{i:05d}"},
+         "status": {"allocatable": {"cpu": "8", "memory": "32Gi", "pods": "50"}}}
+        for i in range(n)
+    ]
+
+
+def _pod(name="pod-a"):
+    return {"kind": "Pod", "metadata": {"name": name}, "spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}]}}
+
+
+def _engine(extenders, plugins=None, n_nodes=3):
+    store = ObjectStore()
+    for n in _nodes(n_nodes):
+        store.create("nodes", n)
+    store.create("pods", _pod())
+    plugins = plugins if plugins is not None else [IndexScore()]
+    cfg = PluginSetConfig(
+        enabled=["NodeResourcesFit"] + [p.name for p in plugins],
+        custom={p.name: p for p in plugins},
+    )
+    engine = SchedulerEngine(store, plugin_config=cfg)
+    engine.plugin_extenders = extenders
+    return engine, store
+
+
+def _annos(store, name="pod-a"):
+    return store.get("pods", name)["metadata"].get("annotations") or {}
+
+
+def test_before_filter_failure_suppresses_record_and_node():
+    calls = []
+
+    class Ext(PluginExtender):
+        def before_filter(self, pod, node_name):
+            calls.append(("before", node_name))
+            return "vetoed by hook" if node_name == "node-00002" else None
+
+    engine, store = _engine({"IndexScore": Ext()})
+    assert engine._needs_host_path()
+    assert engine.schedule_pending() == 1
+    annos = _annos(store)
+    fr = json.loads(annos[ann.FILTER_RESULT])
+    # node-00002: NodeResourcesFit (earlier in order) recorded, IndexScore
+    # NOT recorded (Before short-circuited before the plugin ran)
+    assert fr["node-00002"] == {"NodeResourcesFit": "passed"}
+    assert fr["node-00000"]["IndexScore"] == "passed"
+    # the vetoed node lost: IndexScore alone would pick the highest index
+    assert annos[ann.SELECTED_NODE] == "node-00001"
+    assert ("before", "node-00002") in calls
+
+
+def test_after_filter_fail_hides_node_but_record_shows_passed():
+    class Ext(PluginExtender):
+        def after_filter(self, pod, node_name, msg):
+            if node_name == "node-00002":
+                return "hook says no"
+            return msg
+
+    engine, store = _engine({"IndexScore": Ext()})
+    assert engine.schedule_pending() == 1
+    annos = _annos(store)
+    fr = json.loads(annos[ann.FILTER_RESULT])
+    # record keeps the plugin's own result (AddFilterResult runs before
+    # AfterFilter), but the framework never considers the node
+    assert fr["node-00002"]["IndexScore"] == "passed"
+    assert annos[ann.SELECTED_NODE] == "node-00001"
+    assert "node-00002" not in json.loads(annos[ann.SCORE_RESULT])
+
+
+def test_after_filter_pass_resurrects_node_and_later_plugins_record():
+    class Veto(CustomPlugin):
+        name = "Veto"
+
+        def filter(self, pod, node):
+            return ("no" if node["metadata"]["name"] == "node-00002" else None)
+
+    class Tail(CustomPlugin):
+        name = "Tail"
+
+        def filter(self, pod, node):
+            return None
+
+        def score(self, pod, node):
+            return int(node["metadata"]["name"].rsplit("-", 1)[1]) * 10
+
+    class Ext(PluginExtender):
+        def after_filter(self, pod, node_name, msg):
+            return None  # everything passes as far as the framework knows
+
+    engine, store = _engine({"Veto": Ext()}, plugins=[Veto(), Tail()])
+    assert engine.schedule_pending() == 1
+    annos = _annos(store)
+    fr = json.loads(annos[ann.FILTER_RESULT])
+    # record keeps Veto's own failure, AND later plugins ran + recorded on
+    # that node because the framework continued past the rewritten status
+    assert fr["node-00002"]["Veto"] == "no"
+    assert fr["node-00002"]["Tail"] == "passed"
+    # the resurrected highest-index node wins on Tail's score
+    assert annos[ann.SELECTED_NODE] == "node-00002"
+
+
+def test_after_score_changes_selection_but_not_score_record():
+    class Ext(PluginExtender):
+        def after_score(self, pod, node_name, score):
+            # invert the ranking
+            return 1000 - score
+
+    engine, store = _engine({"IndexScore": Ext()})
+    assert engine.schedule_pending() == 1
+    annos = _annos(store)
+    sc = json.loads(annos[ann.SCORE_RESULT])
+    # score-result keeps the ORIGINAL raw scores
+    assert sc["node-00002"]["IndexScore"] == "20"
+    # but the framework ranked on the inverted values -> lowest index wins
+    assert annos[ann.SELECTED_NODE] == "node-00000"
+    # finalscore reflects normalize(modified raw) x weight: IndexScore has
+    # no ScoreExtensions, so final = modified raw x 1
+    fs = json.loads(annos[ann.FINAL_SCORE_RESULT])
+    assert fs["node-00000"]["IndexScore"] == "1000"
+    assert fs["node-00002"]["IndexScore"] == "980"
+
+
+def test_before_score_failure_fails_the_cycle():
+    class Ext(PluginExtender):
+        def before_score(self, pod, node_name):
+            return "scoring disabled"
+
+    engine, store = _engine({"IndexScore": Ext()})
+    assert engine.schedule_pending() == 0
+    pod = store.get("pods", "pod-a")
+    assert not pod["spec"].get("nodeName")
+    conds = {c["type"]: c for c in pod["status"]["conditions"]}
+    assert conds["PodScheduled"]["reason"] == "Unschedulable"
+
+
+def test_after_normalize_changes_selection_not_record():
+    class Ext(PluginExtender):
+        def after_normalize(self, pod, scores):
+            # force node-00000 to the top for the framework only
+            out = dict(scores)
+            out["node-00000"] = 10_000
+            return out
+
+    engine, store = _engine({"IndexScore": Ext()})
+    assert engine.schedule_pending() == 1
+    annos = _annos(store)
+    assert annos[ann.SELECTED_NODE] == "node-00000"
+    fs = json.loads(annos[ann.FINAL_SCORE_RESULT])
+    # record written before AfterNormalizeScore upstream
+    assert fs["node-00000"]["IndexScore"] == "0"
+    assert fs["node-00002"]["IndexScore"] == "20"
+
+
+class LifecyclePlugin(CustomPlugin):
+    name = "LC"
+
+    def __init__(self, log):
+        self.log = log
+
+    def filter(self, pod, node):
+        return None
+
+    def reserve(self, pod, node):
+        self.log.append("reserve")
+        return None
+
+    def unreserve(self, pod, node):
+        self.log.append("unreserve")
+
+    def permit(self, pod, node):
+        self.log.append("permit")
+        return None
+
+    def pre_bind(self, pod, node):
+        self.log.append("pre_bind")
+        return None
+
+
+def test_before_reserve_failure_skips_plugin_and_record():
+    log = []
+
+    class Ext(PluginExtender):
+        def before_reserve(self, pod, node):
+            return "reservation vetoed"
+
+    engine, store = _engine({"LC": Ext()}, plugins=[LifecyclePlugin(log)])
+    assert engine.schedule_pending() == 0
+    assert "reserve" not in log          # plugin skipped
+    assert "unreserve" in log            # unreserve still runs
+    annos = _annos(store)
+    assert json.loads(annos.get(ann.RESERVE_RESULT, "{}")) == {}  # no record
+
+
+def test_after_permit_deny_overrides_allow():
+    log = []
+
+    class Ext(PluginExtender):
+        def after_permit(self, pod, node, out):
+            return "denied by hook"
+
+    engine, store = _engine({"LC": Ext()}, plugins=[LifecyclePlugin(log)])
+    assert engine.schedule_pending() == 0
+    assert "permit" in log
+    annos = _annos(store)
+    # record keeps the plugin's own allow; the framework obeyed the hook
+    assert json.loads(annos[ann.PERMIT_STATUS_RESULT])["LC"] == "success"
+    assert not store.get("pods", "pod-a")["spec"].get("nodeName")
+
+
+def test_after_pre_bind_failure_unreserves():
+    log = []
+
+    class Ext(PluginExtender):
+        def after_pre_bind(self, pod, node, msg):
+            return "prebind vetoed"
+
+    engine, store = _engine({"LC": Ext()}, plugins=[LifecyclePlugin(log)])
+    assert engine.schedule_pending() == 0
+    assert "pre_bind" in log and "unreserve" in log
+    annos = _annos(store)
+    assert json.loads(annos[ann.PRE_BIND_RESULT])["LC"] == "success"
+
+
+def test_custom_normalize_with_preemption_does_not_crash():
+    """Preemption's fit oracle replays with the same plugin config; the
+    replay() NormalizeScore guard must not fire for that filter-only
+    caller (regression: ValueError aborted the whole wave)."""
+    class Norm(IndexScore):
+        name = "Norm"
+
+        def normalize(self, scores):
+            return list(scores)
+
+    store = ObjectStore()
+    store.create("nodes", {
+        "metadata": {"name": "node-00000"},
+        "status": {"allocatable": {"cpu": "2", "memory": "4Gi", "pods": "10"}}})
+    # a low-priority victim occupying the node
+    store.create("pods", {
+        "kind": "Pod", "metadata": {"name": "victim"},
+        "spec": {"priority": 0, "nodeName": "node-00000", "containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "2", "memory": "3Gi"}}}]}})
+    store.create("pods", {
+        "kind": "Pod", "metadata": {"name": "urgent"},
+        "spec": {"priority": 100, "containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "2", "memory": "3Gi"}}}]}})
+    cfg = PluginSetConfig(
+        enabled=["NodeResourcesFit", "DefaultPreemption", "Norm"],
+        custom={"Norm": Norm()},
+    )
+    engine = SchedulerEngine(store, plugin_config=cfg)
+    assert engine._needs_host_path()
+    assert engine.schedule_pending() == 1
+    assert store.get("pods", "urgent")["spec"].get("nodeName") == "node-00000"
+
+
+def test_hooks_only_apply_to_their_plugin():
+    """An extender registered for a DISABLED plugin name must not force
+    the host path or fire."""
+    fired = []
+
+    class Ext(PluginExtender):
+        def before_filter(self, pod, node_name):
+            fired.append(node_name)
+            return "nope"
+
+    engine, store = _engine({"NotEnabled": Ext()})
+    assert not engine._needs_host_path()
+    assert engine.schedule_pending() == 1
+    assert fired == []
