@@ -461,7 +461,10 @@ class SchedulerEngine:
             (node, firsts[j]) for j, node in enumerate(cw.node_table.names)
             if firsts[j] is not None
         ]
-        outcome = Preemptor(self.store, self.plugin_config).preempt(pod, failed)
+        outcome = Preemptor(
+            self.store, self.plugin_config,
+            extender_service=self.extender_service,
+        ).preempt(pod, failed)
         self.result_store.add_post_filter_result(
             ns, name, outcome.nominated_node, PLUGIN_NAME, outcome.evaluated_nodes
         )
@@ -607,7 +610,7 @@ class SchedulerEngine:
         return eff_feasible, filter_map
 
     def _hooked_score_phase(self, cw, carry, sl, pod, pod_idx, raw, names,
-                            feasible, hooks):
+                            feasible, hooks, name_to_idx):
         """AfterScore rewrites + host renormalization + AfterNormalize.
         Returns (record_final [S,N], total [N], cycle_error: bool).
 
@@ -652,8 +655,8 @@ class SchedulerEngine:
                     pod, {names[j]: int(fw_norm[j]) for j in feas_idx})
                 if ret is not None:
                     for node_name, v in ret.items():
-                        j = names.index(node_name) if node_name in names else -1
-                        if j >= 0:
+                        j = name_to_idx.get(node_name)
+                        if j is not None:
                             fw_norm[j] = int(v)
             total += np.where(feasible, fw_norm * w, 0)
         return record_final, total, False
@@ -708,7 +711,7 @@ class SchedulerEngine:
             if rescore and not ext_error and int(feasible.sum()) > 1:
                 record_final, total, cycle_error = self._hooked_score_phase(
                     cw, carry, sl, pod, i, np.asarray(out.score_raw), names,
-                    feasible, hooks)
+                    feasible, hooks, name_to_idx)
             else:
                 total = np.asarray(out.score_final).sum(axis=0).astype(np.int64)
             if not cycle_error:

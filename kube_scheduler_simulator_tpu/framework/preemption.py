@@ -106,9 +106,13 @@ def first_fail_plugins(codes: np.ndarray, active_names: list[str]) -> list[str |
 class Preemptor:
     """Runs preemption for one unschedulable pod against live store state."""
 
-    def __init__(self, store, plugin_config):
+    def __init__(self, store, plugin_config, extender_service=None):
         self.store = store
         self.plugin_config = plugin_config
+        # webhook extenders with a preemptVerb participate in candidate
+        # selection (upstream preemption callExtenders; the reference
+        # proxies + records the round-trip, extender/service.go:45-85)
+        self.extender_service = extender_service
         self._fit_cache: dict = {}
         self._nodes: list[dict] | None = None   # store snapshot, per preempt()
         self._pods_all: list[dict] | None = None
@@ -201,10 +205,75 @@ class Preemptor:
         if not candidates:
             return out
 
+        if self.extender_service is not None:
+            candidates = self._call_extenders(pod, candidates)
+            if not candidates:
+                return out
+
         node, victims = self._select(candidates)
         out.nominated_node = node
         out.victims = victims
         return out
+
+    def _call_extenders(self, pod: dict, candidates: list[tuple[str, list[dict]]]
+                        ) -> list[tuple[str, list[dict]]]:
+        """upstream preemption callExtenders: each preempt-capable extender
+        receives ExtenderPreemptionArgs{Pod, NodeNameToVictims} and returns
+        a (possibly narrowed) node->victims map; an unignorable error
+        aborts preemption.  Each round-trip is recorded into
+        extender-preempt-result by the service's store."""
+        def _pods_of(victims_obj) -> list:
+            # the k8s extender/v1 Victims json tag is lowercase "pods";
+            # accept the capitalized Go-field spelling too (as the
+            # node-map and UID keys already do)
+            v = victims_obj or {}
+            return v.get("Pods") or v.get("pods") or []
+
+        node_to_victims: dict[str, dict] = {
+            node: {"Pods": victims, "NumPDBViolations": 0}
+            for node, victims in candidates
+        }
+        order = [node for node, _ in candidates]
+        for idx, ext in enumerate(self.extender_service.extenders):
+            if not ext.preempt_verb or not node_to_victims:
+                continue
+            args = {"Pod": pod, "NodeNameToVictims": node_to_victims}
+            try:
+                result = self.extender_service.handle("preempt", idx, args)
+            except Exception:
+                if ext.ignorable:
+                    continue
+                return []  # non-ignorable extender error aborts preemption
+            ret = result.get("NodeNameToVictims") or result.get("nodeNameToVictims")
+            if ret is None:
+                # nodeCacheCapable contract: MetaVictims carry pod UIDs
+                meta = (result.get("NodeNameToMetaVictims")
+                        or result.get("nodeNameToMetaVictims"))
+                if meta is None:
+                    continue
+                ret = {}
+                for node, mv in meta.items():
+                    olds = {}
+                    for v in _pods_of(node_to_victims.get(node)):
+                        vm = v.get("metadata") or {}
+                        olds[vm.get("uid") or vm.get("name", "")] = v
+                    pods = [
+                        olds[m.get("UID") or m.get("uid") or ""]
+                        for m in _pods_of(mv)
+                        if (m.get("UID") or m.get("uid") or "") in olds
+                    ]
+                    ret[node] = {"Pods": pods,
+                                 "NumPDBViolations": (mv or {}).get("NumPDBViolations")
+                                 or (mv or {}).get("numPDBViolations") or 0}
+            else:
+                ret = {n: {"Pods": _pods_of(v)} for n, v in ret.items()}
+            node_to_victims = {
+                n: v for n, v in ret.items() if n in node_to_victims
+            }
+        return [
+            (n, _pods_of(node_to_victims[n]))
+            for n in order if n in node_to_victims
+        ]
 
     def _victims_on(self, node: str, node_pods: list[dict], pod: dict,
                     pod_prio: int) -> list[dict] | None:
