@@ -80,6 +80,11 @@ def main():
                          "keeps the REAL cluster size so per-cycle cost is "
                          "honest (per-cycle work grows with node count)")
     ap.add_argument("--chunk", type=int, default=1024)
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard the node axis over this many devices "
+                         "(0: unsharded). Single-chip bench runs leave "
+                         "this 0; the virtual-CPU mesh path is validated "
+                         "by dryrun_multichip + tests/test_mesh.py")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true", help="tiny sizes, fast")
     ap.add_argument("--skip-parity", action="store_true")
@@ -139,18 +144,34 @@ def main():
     cw = compile_workload(nodes, pods, cfg)
     log(f"compile_workload (host precompile): {time.time()-t0:.1f}s")
 
+    mesh = None
+    if args.mesh:
+        from kube_scheduler_simulator_tpu.parallel.mesh import make_mesh
+
+        shards = args.mesh
+        while shards > 1 and len(nodes) % shards:
+            shards -= 1  # node axis must divide evenly across shards
+        if shards > 1:
+            mesh = make_mesh(shards, dp=1)
+            log(f"mesh: node axis sharded over {shards} devices"
+                + (f" (requested {args.mesh}, reduced to divide {len(nodes)} nodes)"
+                   if shards != args.mesh else ""))
+        else:
+            log(f"mesh: {len(nodes)} nodes not divisible by any shard count "
+                f"<= {args.mesh}; running unsharded")
+
     t0 = time.time()
-    rr = replay(cw, chunk=args.chunk, collect=False)  # warm-up: XLA compile + run
+    rr = replay(cw, chunk=args.chunk, collect=False, mesh=mesh)  # warm-up: XLA compile + run
     log(f"warm-up replay: {time.time()-t0:.1f}s, scheduled {rr.scheduled}/{len(pods)}")
 
     t0 = time.time()
-    rr = replay(cw, chunk=args.chunk, collect=False)
+    rr = replay(cw, chunk=args.chunk, collect=False, mesh=mesh)
     tpu_s = time.time() - t0
     tpu_cps = len(pods) / tpu_s
     log(f"timed replay (results on device): {tpu_s:.2f}s -> {tpu_cps:,.0f} cycles/s")
 
     t0 = time.time()
-    replay(cw, chunk=args.chunk, collect=True)
+    replay(cw, chunk=args.chunk, collect=True, mesh=mesh)
     log(f"replay incl. host transfer of result tensors: {time.time()-t0:.2f}s "
         f"-> {len(pods)/(time.time()-t0):,.0f} cycles/s")
 

@@ -38,7 +38,7 @@ class SchedulerEngine:
     def __init__(self, store: ObjectStore, reflector: StoreReflector | None = None,
                  result_store: ResultStore | None = None,
                  plugin_config: PluginSetConfig | None = None,
-                 chunk: int = 512):
+                 chunk: int = 512, mesh=None):
         self.store = store
         self.result_store = result_store or ResultStore()
         self.reflector = reflector or StoreReflector(store)
@@ -46,6 +46,9 @@ class SchedulerEngine:
             self.reflector.add_result_store(self.result_store, RESULT_STORE_KEY)
         self.plugin_config = plugin_config or PluginSetConfig()
         self.chunk = chunk
+        # optional jax.sharding.Mesh with a "nodes" axis: every batched
+        # replay shards the node axis across it (parallel/mesh.py)
+        self.mesh = mesh
         self.extender_service = None
         # plugin name -> PluginExtender (the reference's WithPluginExtenders
         # registry); a bare list is accepted as anonymous after_cycle
@@ -262,7 +265,8 @@ class SchedulerEngine:
             return self._schedule_host_path(cw, pending)
 
         with TRACER.span("device_replay", pods=len(pending), nodes=len(nodes)):
-            rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)))
+            rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
+                        mesh=self.mesh)
         postfilter_on = bool(self.plugin_config.postfilters())
 
         n_bound = 0

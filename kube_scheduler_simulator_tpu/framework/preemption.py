@@ -244,11 +244,19 @@ class Preemptor:
                 if ext.ignorable:
                     continue
                 return []  # non-ignorable extender error aborts preemption
-            ret = result.get("NodeNameToVictims") or result.get("nodeNameToVictims")
+            def _field(obj, *keys):
+                # key-presence lookup: an explicit {} answer ("no candidate
+                # may be preempted") must not read as "no opinion"
+                for k in keys:
+                    if k in obj:
+                        return obj[k]
+                return None
+
+            ret = _field(result, "NodeNameToVictims", "nodeNameToVictims")
             if ret is None:
                 # nodeCacheCapable contract: MetaVictims carry pod UIDs
-                meta = (result.get("NodeNameToMetaVictims")
-                        or result.get("nodeNameToMetaVictims"))
+                meta = _field(result, "NodeNameToMetaVictims",
+                              "nodeNameToMetaVictims")
                 if meta is None:
                     continue
                 ret = {}

@@ -71,10 +71,12 @@ _SCAN_CACHE: dict = {}
 _SCAN_CACHE_MAX = 64
 
 
-def _workload_scan_key(cw: CompiledWorkload, chunk: int):
+def _workload_scan_key(cw: CompiledWorkload, chunk: int, mesh=None):
     import hashlib
 
     h = hashlib.sha1()
+    if mesh is not None:
+        h.update(repr(tuple(mesh.shape.items())).encode())
     for name in sorted(cw.statics):
         h.update(name.encode())
         for leaf in jax.tree.leaves(cw.statics[name]):
@@ -113,8 +115,8 @@ class _SlimWorkload:
         self.schema = cw.schema
 
 
-def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1):
-    key = (*_workload_scan_key(cw, chunk), unroll)
+def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1, mesh=None):
+    key = (*_workload_scan_key(cw, chunk, mesh), unroll)
     scan_jit = _SCAN_CACHE.get(key)
     if scan_jit is None:
         step = build_step(_SlimWorkload(cw))
@@ -130,7 +132,8 @@ def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1):
 
 
 def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
-           unroll: int = 1, filter_only: bool = False) -> ReplayResult:
+           unroll: int = 1, filter_only: bool = False,
+           mesh=None) -> ReplayResult:
     """Run the full queue; returns host-side result arrays.
 
     collect=False skips device->host transfer of the per-node tensors
@@ -141,7 +144,17 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
     filter_only: the caller only consumes filter codes / prefilter rejects
     (preemption's fit oracle) — skips the custom-NormalizeScore guard,
     whose divergence touches scoring alone.
+    mesh: a jax.sharding.Mesh with a "nodes" axis — the workload's node
+    axis is sharded over it (parallel/mesh.py shard_workload) and GSPMD
+    inserts the cross-shard collectives (feasible-count sums, normalize
+    max/min, select argmax ride ICI); results are bit-identical to the
+    unsharded replay (tests/test_mesh.py parity gate).  The node count
+    must divide by the mesh's "nodes" extent.
     """
+    if mesh is not None:
+        from ..parallel.mesh import shard_workload
+
+        cw = shard_workload(cw, mesh)
     if not filter_only:
         for name in cw.config.enabled:
             if cw.config.is_custom(name) and getattr(
@@ -153,7 +166,7 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
                     "build_phased directly")
     p = cw.n_pods
     chunk = min(chunk, max(p, 1))
-    scan_jit = _scan_for(cw, chunk, unroll)
+    scan_jit = _scan_for(cw, chunk, unroll, mesh)
 
     # copy: the scan donates its carry argument, and cw.init_carry must
     # survive for subsequent replays of the same compiled workload

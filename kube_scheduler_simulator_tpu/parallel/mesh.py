@@ -68,8 +68,17 @@ def _node_axis_spec(x, n_nodes: int, skip_leading: bool):
 
 
 def shard_workload(cw: CompiledWorkload, mesh: Mesh) -> CompiledWorkload:
-    """Place statics/xs/carry with the node axis sharded over the mesh."""
+    """A copy of `cw` with statics/xs/carry placed node-axis-sharded over
+    the mesh (the input workload is left untouched so unsharded replays of
+    the same object stay genuinely unsharded)."""
+    import dataclasses
+
     n = cw.n_nodes
+    shards = mesh.shape.get("nodes", 1)
+    if shards > 1 and n % shards:
+        raise ValueError(
+            f"node axis ({n}) must divide evenly across the mesh's "
+            f"'nodes' extent ({shards}); pick a divisor shard count")
 
     def place(skip_leading):
         def f(x):

