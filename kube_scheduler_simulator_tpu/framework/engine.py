@@ -34,6 +34,16 @@ EXTENDER_STORE_KEY = "ExtenderResultStoreKey"  # reference: extender/service.go:
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
 
+class _LazyDecode:
+    """list-like view decoding each pod's annotations on first access."""
+
+    def __init__(self, rr):
+        self.rr = rr
+
+    def __getitem__(self, i):
+        return decode_pod_result(self.rr, i)
+
+
 class SchedulerEngine:
     def __init__(self, store: ObjectStore, reflector: StoreReflector | None = None,
                  result_store: ResultStore | None = None,
@@ -269,13 +279,22 @@ class SchedulerEngine:
                         mesh=self.mesh)
         postfilter_on = bool(self.plugin_config.postfilters())
 
+        from ..store.decode import decode_all_parallel
+
+        if self._custom_lifecycle_plugins():
+            # a custom Reserve/Permit/PreBind can reject mid-wave and abort
+            # the rest — decode per pod so an aborted wave wastes nothing
+            all_annotations = _LazyDecode(rr)
+        else:
+            with TRACER.span("decode_batch", pods=len(pending)):
+                all_annotations = decode_all_parallel(rr, len(pending))
         n_bound = 0
         retry: str | None = None
         with TRACER.span("commit_and_reflect", pods=len(pending)):
             for i, pod in enumerate(pending):
                 meta = pod.get("metadata") or {}
                 ns, name = meta.get("namespace") or "default", meta.get("name", "")
-                annotations = decode_pod_result(rr, i)
+                annotations = all_annotations[i]
                 self.result_store.put_decoded(ns, name, annotations)
                 for hook in self._extenders_map().values():
                     hook.after_cycle(pod, annotations, self.result_store)
@@ -302,7 +321,7 @@ class SchedulerEngine:
                     # is not modeled — documented divergence
                     if postfilter_on and int(rr.prefilter_reject[i]) == 0:
                         if self._run_postfilter(
-                                cw, rr.filter_codes[i], i, pod, ns, name):
+                                cw, rr.codes_of(i), i, pod, ns, name):
                             retry = "preempted"
                     self._mark_unschedulable(ns, name)
                 self.reflector.reflect(ns, name)

@@ -157,7 +157,7 @@ class Preemptor:
             f for f, name in enumerate(cw.config.filters())
             if not cw.host["filter_skip"][name][0]
         ]
-        ok = bool((rr.filter_codes[0][active, j] == 0).all()) if active else True
+        ok = bool((rr.codes_of(0)[active, j] == 0).all()) if active else True
         self._fit_cache[cache_key] = ok
         return ok
 

@@ -7,10 +7,19 @@ cycle of SURVEY.md §3.2).  Here the entire queue is evaluated as a
 `lax.scan` of the fused step (framework/pipeline.py) over the pod axis.
 
 The scan is chunked (default 512 pods per device call) for two reasons:
-  * output tensors are [chunk, F+2S, N]; chunking bounds device memory at
-    ~chunk x plugins x nodes x 4B regardless of queue length;
-  * per-chunk host copies overlap with the next chunk's device compute
-    (jax dispatch is async), pipelining host decode with TPU evaluate.
+  * output tensors are [chunk, .., N]; chunking bounds device memory at
+    ~chunk x plugins x nodes regardless of queue length;
+  * per-chunk host copies overlap with later chunks' device compute
+    (dispatch is async and copy_to_host_async starts each D2H the moment
+    its chunk's results exist), pipelining transfer with TPU evaluate.
+
+Device->host transfer is the end-to-end bottleneck (the axon-tunneled TPU
+link moves ~35 MB/s), so the scan emits pipeline.CompactOut instead of the
+full result tensors: filter codes pack to one int per node (the decoder
+only needs the first failing plugin — the framework stops there), raw
+scores travel as int16 with an overflow->int32 retry, and finalscore is
+recomputed on host from raw + feasibility (framework/hostnorm.py mirrors,
+bit-identical).  ReplayResult hides all of this behind per-pod accessors.
 
 The last chunk is padded; padded steps carry `is_pad` and never bind
 (pipeline masks their selection to -1).
@@ -18,26 +27,65 @@ The last chunk is padded; padded steps carry `is_pad` and never bind
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .pipeline import StepOut, build_step
+from .pipeline import build_step
 from ..state.compile import CompiledWorkload
 
 
-@dataclasses.dataclass
+class _CompactChunks:
+    """Per-chunk CompactOut arrays, host-side."""
+
+    __slots__ = ("packed", "raw8", "raw16", "raw32", "chunk", "pack_mode",
+                 "score_cols")
+
+    def __init__(self, packed, raw8, raw16, raw32, chunk, pack_mode, score_cols):
+        self.packed = packed      # list of [C, N]
+        self.raw8 = raw8          # list of [C, S8, N] int8
+        self.raw16 = raw16        # list of [C, S16, N] int16
+        self.raw32 = raw32        # list of [C, S32, N] int32
+        self.chunk = chunk
+        self.pack_mode = pack_mode
+        self.score_cols = score_cols  # per scorer: ("raw8"|"raw16"|"raw32", row)
+
+
 class ReplayResult:
-    cw: CompiledWorkload
-    filter_codes: np.ndarray    # [P, F, N] int32
-    score_raw: np.ndarray       # [P, S, N] int32
-    score_final: np.ndarray     # [P, S, N] int32
-    selected: np.ndarray        # [P] int32 (-1 unschedulable)
-    feasible_count: np.ndarray  # [P] int32
-    prefilter_reject: np.ndarray  # [P] int32 (bitmask, see pipeline.StepOut)
+    """Host-side replay results.
+
+    Two storage layouts:
+      * compact (the replay() path): first-fail-packed filters + narrow raw
+        scores; full per-pod views are reconstructed chunk-at-a-time on
+        demand (finalscore via framework/hostnorm.py);
+      * full arrays (the engine's host-interleaved path constructs these
+        directly from per-pod StepOuts).
+
+    Use the per-pod accessors (codes_of/raw_of/final_of/feasible_of) —
+    they avoid materializing [P, .., N] tensors.  The legacy whole-array
+    properties exist for tests and small workloads.
+    """
+
+    def __init__(self, cw: CompiledWorkload, filter_codes=None, score_raw=None,
+                 score_final=None, selected=None, feasible_count=None,
+                 prefilter_reject=None, compact: _CompactChunks | None = None):
+        self.cw = cw
+        self._filter_codes = filter_codes
+        self._score_raw = score_raw
+        self._score_final = score_final
+        self.selected = selected
+        self.feasible_count = feasible_count
+        self.prefilter_reject = prefilter_reject
+        self._compact = compact
+        self._recon_ci = -1
+        self._recon: dict[str, np.ndarray] | None = None
+        import threading
+
+        self._recon_lock = threading.Lock()
+
+    # ------------------------------------------------------------ summary
 
     @property
     def scheduled(self) -> int:
@@ -46,6 +94,124 @@ class ReplayResult:
     def selected_node_name(self, i: int) -> str:
         s = int(self.selected[i])
         return self.cw.node_table.names[s] if s >= 0 else ""
+
+    # ------------------------------------------------------------ access
+
+    def codes_of(self, i: int) -> np.ndarray:
+        """[F, N] int32 filter codes for pod i (0 == pass)."""
+        if self._filter_codes is not None:
+            return self._filter_codes[i]
+        d = self._chunk_recon(i // self._compact.chunk)
+        return d["codes"][i % self._compact.chunk]
+
+    def raw_of(self, i: int) -> np.ndarray:
+        """[S, N] raw scores for pod i."""
+        if self._score_raw is not None:
+            return self._score_raw[i]
+        d = self._chunk_recon(i // self._compact.chunk, scores=True)
+        return d["raw"][i % self._compact.chunk]
+
+    def final_of(self, i: int) -> np.ndarray:
+        """[S, N] finalscore (normalized x weight) for pod i."""
+        if self._score_final is not None:
+            return self._score_final[i]
+        d = self._chunk_recon(i // self._compact.chunk, scores=True)
+        return d["final"][i % self._compact.chunk]
+
+    def feasible_of(self, i: int) -> np.ndarray | None:
+        """[N] bool plugin-filter feasibility for pod i, or None when only
+        full arrays are stored (the caller derives it from codes_of)."""
+        if self._compact is None:
+            return None
+        d = self._chunk_recon(i // self._compact.chunk)
+        return d["feasible"][i % self._compact.chunk]
+
+    def _chunk_recon(self, ci: int, scores: bool = False) -> dict[str, np.ndarray]:
+        """Reconstruct one chunk's full views; single-slot cache, safe for
+        concurrent decoders (store/decode.py decode_all_parallel) — a
+        caller evicted mid-read keeps valid references to the old arrays.
+        scores=False skips the raw/final assembly (codes-only consumers
+        like the preemption fit oracle never pay the normalize mirror)."""
+        with self._recon_lock:
+            return self._chunk_recon_locked(ci, scores)
+
+    def _chunk_recon_locked(self, ci: int, scores: bool) -> dict[str, np.ndarray]:
+        d = self._recon if self._recon_ci == ci else None
+        if d is not None and (not scores or "raw" in d):
+            return d
+        from . import hostnorm
+        from .pipeline import PACK_MODES
+
+        cc = self._compact
+        if d is None:
+            packed = np.asarray(cc.packed[ci])
+            c, n = packed.shape
+            f = len(self.cw.config.filters())
+            _, code_bits, ff_bits, has_ign = PACK_MODES[cc.pack_mode]
+            p_int = packed.astype(np.int64)
+            code = p_int & ((1 << code_bits) - 1)
+            ffp = (p_int >> code_bits) & ((1 << ff_bits) - 1)  # 0 == all pass
+            codes = np.zeros((c, f, n), np.int32)
+            if f:
+                idx = np.clip(ffp - 1, 0, f - 1)[:, None, :]
+                np.put_along_axis(codes, idx, np.where(ffp > 0, code, 0)[:, None, :], axis=1)
+            feasible = ffp == 0
+            if has_ign:
+                ignored = ((p_int >> (code_bits + ff_bits)) & 1).astype(bool)
+            else:
+                ignored = np.zeros((c, n), bool)
+            d = {"codes": codes, "feasible": feasible, "ignored": ignored}
+            self._recon_ci, self._recon = ci, d
+        if scores:
+            c, n = d["feasible"].shape
+            raw = np.empty((c, len(cc.score_cols), n), np.int64)
+            for s, (group, row) in enumerate(cc.score_cols):
+                raw[:, s, :] = getattr(cc, group)[ci][:, row, :]
+            d["raw"] = raw
+            d["final"] = hostnorm.finalize_chunk(
+                self.cw, raw, d["feasible"], d["ignored"], ci * cc.chunk)
+        return d
+
+    def _materialize(self) -> None:
+        """Fill the whole-array caches in ONE pass over the chunks (the
+        reconstruction computes every field anyway)."""
+        cc = self._compact
+        p = self.cw.n_pods
+        n = self.cw.n_nodes
+        if cc is None or not cc.packed:
+            self._filter_codes = np.zeros((0, len(self.cw.config.filters()), n), np.int32)
+            self._score_raw = np.zeros((0, len(self.cw.config.scorers()), n), np.int64)
+            self._score_final = np.zeros((0, len(self.cw.config.scorers()), n), np.int64)
+            return
+        pieces = {"codes": [], "raw": [], "final": []}
+        for ci in range(len(cc.packed)):
+            d = self._chunk_recon(ci, scores=True)
+            for k in pieces:
+                pieces[k].append(d[k])
+        self._filter_codes = np.concatenate(pieces["codes"], axis=0)[:p]
+        self._score_raw = np.concatenate(pieces["raw"], axis=0)[:p]
+        self._score_final = np.concatenate(pieces["final"], axis=0)[:p]
+
+    # legacy whole-array views (tests / small workloads); raw/final are
+    # int64 on the compact path (the engine's host-interleaved path stores
+    # whatever its per-pod StepOuts held — int32)
+    @property
+    def filter_codes(self) -> np.ndarray:  # [P, F, N]
+        if self._filter_codes is None:
+            self._materialize()
+        return self._filter_codes
+
+    @property
+    def score_raw(self) -> np.ndarray:     # [P, S, N]
+        if self._score_raw is None:
+            self._materialize()
+        return self._score_raw
+
+    @property
+    def score_final(self) -> np.ndarray:   # [P, S, N]
+        if self._score_final is None:
+            self._materialize()
+        return self._score_final
 
 
 def _slice_xs(xs: dict[str, Any], lo: int, hi: int, pad_to: int) -> dict[str, Any]:
@@ -67,16 +233,19 @@ def _slice_xs(xs: dict[str, Any], lo: int, hi: int, pad_to: int) -> dict[str, An
 # shapes.  The key therefore hashes the statics CONTENT (the step closure
 # bakes them in as constants) plus the xs/carry shape signature and the
 # plugin-set signature; any mismatch falls through to a fresh compile.
+# The statics fingerprint is computed once per CompiledWorkload (cached in
+# cw.host), not on every replay() call.
 _SCAN_CACHE: dict = {}
 _SCAN_CACHE_MAX = 64
 
 
-def _workload_scan_key(cw: CompiledWorkload, chunk: int, mesh=None):
+def _statics_fingerprint(cw: CompiledWorkload) -> str:
+    fp = cw.host.get("_statics_fp")
+    if fp is not None:
+        return fp
     import hashlib
 
     h = hashlib.sha1()
-    if mesh is not None:
-        h.update(repr(tuple(mesh.shape.items())).encode())
     for name in sorted(cw.statics):
         h.update(name.encode())
         for leaf in jax.tree.leaves(cw.statics[name]):
@@ -84,13 +253,20 @@ def _workload_scan_key(cw: CompiledWorkload, chunk: int, mesh=None):
             h.update(str(a.shape).encode())
             h.update(str(a.dtype).encode())
             h.update(a.tobytes())
+    fp = h.hexdigest()
+    cw.host["_statics_fp"] = fp
+    return fp
+
+
+def _workload_scan_key(cw: CompiledWorkload, chunk: int, mesh=None):
+    import json
+
+    mesh_sig = tuple(mesh.shape.items()) if mesh is not None else None
     shapes = tuple(
         (path_leaf[0].__str__(), tuple(np.shape(path_leaf[1])), str(np.asarray(path_leaf[1]).dtype))
         for tree in (cw.xs, cw.init_carry)
         for path_leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
     )
-    import json
-
     cfg = cw.config
     cfg_sig = (
         tuple(cfg.enabled),
@@ -99,7 +275,7 @@ def _workload_scan_key(cw: CompiledWorkload, chunk: int, mesh=None):
         json.dumps(cfg.args, sort_keys=True, default=str),
         tuple(cw.schema.columns),
     )
-    return (h.hexdigest(), shapes, cfg_sig, chunk)
+    return (_statics_fingerprint(cw), mesh_sig, shapes, cfg_sig, chunk)
 
 
 class _SlimWorkload:
@@ -115,11 +291,16 @@ class _SlimWorkload:
         self.schema = cw.schema
 
 
-def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1, mesh=None):
-    key = (*_workload_scan_key(cw, chunk, mesh), unroll)
+def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1, mesh=None,
+              pack_mode: str = "p16", score_dtypes: tuple = (),
+              wide: bool = False):
+    key = (*_workload_scan_key(cw, chunk, mesh), unroll, "compact", pack_mode,
+           score_dtypes, wide)
     scan_jit = _SCAN_CACHE.get(key)
     if scan_jit is None:
-        step = build_step(_SlimWorkload(cw))
+        step = build_step(_SlimWorkload(cw), out_mode="compact",
+                          pack_mode=pack_mode, score_dtypes=score_dtypes,
+                          wide_raw=wide)
 
         def scan_chunk(carry, xs_chunk):
             return jax.lax.scan(step, carry, xs_chunk, unroll=unroll)
@@ -129,6 +310,14 @@ def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1, mesh=None):
             _SCAN_CACHE.pop(next(iter(_SCAN_CACHE)))
         _SCAN_CACHE[key] = scan_jit
     return scan_jit
+
+
+def _fetch_chunk(out) -> dict[str, np.ndarray]:
+    """Blocking D2H of one chunk's outputs (runs on a fetch thread so the
+    transfer overlaps later chunks' device compute — the copy starts the
+    moment the chunk's results exist, and np.asarray releases the GIL
+    while it waits on the tunnel)."""
+    return {f: np.asarray(getattr(out, f)) for f in out._fields}
 
 
 def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
@@ -164,46 +353,95 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
                     "scan cannot run it — schedule through the engine (it "
                     "routes to the host-interleaved path) or use "
                     "build_phased directly")
+    result = _replay_run(cw, chunk, collect, unroll, mesh, wide=False)
+    if result is None:  # some raw score overflowed int16: rerun widened
+        result = _replay_run(cw, chunk, collect, unroll, mesh, wide=True)
+    return result
+
+
+def _compact_plan(cw: CompiledWorkload, wide: bool):
+    """(pack_mode, score_dtypes, score_cols) for this workload."""
+    from .pipeline import choose_pack_mode
+
+    pack_mode = choose_pack_mode(
+        cw.host.get("max_filter_code", 1 << 62),
+        len(cw.config.filters()),
+        tsp_on="PodTopologySpread" in cw.config.scorers(),
+    )
+    score_dtypes = cw.host.get(
+        "score_dtypes", tuple("i16" for _ in cw.config.scorers()))
+    counts = {"i8": 0, "i16": 0, "i32": 0}
+    cols = []
+    for g in score_dtypes:
+        g = "i32" if wide else g
+        cols.append(({"i8": "raw8", "i16": "raw16", "i32": "raw32"}[g], counts[g]))
+        counts[g] += 1
+    return pack_mode, score_dtypes, tuple(cols)
+
+
+def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
+                mesh, wide: bool) -> ReplayResult | None:
     p = cw.n_pods
     chunk = min(chunk, max(p, 1))
-    scan_jit = _scan_for(cw, chunk, unroll, mesh)
+    pack_mode, score_dtypes, score_cols = _compact_plan(cw, wide)
+    scan_jit = _scan_for(cw, chunk, unroll, mesh, pack_mode=pack_mode,
+                         score_dtypes=score_dtypes, wide=wide)
 
     # copy: the scan donates its carry argument, and cw.init_carry must
     # survive for subsequent replays of the same compiled workload
     carry = jax.tree.map(jnp.array, cw.init_carry)
-    outs: list[StepOut] = []
-    for lo in range(0, p, chunk):
-        hi = min(lo + chunk, p)
-        xs_chunk = _slice_xs(cw.xs, lo, hi, chunk)
-        xs_chunk["is_pad"] = (jnp.arange(chunk) >= (hi - lo))
-        carry, out = scan_jit(carry, xs_chunk)
-        if not collect:
-            out = StepOut(
-                filter_codes=out.filter_codes[:0],
-                score_raw=out.score_raw[:0],
-                score_final=out.score_final[:0],
-                selected=out.selected,
-                feasible_count=out.feasible_count,
-                prefilter_reject=out.prefilter_reject,
-            )
-        outs.append(out)
+    from concurrent.futures import ThreadPoolExecutor
 
-    n = cw.n_nodes
-    n_f = len(cw.config.filters())
-    n_s = len(cw.config.scorers())
+    futures = []
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        for lo in range(0, p, chunk):
+            hi = min(lo + chunk, p)
+            xs_chunk = _slice_xs(cw.xs, lo, hi, chunk)
+            xs_chunk["is_pad"] = (jnp.arange(chunk) >= (hi - lo))
+            carry, out = scan_jit(carry, xs_chunk)
+            if collect:
+                # dispatch returns immediately; a fetch thread blocks on
+                # this chunk's transfer while the device runs later chunks
+                futures.append(pool.submit(_fetch_chunk, out))
+            else:
+                futures.append(out)
+        if collect:
+            chunks = [f.result() for f in futures]
+        else:
+            chunks = [
+                {f: np.asarray(getattr(o, f))
+                 for f in ("selected", "feasible_count", "prefilter_reject")}
+                for o in futures
+            ]
 
-    def cat(field: str, empty_shape: tuple) -> np.ndarray:
-        pieces = [np.asarray(getattr(o, field)) for o in outs]
+    def cat(field: str) -> np.ndarray:
+        pieces = [c[field] for c in chunks]
         if not pieces:
-            return np.zeros(empty_shape, dtype=np.int32)
+            return np.zeros((0,), dtype=np.int32)
         return np.concatenate(pieces, axis=0)[:p]
 
+    selected = cat("selected")
+    feasible_count = cat("feasible_count")
+    prefilter_reject = cat("prefilter_reject")
+    if not collect:
+        return ReplayResult(
+            cw=cw, selected=selected, feasible_count=feasible_count,
+            prefilter_reject=prefilter_reject,
+        )
+
+    if not wide and any(c["raw_overflow"].any() for c in chunks):
+        return None  # caller reruns with int32 raw outputs
+
+    compact = _CompactChunks(
+        packed=[c["packed_filter"] for c in chunks],
+        raw8=[c["raw8"] for c in chunks],
+        raw16=[c["raw16"] for c in chunks],
+        raw32=[c["raw32"] for c in chunks],
+        chunk=chunk,
+        pack_mode=pack_mode,
+        score_cols=score_cols,
+    )
     return ReplayResult(
-        cw=cw,
-        filter_codes=cat("filter_codes", (0, n_f, n)),
-        score_raw=cat("score_raw", (0, n_s, n)),
-        score_final=cat("score_final", (0, n_s, n)),
-        selected=cat("selected", (0,)),
-        feasible_count=cat("feasible_count", (0,)),
-        prefilter_reject=cat("prefilter_reject", (0,)),
+        cw=cw, selected=selected, feasible_count=feasible_count,
+        prefilter_reject=prefilter_reject, compact=compact,
     )

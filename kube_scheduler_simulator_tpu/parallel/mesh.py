@@ -88,10 +88,12 @@ def shard_workload(cw: CompiledWorkload, mesh: Mesh) -> CompiledWorkload:
 
         return f
 
-    cw.statics = jax.tree.map(place(False), cw.statics)
-    cw.xs = jax.tree.map(place(True), cw.xs)
-    cw.init_carry = jax.tree.map(place(False), cw.init_carry)
-    return cw
+    return dataclasses.replace(
+        cw,
+        statics=jax.tree.map(place(False), cw.statics),
+        xs=jax.tree.map(place(True), cw.xs),
+        init_carry=jax.tree.map(place(False), cw.init_carry),
+    )
 
 
 def sharded_step(cw: CompiledWorkload, mesh: Mesh | None = None):

@@ -1,0 +1,239 @@
+"""16-way-parallel CPU baseline: the upstream Parallelizer model.
+
+The real reference runs the per-node Filter/Score loops fanned across 16
+goroutines (upstream k8s.io/kubernetes Parallelizer, default parallelism
+16 — SURVEY.md §6; config surface KubeSchedulerConfiguration.Parallelism).
+A single-threaded Python oracle therefore under-states the CPU baseline.
+This module parallelizes the SequentialScheduler's node loops across
+worker PROCESSES (CPython threads would serialize on the GIL, which would
+be a strawman in the other direction), keeping everything else —
+normalization, host selection, bind bookkeeping, annotation marshalling —
+on the master, exactly where upstream keeps it (scheduleOne runs
+selectHost and the binding cycle on one goroutine).
+
+Design: each worker holds a full SequentialScheduler replica and evaluates
+only its node slice [lo, hi); per cycle the master broadcasts the pod
+index, gathers each slice's (filter entries, feasible set, raw scores),
+merges, normalizes, selects, and broadcasts the bind so every replica's
+dynamic state (requested resources, topology counts, assigned pods) stays
+in lock-step.  Output is asserted identical to SequentialScheduler by
+tests/test_parallel_oracle.py.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+from . import sequential as seq_mod
+from .sequential import SequentialScheduler
+from ..state.resources import pod_resource_request
+from ..store import annotations as ann
+
+MAX_NODE_SCORE = seq_mod.MAX_NODE_SCORE
+
+DEFAULT_PARALLELISM = 16  # upstream parallelism default
+
+
+def _worker_main(conn, nodes, pods, config, bound_pods, volumes, lo, hi):
+    seq = SequentialScheduler(nodes, pods, config, bound_pods=bound_pods,
+                              volumes=volumes)
+    while True:
+        msg = conn.recv()
+        op = msg[0]
+        if op == "eval":
+            _, i, active, scorer_names = msg
+            pod = pods[i]
+            seq._cycle = {}
+            req, nz = pod_resource_request(pod, seq.schema)
+            entries: dict[int, dict[str, str]] = {}
+            feasible: list[int] = []
+            for j in range(lo, hi):
+                entry: dict[str, str] = {}
+                ok = True
+                for name in active:
+                    m = seq._filter(name, pod, req, j)
+                    if m is None:
+                        entry[name] = ann.PASSED_FILTER_MESSAGE
+                    else:
+                        entry[name] = m
+                        ok = False
+                        break
+                if entry:
+                    entries[j] = entry
+                if ok:
+                    feasible.append(j)
+            conn.send((entries, feasible))
+        elif op == "score":
+            _, i, scorer_names, feasible = msg
+            pod = pods[i]
+            req, nz = pod_resource_request(pod, seq.schema)
+            mine = [j for j in feasible if lo <= j < hi]
+            raws = {
+                name: {j: seq._score(name, pod, req, nz, j) for j in mine}
+                for name in scorer_names
+            }
+            conn.send(raws)
+        elif op == "bind":
+            _, i, selected = msg
+            _apply_bind(seq, pods[i], selected)
+        elif op == "stop":
+            conn.close()
+            return
+
+
+def _apply_bind(seq: SequentialScheduler, pod, selected: int) -> None:
+    """The bind section of SequentialScheduler.schedule_one, replayed on a
+    replica so its dynamic state tracks the master's."""
+    req, nz = pod_resource_request(pod, seq.schema)
+    seq.requested[selected] = seq.requested[selected] + req
+    seq.nonzero[selected][0] += int(nz[0])
+    seq.nonzero[selected][1] += int(nz[1])
+    seq.num_pods[selected] += 1
+    seq.assigned.append((pod, selected))
+    if "VolumeBinding" in seq.config.enabled and seq._pod_pvcs(pod):
+        seq._vb_bind(pod, selected)
+
+
+class ParallelScheduler:
+    """Drop-in for SequentialScheduler.schedule_all with the node loops
+    fanned over `parallelism` worker processes."""
+
+    def __init__(self, nodes, pods, config=None, bound_pods=None, volumes=None,
+                 parallelism: int = DEFAULT_PARALLELISM):
+        self.master = SequentialScheduler(nodes, pods, config,
+                                          bound_pods=bound_pods, volumes=volumes)
+        if self.master.config.custom:
+            raise ValueError("parallel oracle does not support custom plugins "
+                             "(worker processes cannot pickle them reliably)")
+        self.pods = pods
+        n = self.master.n
+        workers = max(1, min(parallelism, n, os.cpu_count() or parallelism))
+        bounds = [round(k * n / workers) for k in range(workers + 1)]
+        ctx = mp.get_context("fork")
+        self._conns = []
+        self._procs = []
+        for k in range(workers):
+            parent, child = ctx.Pipe()
+            proc = ctx.Process(
+                target=_worker_main,
+                args=(child, nodes, pods, self.master.config, bound_pods,
+                      volumes, bounds[k], bounds[k + 1]),
+                daemon=True,
+            )
+            proc.start()
+            child.close()
+            self._conns.append(parent)
+            self._procs.append(proc)
+
+    def close(self):
+        for c in self._conns:
+            try:
+                c.send(("stop",))
+                c.close()
+            except (BrokenPipeError, OSError):
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+        self._conns, self._procs = [], []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------ cycle
+
+    def schedule_one(self, pod_idx: int):
+        m = self.master
+        pod = self.pods[pod_idx]
+        cfg = m.config
+        m._cycle = {}
+        req, nz = pod_resource_request(pod, m.schema)
+
+        reject = m._prefilter_reject(pod)
+        if reject is not None:
+            # delegate the (cheap, node-loop-free) reject path wholesale
+            return m.schedule_one(pod)
+
+        prefilter_status = {
+            name: ("" if m._filter_skip(name, pod) else ann.SUCCESS_MESSAGE)
+            for name in cfg.prefilters()
+        }
+        active = [n for n in cfg.filters() if not m._filter_skip(n, pod)]
+        scorer_names = [n for n in cfg.scorers() if not m._score_skip(n, pod)]
+
+        for c in self._conns:
+            c.send(("eval", pod_idx, active, scorer_names))
+        filter_map: dict[str, dict[str, str]] = {}
+        feasible: list[int] = []
+        for c in self._conns:
+            entries, feas = c.recv()
+            for j, entry in entries.items():
+                filter_map[m.names[j]] = entry
+            feasible.extend(feas)
+        feasible.sort()
+
+        prescore: dict[str, str] = {}
+        score_map: dict[str, dict[str, str]] = {}
+        final_map: dict[str, dict[str, str]] = {}
+        selected = -1
+        if len(feasible) == 1:
+            selected = feasible[0]
+        elif len(feasible) > 1:
+            for name in cfg.prescorers():
+                prescore[name] = "" if m._score_skip(name, pod) else ann.SUCCESS_MESSAGE
+            for c in self._conns:
+                c.send(("score", pod_idx, scorer_names, feasible))
+            merged: dict[str, dict[int, int]] = {name: {} for name in scorer_names}
+            for c in self._conns:
+                raws = c.recv()
+                for name, d in raws.items():
+                    merged[name].update(d)
+            totals = {j: 0 for j in feasible}
+            for name in scorer_names:
+                raw = merged[name]
+                normed = m._normalize(name, raw, pod)
+                w = cfg.weight(name)
+                for j in feasible:
+                    score_map.setdefault(m.names[j], {})[name] = str(raw[j])
+                    final = normed[j] * w
+                    final_map.setdefault(m.names[j], {})[name] = str(final)
+                    totals[j] += final
+            best = max(totals.values())
+            selected = min(j for j, t in totals.items() if t == best)
+
+        if selected >= 0:
+            _apply_bind(m, pod, selected)
+            for c in self._conns:
+                c.send(("bind", pod_idx, selected))
+
+        vb_on = ("VolumeBinding" in cfg.enabled and not cfg.is_custom("VolumeBinding"))
+        reserve_map = (
+            {"VolumeBinding": ann.SUCCESS_MESSAGE} if selected >= 0 and vb_on else {}
+        )
+        annotations = {
+            ann.PRE_FILTER_STATUS_RESULT: ann.marshal(prefilter_status),
+            ann.PRE_FILTER_RESULT: ann.marshal({}),
+            ann.FILTER_RESULT: ann.marshal(filter_map),
+            ann.POST_FILTER_RESULT: ann.marshal({}),
+            ann.PRE_SCORE_RESULT: ann.marshal(prescore),
+            ann.SCORE_RESULT: ann.marshal(score_map),
+            ann.FINAL_SCORE_RESULT: ann.marshal(final_map),
+            ann.RESERVE_RESULT: ann.marshal(reserve_map),
+            ann.PERMIT_STATUS_RESULT: ann.marshal({}),
+            ann.PERMIT_TIMEOUT_RESULT: ann.marshal({}),
+            ann.PRE_BIND_RESULT: ann.marshal(reserve_map),
+            ann.BIND_RESULT: ann.marshal(
+                {"DefaultBinder": ann.SUCCESS_MESSAGE} if selected >= 0 else {}
+            ),
+            ann.SELECTED_NODE: m.names[selected] if selected >= 0 else "",
+        }
+        return annotations, selected
+
+    def schedule_all(self):
+        try:
+            return [self.schedule_one(i) for i in range(len(self.pods))]
+        finally:
+            self.close()

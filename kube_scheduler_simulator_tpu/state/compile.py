@@ -319,3 +319,55 @@ def _collect_host_flags(cw: CompiledWorkload):
         )
     cw.host["filter_skip"] = skips_filter
     cw.host["score_skip"] = skips_score
+    cw.host["max_filter_code"] = _max_filter_code(cw)
+    cw.host["score_dtypes"] = tuple(
+        _score_dtype(cw, name) for name in cw.config.scorers()
+    )
+
+
+# static per-plugin bound on the filter codes each kernel can emit — lets
+# the replay pick the uint16 first-fail packing (framework/pipeline.py
+# pack_filter_codes) when every code fits a byte
+_FILTER_CODE_BOUNDS = {
+    "NodeAffinity": 1, "NodeUnschedulable": 1, "NodeName": 1, "NodePorts": 1,
+    "VolumeRestrictions": 1, "NodeVolumeLimits": 1, "VolumeZone": 1,
+    "InterPodAffinity": 3, "VolumeBinding": 7,
+}
+
+
+# raw scores provably bounded by framework.MaxNodeScore (100): these
+# plugins score in [0, 100] by construction, so their raws transfer as int8
+# in the compact replay without a runtime overflow check
+_SCORE_I8_SAFE = frozenset({
+    "NodeResourcesFit", "NodeResourcesBalancedAllocation", "ImageLocality",
+    "VolumeBinding",
+})
+
+
+def _score_dtype(cw: CompiledWorkload, name: str) -> str:
+    if name in _SCORE_I8_SAFE:
+        return "i8"
+    if name == "TaintToleration":
+        # raw = count of intolerable PreferNoSchedule taints on the node
+        if max((len(t) for t in cw.node_table.taints), default=0) <= 127:
+            return "i8"
+    return "i16"
+
+
+def _max_filter_code(cw: CompiledWorkload) -> int:
+    bound = 0
+    for name in cw.config.filters():
+        if name == "NodeResourcesFit":
+            b = (1 << (cw.schema.n + 1)) - 1
+        elif name == "TaintToleration":
+            b = max((len(t) for t in cw.node_table.taints), default=0)
+        elif name == "PodTopologySpread":
+            b = 2 * topologyspread.MAX_CONSTRAINTS
+        elif name in _FILTER_CODE_BOUNDS:
+            b = _FILTER_CODE_BOUNDS[name]
+        elif name in cw.host.get("custom_msgs", {}):
+            b = len(cw.host["custom_msgs"][name])
+        else:
+            b = 1 << 30  # unknown plugin: force wide packing
+        bound = max(bound, b)
+    return bound

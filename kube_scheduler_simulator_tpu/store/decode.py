@@ -142,7 +142,7 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None,
     active = [
         (f, name) for f, name in enumerate(filter_names) if not fskip[name][hi]
     ]
-    codes = rr.filter_codes[i]  # [F, N]
+    codes = rr.codes_of(i)  # [F, N]
 
     native_ctx = _native_ctx(cw)
     filter_json: str | None = None
@@ -175,11 +175,13 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None,
     if feasible_count > 1:
         for name in cfg.prescorers():
             prescore[name] = "" if sskip[name][hi] else ann.SUCCESS_MESSAGE
-        feasible = (codes[[f for f, _ in active], :] == 0).all(axis=0) if active else None
+        feasible = rr.feasible_of(i)
+        if feasible is None:
+            feasible = (codes[[f for f, _ in active], :] == 0).all(axis=0) if active else None
         if feasible_override is not None:
             feasible = feasible_override
-        raw = rr.score_raw[i]
-        fin = rr.score_final[i]
+        raw = rr.raw_of(i)
+        fin = rr.final_of(i)
         if native_ctx is not None:
             from . import native_decode
 
@@ -237,3 +239,33 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None,
 
 def decode_all(rr: ReplayResult) -> list[dict[str, str]]:
     return [decode_pod_result(rr, i) for i in range(rr.cw.n_pods)]
+
+
+def decode_all_parallel(rr: ReplayResult, n: int | None = None,
+                        workers: int = 8) -> list[dict[str, str]]:
+    """Decode pods 0..n across a thread pool, chunk by chunk.
+
+    The native codec runs outside the GIL (ctypes releases it for the C
+    call), so threads give real parallelism on the JSON encoding — the
+    dominant cost at cluster scale.  Chunks are reconstructed on the main
+    thread first so the workers share one cached reconstruction instead of
+    thrashing ReplayResult's single-slot cache.  Falls back to the serial
+    loop when the ReplayResult holds full arrays (host path) or the
+    workload is small."""
+    if n is None:
+        n = rr.cw.n_pods
+    cc = getattr(rr, "_compact", None)
+    if cc is None or n < 64:
+        return [decode_pod_result(rr, i) for i in range(n)]
+    from concurrent.futures import ThreadPoolExecutor
+
+    out: list = [None] * n
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        for lo in range(0, n, cc.chunk):
+            hi = min(lo + cc.chunk, n)
+            rr._chunk_recon(lo // cc.chunk, scores=True)  # warm once, here
+            for i, a in zip(range(lo, hi),
+                            pool.map(lambda i: decode_pod_result(rr, i),
+                                     range(lo, hi))):
+                out[i] = a
+    return out

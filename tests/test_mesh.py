@@ -41,7 +41,7 @@ def test_sharded_step_matches_unsharded():
 
     cw = compile_workload(nodes, pods, cfg)
     mesh = make_mesh(8, dp=1)  # all 8 virtual devices on the node axis
-    shard_workload(cw, mesh)
+    cw = shard_workload(cw, mesh)
     step = sharded_step(cw, mesh)
     assert _scan_selections(cw, step) == base_sel
 
@@ -53,7 +53,7 @@ def test_sharded_dp_mesh_matches_unsharded():
 
     cw = compile_workload(nodes, pods, cfg)
     mesh = make_mesh(8, dp=2)  # 2-way speculative batch x 4-way node shard
-    shard_workload(cw, mesh)
+    cw = shard_workload(cw, mesh)
     step = sharded_step(cw, mesh)
     assert _scan_selections(cw, step) == base_sel
 

@@ -1,0 +1,33 @@
+"""The parallel CPU baseline must reproduce the sequential oracle exactly
+(same upstream semantics, node loops fanned across worker processes —
+upstream's 16-goroutine Parallelizer model, SURVEY.md §6)."""
+
+import pytest
+
+from kube_scheduler_simulator_tpu.models.workloads import baseline_config
+from kube_scheduler_simulator_tpu.reference_impl.parallel import ParallelScheduler
+from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+
+
+@pytest.mark.parametrize("idx", [1, 2, 3, 4, 5])
+def test_parallel_matches_sequential(idx):
+    nodes, pods, cfg = baseline_config(idx, scale=0.01, seed=7)
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    par = ParallelScheduler(nodes, pods, cfg, parallelism=4).schedule_all()
+    assert len(seq) == len(par)
+    for i, ((sa, ssel), (pa, psel)) in enumerate(zip(seq, par)):
+        assert ssel == psel, f"pod {i}: selected {psel} != {ssel}"
+        assert sa == pa, f"pod {i}: annotations differ"
+
+
+def test_parallel_rejects_custom_plugins():
+    from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    class P(CustomPlugin):
+        name = "X"
+
+    nodes, pods, _ = baseline_config(1, scale=0.01, seed=0)
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit", "X"], custom={"X": P()})
+    with pytest.raises(ValueError):
+        ParallelScheduler(nodes, pods, cfg)
