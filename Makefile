@@ -1,0 +1,26 @@
+# kube-scheduler-simulator_tpu build/test entry points.
+#
+# The framework is pure Python + JAX except the native annotation codec
+# (kube_scheduler_simulator_tpu/native/annotation_codec.cpp), which the
+# loader also auto-builds on first use; `make codec` is the explicit
+# recipe.
+
+PY ?= python
+
+.PHONY: codec test bench smoke clean
+
+codec:
+	$(PY) -c "from kube_scheduler_simulator_tpu.native import build_codec; print(build_codec())"
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+smoke:
+	$(PY) bench.py --smoke
+
+clean:
+	rm -f kube_scheduler_simulator_tpu/native/_annotation_codec.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

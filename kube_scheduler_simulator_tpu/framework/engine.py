@@ -68,6 +68,14 @@ class SchedulerEngine:
         # pods parked by Permit "wait" (upstream waitingPods map analogue),
         # keyed (namespace, name); external threads may allow()/reject()
         self.waiting_pods: dict[tuple[str, str], "WaitingPod"] = {}
+        # async waiter bookkeeping: one daemon thread per parked pod
+        # finishes its binding cycle on resolution (upstream's binding
+        # cycle goroutine blocking in WaitOnPermit)
+        import threading
+
+        self._wait_threads: list = []
+        self._waiter_lock = threading.Lock()
+        self._waiter_results: list[tuple[str, str, str]] = []
 
     def set_plugin_config(self, cfg: PluginSetConfig) -> None:
         """Legacy single-profile API: one plugin set for every pod.
@@ -135,9 +143,29 @@ class SchedulerEngine:
 
     # ------------------------------------------------------------ run
 
+    def _drain_waiters(self) -> tuple[int, set[tuple[str, str]]]:
+        """Join all Permit waiter threads; -> (#bound, rejected keys)."""
+        while True:
+            with self._waiter_lock:
+                threads, self._wait_threads = self._wait_threads, []
+            if not threads:
+                break
+            for t in threads:
+                t.join()
+        with self._waiter_lock:
+            results, self._waiter_results = self._waiter_results, []
+        bound = sum(1 for kind, _, _ in results if kind == "bound")
+        rejected = {(ns, name) for kind, ns, name in results if kind == "rejected"}
+        return bound, rejected
+
     def pending_pods(self) -> list[dict]:
         pods, _ = self.store.list("pods")
-        pending = [p for p in pods if not ((p.get("spec") or {}).get("nodeName"))]
+        pending = [
+            p for p in pods
+            if not ((p.get("spec") or {}).get("nodeName"))
+            and ((p.get("metadata") or {}).get("namespace") or "default",
+                 (p.get("metadata") or {}).get("name", "")) not in self.waiting_pods
+        ]
         # PrioritySort: priority desc, FIFO (creation resourceVersion) within
         pending.sort(
             key=lambda p: (
@@ -151,7 +179,12 @@ class SchedulerEngine:
         """One scheduling wave over all pending pods (plus retry waves for
         pods unblocked by preemption, and re-runs after a custom
         Reserve/Permit/PreBind rejected a speculative placement). Returns
-        #bound."""
+        #bound.
+
+        Pods parked by Permit "wait" do NOT stall the wave: their binding
+        cycle finishes on a waiter thread when allowed/rejected/timed out
+        (upstream runs binding cycles as goroutines), and this call drains
+        all waiters before returning so the result is settled."""
         n_bound = 0
         rejected: set[tuple[str, str]] = set()
         max_waves = 8 + len(self.pending_pods())
@@ -162,6 +195,19 @@ class SchedulerEngine:
             TRACER.count("scheduling_waves_total")
             if retry == "preempted":
                 TRACER.count("preemption_waves_total")
+            # drain Permit waiters after EVERY wave (not just the last):
+            # a retry wave must never observe a half-resolved waiter —
+            # pending_pods would re-schedule a pod whose waiter thread is
+            # mid-bind
+            waiter_bound, waiter_rejected = self._drain_waiters()
+            n_bound += waiter_bound
+            TRACER.count("pods_scheduled_total", waiter_bound)
+            if waiter_rejected:
+                # like a sync lifecycle rejection: re-run without them
+                # (they keep their recorded rejection; upstream would
+                # re-queue, which the next schedule_pending call does)
+                rejected |= waiter_rejected
+                continue
             if not retry:
                 break
         # count unschedulable once per pass, not per retry wave (pods
@@ -268,9 +314,13 @@ class SchedulerEngine:
             "storageclasses": self.store.list("storageclasses")[0],
         }
         with TRACER.span("compile_workload", pods=len(pending), nodes=len(nodes)):
+            from ..state.compile import NodeTableReuse
+
             cw = compile_workload(
-                nodes, pending, self.plugin_config, bound_pods=bound, volumes=volumes
+                nodes, pending, self.plugin_config, bound_pods=bound,
+                volumes=volumes, reuse=getattr(self, "_last_cw", None),
             )
+            self._last_cw = NodeTableReuse(cw)
         if self._needs_host_path():
             return self._schedule_host_path(cw, pending)
 
@@ -299,18 +349,28 @@ class SchedulerEngine:
                 for hook in self._extenders_map().values():
                     hook.after_cycle(pod, annotations, self.result_store)
                 sel = int(rr.selected[i])
-                if sel >= 0 and not self._run_custom_lifecycle(
-                        pod, ns, name, cw.node_table.names[sel]):
-                    # a custom Reserve/Permit/PreBind rejected, but the
-                    # device replay already folded this pod into the carry;
-                    # abandon the rest of the wave and re-run it without
-                    # this pod so later pods see true (unbound) state
-                    self._mark_unschedulable(ns, name)
-                    self.reflector.reflect(ns, name)
-                    if exclude is not None:
-                        exclude.add((ns, name))
-                    return n_bound, "rejected"
                 if sel >= 0:
+                    lc = self._run_custom_lifecycle(
+                        pod, ns, name, cw.node_table.names[sel],
+                        allow_async=True)
+                    if lc == "deferred":
+                        # Permit "wait" parked the pod; its waiter thread
+                        # finishes the binding cycle + reflect.  The carry
+                        # already holds the speculative bind — exactly the
+                        # assumed-pod state upstream exposes while a pod
+                        # waits in WaitOnPermit — so the wave continues
+                        continue
+                    if not lc:
+                        # a custom Reserve/Permit/PreBind rejected, but the
+                        # device replay already folded this pod into the
+                        # carry; abandon the rest of the wave and re-run it
+                        # without this pod so later pods see true (unbound)
+                        # state
+                        self._mark_unschedulable(ns, name)
+                        self.reflector.reflect(ns, name)
+                        if exclude is not None:
+                            exclude.add((ns, name))
+                        return n_bound, "rejected"
                     self._bind(ns, name, cw.node_table.names[sel])
                     self._run_custom_postbind(pod, cw.node_table.names[sel])
                     n_bound += 1
@@ -333,7 +393,8 @@ class SchedulerEngine:
             if n in self.plugin_config.enabled and getattr(p, "has_lifecycle", False)
         ]
 
-    def _run_custom_lifecycle(self, pod, ns: str, name: str, node_name: str) -> bool:
+    def _run_custom_lifecycle(self, pod, ns: str, name: str, node_name: str,
+                              allow_async: bool = False):
         """Reserve -> Permit -> PreBind -> (caller binds) -> PostBind for
         custom plugins, upstream phase ordering (all Reserves, then all
         Permits, then all PreBinds; Unreserve runs for ALL reserve plugins
@@ -343,10 +404,15 @@ class SchedulerEngine:
 
         A Permit "wait" parks the pod in self.waiting_pods with the
         plugin's timeout (upstream waitingPods map); the plugin's optional
-        on_waiting(handle) is invoked, then the engine blocks until every
-        waiting plugin allowed, one rejected, or the timeout expired
+        on_waiting(handle) is invoked.  With allow_async (the batched wave
+        path) the method returns "deferred" and a waiter thread finishes
+        the binding cycle — PreBind, bind, PostBind, reflect — once every
+        waiting plugin allowed, one rejected, or the timeout expired; the
+        wave continues scheduling other pods meanwhile, like upstream's
+        per-pod binding-cycle goroutines blocking in WaitOnPermit
         (reference: wrappedplugin.go:588-620 + upstream
-        runtime/waiting_pods_map.go)."""
+        runtime/waiting_pods_map.go).  Without allow_async the call blocks
+        until resolution (host-interleaved path)."""
         plugins = self._custom_lifecycle_plugins()
         if not plugins:
             return True
@@ -418,11 +484,24 @@ class SchedulerEngine:
                     timeouts[p.name] = 0.0
             wp = WaitingPod(pod, timeouts)
             self.waiting_pods[(ns, name)] = wp
+            for p, _ in waits:
+                on_waiting = getattr(p, "on_waiting", None)
+                if callable(on_waiting):
+                    on_waiting(wp)
+            if allow_async:
+                import threading
+
+                t = threading.Thread(
+                    target=self._waiter_finish,
+                    args=(wp, waits, pod, ns, name, node_name, node, plugins,
+                          emap, unreserve_all),
+                    daemon=True,
+                )
+                with self._waiter_lock:
+                    self._wait_threads.append(t)
+                t.start()
+                return "deferred"
             try:
-                for p, _ in waits:
-                    on_waiting = getattr(p, "on_waiting", None)
-                    if callable(on_waiting):
-                        on_waiting(wp)
                 rejection = wp.wait()
             finally:
                 self.waiting_pods.pop((ns, name), None)
@@ -433,6 +512,14 @@ class SchedulerEngine:
                 rs.add_permit_result(ns, name, plugin_name, msg, timeout_str)
                 unreserve_all()
                 return False
+        return self._lifecycle_prebind(pod, ns, name, node, plugins, emap,
+                                       unreserve_all)
+
+    def _lifecycle_prebind(self, pod, ns, name, node, plugins, emap,
+                           unreserve_all) -> bool:
+        from ..scheduler.debuggable import has_hook
+
+        rs = self.result_store
         for p in plugins:
             if not p.has_pre_bind:
                 continue
@@ -450,6 +537,45 @@ class SchedulerEngine:
                 unreserve_all()
                 return False
         return True
+
+    def _waiter_finish(self, wp, waits, pod, ns, name, node_name, node,
+                       plugins, emap, unreserve_all) -> None:
+        """Binding-cycle tail for a parked pod (runs on a waiter thread).
+
+        The pod stays in self.waiting_pods until the bind (or rejection)
+        has fully landed — popping earlier would let a concurrent retry
+        wave re-schedule it.  Any exception resolves to "rejected" (with
+        unreserve) rather than silently killing the thread."""
+        outcome = "rejected"
+        try:
+            rejection = wp.wait()
+            if rejection is not None:
+                plugin_name, msg = rejection
+                timeout_str = next(
+                    (t for p, t in waits if p.name == plugin_name), "0s")
+                self.result_store.add_permit_result(ns, name, plugin_name,
+                                                    msg, timeout_str)
+                unreserve_all()
+            elif self._lifecycle_prebind(pod, ns, name, node, plugins, emap,
+                                         unreserve_all):
+                self._bind(ns, name, node_name)
+                self._run_custom_postbind(pod, node_name)
+                outcome = "bound"
+        except Exception:
+            try:
+                unreserve_all()
+            except Exception:
+                pass
+        finally:
+            try:
+                if outcome == "rejected":
+                    self._mark_unschedulable(ns, name)
+                self.reflector.reflect(ns, name)
+            except Exception:
+                pass
+            self.waiting_pods.pop((ns, name), None)
+            with self._waiter_lock:
+                self._waiter_results.append((outcome, ns, name))
 
     def _run_custom_postbind(self, pod, node_name: str) -> None:
         """PostBind (observation only, after the successful bind)."""

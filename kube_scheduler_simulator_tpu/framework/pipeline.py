@@ -347,7 +347,7 @@ def pack_filter_codes(filter_codes: jnp.ndarray, n: int, mode: str,
 
 
 def build_step(cw, out_mode: str = "full", pack_mode: str = "p16",
-               score_dtypes: tuple = (), wide_raw: bool = False):
+               score_dtypes: tuple = (), wide_raw: str | None = None):
     """Returns step(carry_dict, xs_slice_dict) -> (carry', out).
 
     cw: CompiledWorkload or any object with .config/.statics/.n_nodes
@@ -355,7 +355,8 @@ def build_step(cw, out_mode: str = "full", pack_mode: str = "p16",
     out_mode "full" -> StepOut; "compact" -> CompactOut (first-fail-packed
     filters, narrow raw scores, no finalscore — see CompactOut).
     score_dtypes: per-scorer "i8"/"i16" group assignment (compact mode);
-    wide_raw overrides every group to int32 after an overflow."""
+    wide_raw "i32"/"i64" pools every scorer into the raw32 field at that
+    width after an overflow (the replay's widening ladder)."""
     cfg = cw.config
     filter_names = cfg.filters()
     score_names = cfg.scorers()
@@ -395,14 +396,19 @@ def build_step(cw, out_mode: str = "full", pack_mode: str = "p16",
 
             raw8 = stack(groups["i8"], jnp.int8)
             raw16 = stack(groups["i16"], jnp.int16)
-            raw32 = stack(groups["i32"], jnp.int32)
+            raw32 = stack(groups["i32"],
+                          jnp.int64 if wide_raw == "i64" else jnp.int32)
             ovf = jnp.asarray(False)
-            if not wide_raw:
+            if wide_raw is None and groups["i16"]:
                 # i8 members are provably in range (compile-time bounds);
                 # only the i16 group needs the runtime check
-                if groups["i16"]:
-                    wide = jnp.stack(groups["i16"])
-                    ovf = jnp.any(wide != raw16.astype(wide.dtype))
+                full = jnp.stack(groups["i16"])
+                ovf = jnp.any(full != raw16.astype(full.dtype))
+            elif wide_raw == "i32" and groups["i32"]:
+                # custom scorers can exceed int32 (upstream scores are
+                # int64): keep checking so the ladder can reach i64
+                full = jnp.stack(groups["i32"])
+                ovf = jnp.any(full != raw32.astype(full.dtype))
             out: Any = CompactOut(
                 packed_filter=pack_filter_codes(
                     filter_codes, n, pack_mode, ignored=ignored),

@@ -140,9 +140,13 @@ class Preemptor:
             (p, p["spec"]["nodeName"]) for p in self._pods_all
             if (p.get("spec") or {}).get("nodeName") and _pod_key(p) not in removed
         ]
+        from ..state.compile import NodeTableReuse
+
         cw = compile_workload(
-            nodes, [pod], self.plugin_config, bound_pods=bound, volumes=self._volumes
+            nodes, [pod], self.plugin_config, bound_pods=bound,
+            volumes=self._volumes, reuse=getattr(self, "_fit_cw", None),
         )
+        self._fit_cw = NodeTableReuse(cw)  # shared across fit hypotheses
         rr = replay(cw, chunk=1, filter_only=True)
         try:
             j = cw.node_table.names.index(node_name)

@@ -353,13 +353,17 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
                     "scan cannot run it — schedule through the engine (it "
                     "routes to the host-interleaved path) or use "
                     "build_phased directly")
-    result = _replay_run(cw, chunk, collect, unroll, mesh, wide=False)
-    if result is None:  # some raw score overflowed int16: rerun widened
-        result = _replay_run(cw, chunk, collect, unroll, mesh, wide=True)
-    return result
+    # widening ladder: narrow groups -> int32 -> int64 (a raw overflowing
+    # its group dtype triggers the next tier; int64 is the upstream score
+    # type and cannot overflow)
+    for wide in (None, "i32", "i64"):
+        result = _replay_run(cw, chunk, collect, unroll, mesh, wide=wide)
+        if result is not None:
+            return result
+    raise AssertionError("unreachable: i64 replay cannot overflow")
 
 
-def _compact_plan(cw: CompiledWorkload, wide: bool):
+def _compact_plan(cw: CompiledWorkload, wide: str | None):
     """(pack_mode, score_dtypes, score_cols) for this workload."""
     from .pipeline import choose_pack_mode
 
@@ -373,14 +377,32 @@ def _compact_plan(cw: CompiledWorkload, wide: bool):
     counts = {"i8": 0, "i16": 0, "i32": 0}
     cols = []
     for g in score_dtypes:
-        g = "i32" if wide else g
+        g = "i32" if wide else g  # widened runs pool every scorer in raw32
         cols.append(({"i8": "raw8", "i16": "raw16", "i32": "raw32"}[g], counts[g]))
         counts[g] += 1
     return pack_mode, score_dtypes, tuple(cols)
 
 
+# chunks allowed in flight before the dispatch loop waits on the oldest
+# fetch: bounds device memory at O(inflight x chunk x N) even when D2H is
+# slower than device compute (the module-docstring invariant)
+_MAX_INFLIGHT = 4
+
+
+class _TinyOut:
+    """collect=False holder: keeps ONLY the per-pod scalars referenced so
+    the chunk's big result buffers free as soon as the device is done."""
+
+    _fields = ("selected", "feasible_count", "prefilter_reject")
+
+    def __init__(self, out):
+        self.selected = out.selected
+        self.feasible_count = out.feasible_count
+        self.prefilter_reject = out.prefilter_reject
+
+
 def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
-                mesh, wide: bool) -> ReplayResult | None:
+                mesh, wide: str | None) -> ReplayResult | None:
     p = cw.n_pods
     chunk = min(chunk, max(p, 1))
     pack_mode, score_dtypes, score_cols = _compact_plan(cw, wide)
@@ -392,7 +414,8 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
     carry = jax.tree.map(jnp.array, cw.init_carry)
     from concurrent.futures import ThreadPoolExecutor
 
-    futures = []
+    chunks: list = []
+    futures: list = []
     with ThreadPoolExecutor(max_workers=3) as pool:
         for lo in range(0, p, chunk):
             hi = min(lo + chunk, p)
@@ -403,14 +426,16 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
                 # dispatch returns immediately; a fetch thread blocks on
                 # this chunk's transfer while the device runs later chunks
                 futures.append(pool.submit(_fetch_chunk, out))
+                del out
+                while len(futures) - len(chunks) > _MAX_INFLIGHT:
+                    chunks.append(futures[len(chunks)].result())
             else:
-                futures.append(out)
+                futures.append(_TinyOut(out))
         if collect:
-            chunks = [f.result() for f in futures]
+            chunks.extend(f.result() for f in futures[len(chunks):])
         else:
             chunks = [
-                {f: np.asarray(getattr(o, f))
-                 for f in ("selected", "feasible_count", "prefilter_reject")}
+                {f: np.asarray(getattr(o, f)) for f in _TinyOut._fields}
                 for o in futures
             ]
 
@@ -429,8 +454,8 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
             prefilter_reject=prefilter_reject,
         )
 
-    if not wide and any(c["raw_overflow"].any() for c in chunks):
-        return None  # caller reruns with int32 raw outputs
+    if wide != "i64" and any(c["raw_overflow"].any() for c in chunks):
+        return None  # caller reruns at the next width tier
 
     compact = _CompactChunks(
         packed=[c["packed_filter"] for c in chunks],

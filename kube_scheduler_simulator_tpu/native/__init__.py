@@ -17,16 +17,31 @@ _lib = None
 _tried = False
 
 
+BUILD_CMD = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+
+
+def build_codec(so: str | None = None) -> str:
+    """Compile annotation_codec.cpp -> _annotation_codec.so (the recipe
+    `make codec` runs); returns the .so path."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "annotation_codec.cpp")
+    so = so or os.path.join(here, "_annotation_codec.so")
+    subprocess.run([*BUILD_CMD, "-o", so, src], check=True, capture_output=True)
+    return so
+
+
 def _build_and_load():
     here = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(here, "annotation_codec.cpp")
     so = os.path.join(here, "_annotation_codec.so")
     if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so, src],
-            check=True, capture_output=True,
-        )
-    lib = ctypes.CDLL(so)
+        build_codec(so)
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        # stale or foreign-platform binary: rebuild from source
+        build_codec(so)
+        lib = ctypes.CDLL(so)
     P = ctypes.POINTER
     lib.encode_filter_result.restype = ctypes.c_void_p
     lib.encode_filter_result.argtypes = [

@@ -42,7 +42,7 @@ def _worker_main(conn, nodes, pods, config, bound_pods, volumes, lo, hi):
         msg = conn.recv()
         op = msg[0]
         if op == "eval":
-            _, i, active, scorer_names = msg
+            _, i, active = msg
             pod = pods[i]
             seq._cycle = {}
             req, nz = pod_resource_request(pod, seq.schema)
@@ -165,7 +165,7 @@ class ParallelScheduler:
         scorer_names = [n for n in cfg.scorers() if not m._score_skip(n, pod)]
 
         for c in self._conns:
-            c.send(("eval", pod_idx, active, scorer_names))
+            c.send(("eval", pod_idx, active))
         filter_map: dict[str, dict[str, str]] = {}
         feasible: list[int] = []
         for c in self._conns:

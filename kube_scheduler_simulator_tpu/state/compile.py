@@ -63,12 +63,26 @@ def _pod_key(pod: dict) -> str:
     return f"{meta.get('namespace') or 'default'}/{meta.get('name', '')}"
 
 
+class NodeTableReuse:
+    """Slim handle for compile_workload(reuse=...): holds ONLY the node
+    table + schema (what the reuse path reads), so callers caching it
+    between waves don't pin the previous wave's per-pod device tensors."""
+
+    __slots__ = ("host", "schema", "node_table")
+
+    def __init__(self, cw: CompiledWorkload):
+        self.host = {"node_key": cw.host.get("node_key")}
+        self.schema = cw.schema
+        self.node_table = cw.node_table
+
+
 def compile_workload(
     nodes: list[dict],
     pods: list[dict],
     config: reg.PluginSetConfig | None = None,
     bound_pods: list[tuple[dict, str]] | None = None,
     volumes: dict | None = None,
+    reuse: "CompiledWorkload | NodeTableReuse | None" = None,
 ) -> CompiledWorkload:
     """Compile (nodes, queue pods, already-bound pods) into device tensors.
 
@@ -77,12 +91,28 @@ def compile_workload(
     existing cluster pods the reference scheduler sees via informers.
     volumes: optional {"pvcs": [...], "pvs": [...], "storageclasses": [...],
     "csinodes": [...]} manifest lists backing the volume plugin family.
+    reuse: a prior wave's workload — its NodeTable (the expensive per-node
+    manifest parse) is reused when the node set, resourceVersions, and the
+    discovered resource schema are unchanged (the common case between
+    scheduler waves; the engine passes its previous workload).
     """
     config = config or reg.PluginSetConfig()
     bound_pods = bound_pods or []
     volumes = volumes or {}
     schema = ResourceSchema.discover(pods + [bp for bp, _ in bound_pods], nodes)
-    table = build_node_table(nodes, schema)
+    node_key = tuple(
+        ((n.get("metadata") or {}).get("name", ""),
+         (n.get("metadata") or {}).get("resourceVersion", ""))
+        for n in nodes
+    )
+    if (reuse is not None
+            and reuse.host.get("node_key") == node_key
+            and tuple(reuse.schema.columns) == tuple(schema.columns)
+            and reuse.schema.n == schema.n):
+        schema = reuse.schema
+        table = reuse.node_table
+    else:
+        table = build_node_table(nodes, schema)
 
     p = len(pods)
     requests = np.zeros((p, schema.n), dtype=np.int64)
@@ -93,7 +123,8 @@ def compile_workload(
     statics: dict[str, Any] = {}
     xs: dict[str, Any] = {}
     init_carry: dict[str, Any] = {}
-    host: dict[str, Any] = {"node_table": table, "schema": schema}
+    host: dict[str, Any] = {"node_table": table, "schema": schema,
+                            "node_key": node_key}
 
     # core resource carry, primed with bound pods
     name_idx = {name: j for j, name in enumerate(table.names)}

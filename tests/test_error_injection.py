@@ -1,0 +1,266 @@
+"""Failure-path tables for the parity-critical services.
+
+The reference carries its deepest tests exactly here: snapshot list/apply
+error injection (reference: simulator/snapshot/snapshot_test.go:585-964,
+via k8stesting reaction hooks), reflector conflict exhaustion
+(storereflector/storereflector_test.go), and result-store edge tables
+(resultstore/store_test.go).  This module is the analogue: a FaultyStore
+injects per-(op, resource) errors like reaction hooks do.
+"""
+
+import json
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import (
+    AlreadyExists, ApiError, Conflict, NotFound, ObjectStore,
+)
+from kube_scheduler_simulator_tpu.services.snapshot import (
+    SnapshotOptions, SnapshotService,
+)
+from kube_scheduler_simulator_tpu.store import annotations as ann
+from kube_scheduler_simulator_tpu.store.reflector import (
+    StoreReflector, update_result_history,
+)
+from kube_scheduler_simulator_tpu.store.resultstore import ResultStore
+
+
+class FaultyStore(ObjectStore):
+    """Reaction-hook analogue: fail selected (op, resource) calls."""
+
+    def __init__(self):
+        super().__init__()
+        self.fail: dict[tuple[str, str], Exception] = {}
+        self.conflict_times: int = 0  # fail the next N updates w/ Conflict
+        self.calls: list[tuple[str, str, str]] = []
+
+    def create(self, resource, obj):
+        self.calls.append(("create", resource,
+                           (obj.get("metadata") or {}).get("name", "")))
+        err = self.fail.get(("create", resource))
+        if err is not None:
+            raise err
+        return super().create(resource, obj)
+
+    def update(self, resource, obj):
+        if self.conflict_times > 0:
+            self.conflict_times -= 1
+            raise Conflict(f"injected conflict for {resource}")
+        err = self.fail.get(("update", resource))
+        if err is not None:
+            raise err
+        return super().update(resource, obj)
+
+
+class FakeScheduler:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.restarts: list = []
+
+    def get_config(self):
+        return {"profiles": []}
+
+    def restart_scheduler(self, cfg):
+        if self.fail:
+            raise ApiError("scheduler restart failed")
+        self.restarts.append(cfg)
+
+
+def _obj(name, namespace=None, **spec):
+    meta = {"name": name}
+    if namespace:
+        meta["namespace"] = namespace
+    return {"metadata": meta, **({"spec": spec} if spec else {})}
+
+
+def _snapshot():
+    return {
+        "namespaces": [_obj("team-a")],
+        "priorityClasses": [_obj("high")],
+        "storageClasses": [_obj("fast")],
+        "pvcs": [_obj("claim-0", namespace="team-a")],
+        "nodes": [_obj("node-0")],
+        "pods": [_obj("pod-0", namespace="team-a")],
+        "pvs": [],
+        "schedulerConfig": {"profiles": []},
+    }
+
+
+# ---------------------------------------------------------- snapshot load
+
+def test_load_apply_error_aborts_without_ignore_err():
+    store = FaultyStore()
+    store.fail[("create", "nodes")] = ApiError("injected: node create fails")
+    svc = SnapshotService(store, FakeScheduler())
+    with pytest.raises(ApiError, match="node create fails"):
+        svc.load(_snapshot())
+    # the earlier barrier group (namespaces) still landed
+    assert store.get("namespaces", "team-a")
+
+
+def test_load_apply_error_collected_with_ignore_err():
+    store = FaultyStore()
+    store.fail[("create", "nodes")] = ApiError("injected")
+    svc = SnapshotService(store, FakeScheduler())
+    svc.load(_snapshot(), SnapshotOptions(ignore_err=True))
+    # everything except the failing resource applied
+    assert store.get("pods", "pod-0", "team-a")
+    assert store.get("priorityclasses", "high")
+    with pytest.raises(NotFound):
+        store.get("nodes", "node-0")
+
+
+def test_load_tolerates_already_exists():
+    store = FaultyStore()
+    store.create("nodes", _obj("node-0"))
+    svc = SnapshotService(store, FakeScheduler())
+    svc.load(_snapshot())  # no raise
+    assert store.get("pods", "pod-0", "team-a")
+
+
+def test_load_scheduler_restart_failure_aborts_before_apply():
+    store = FaultyStore()
+    svc = SnapshotService(store, FakeScheduler(fail=True))
+    with pytest.raises(ApiError):
+        svc.load(_snapshot())
+    with pytest.raises(NotFound):
+        store.get("nodes", "node-0")  # nothing applied
+
+
+def test_load_ignore_scheduler_configuration_skips_restart():
+    store = FaultyStore()
+    sched = FakeScheduler(fail=True)  # would raise if called
+    svc = SnapshotService(store, sched)
+    svc.load(_snapshot(), SnapshotOptions(ignore_scheduler_configuration=True))
+    assert sched.restarts == []
+    assert store.get("nodes", "node-0")
+
+
+def test_load_reresolves_pv_claim_uid():
+    store = FaultyStore()
+    svc = SnapshotService(store, FakeScheduler())
+    snap = _snapshot()
+    snap["pvs"] = [{
+        "metadata": {"name": "pv-0"},
+        "spec": {"claimRef": {"name": "claim-0", "namespace": "team-a",
+                              "uid": "stale-uid"}},
+    }]
+    svc.load(snap)
+    pv = store.get("persistentvolumes", "pv-0")
+    fresh = store.get("persistentvolumeclaims", "claim-0", "team-a")
+    assert pv["spec"]["claimRef"]["uid"] == fresh["metadata"]["uid"]
+    assert pv["spec"]["claimRef"]["uid"] != "stale-uid"
+
+
+def test_load_drops_claim_uid_when_pvc_missing():
+    store = FaultyStore()
+    svc = SnapshotService(store, FakeScheduler())
+    snap = _snapshot()
+    snap["pvcs"] = []
+    snap["pvs"] = [{
+        "metadata": {"name": "pv-0"},
+        "spec": {"claimRef": {"name": "claim-0", "namespace": "team-a",
+                              "uid": "stale-uid"}},
+    }]
+    svc.load(snap)
+    assert "uid" not in store.get("persistentvolumes", "pv-0")["spec"]["claimRef"]
+
+
+def test_load_skips_system_priority_classes_and_kube_namespaces():
+    store = FaultyStore()
+    svc = SnapshotService(store, FakeScheduler())
+    snap = _snapshot()
+    snap["namespaces"].append(_obj("kube-system"))
+    snap["priorityClasses"].append(_obj("system-cluster-critical"))
+    svc.load(snap)
+    with pytest.raises(NotFound):
+        store.get("namespaces", "kube-system")
+    with pytest.raises(NotFound):
+        store.get("priorityclasses", "system-cluster-critical")
+
+
+# ------------------------------------------------------------- reflector
+
+def _reflector_fixture(conflicts: int):
+    store = FaultyStore()
+    store.create("pods", _obj("pod-0", namespace="default"))
+    rs = ResultStore()
+    rs.add_selected_node("default", "pod-0", "node-7")
+    refl = StoreReflector(store, sleep=lambda _t: None)
+    refl.add_result_store(rs, "k")
+    store.conflict_times = conflicts
+    return store, rs, refl
+
+
+def test_reflector_retries_through_transient_conflicts():
+    store, rs, refl = _reflector_fixture(conflicts=3)
+    refl.reflect("default", "pod-0")
+    pod = store.get("pods", "pod-0", "default")
+    assert pod["metadata"]["annotations"][ann.SELECTED_NODE] == "node-7"
+    # store entry deleted only after the successful write
+    assert rs.get_stored_result(pod) is None or ann.SELECTED_NODE not in (
+        rs.get_stored_result(pod) or {})
+
+
+def test_reflector_conflict_exhaustion_keeps_store_data():
+    from kube_scheduler_simulator_tpu.utils.retry import RetryTimeout
+
+    store, rs, refl = _reflector_fixture(conflicts=10**6)
+    with pytest.raises(RetryTimeout):
+        refl.reflect("default", "pod-0")
+    # the write never landed and the result data was NOT deleted
+    pod = store.get("pods", "pod-0", "default")
+    assert not (pod["metadata"].get("annotations") or {})
+    assert ann.SELECTED_NODE in (rs.get_stored_result(pod) or {})
+
+
+def test_reflector_pod_deleted_is_not_an_error():
+    store, rs, refl = _reflector_fixture(conflicts=0)
+    store.delete("pods", "pod-0", "default")
+    refl.reflect("default", "pod-0")  # no raise
+
+
+def test_result_history_trims_oldest_to_fit_limit():
+    pod = _obj("pod-0", namespace="default")
+    big = "x" * 60_000
+    for i in range(6):
+        update_result_history(pod, {"k": f"{i}-{big}"})
+    history = json.loads(pod["metadata"]["annotations"][ann.RESULT_HISTORY])
+    # 6 x 60KB > 256KiB: the oldest entries were dropped, newest kept
+    assert len(history) == 4
+    assert history[-1]["k"].startswith("5-")
+    assert history[0]["k"].startswith("2-")
+
+
+def test_result_history_single_oversized_entry_raises():
+    pod = _obj("pod-0", namespace="default")
+    with pytest.raises(ValueError):
+        update_result_history(pod, {"k": "x" * 300_000})
+
+
+# ----------------------------------------------------------- result store
+
+def test_result_store_empty_pod_returns_nothing():
+    rs = ResultStore()
+    assert rs.get_stored_result(_obj("ghost", namespace="default")) is None
+
+
+def test_result_store_isolates_pods_and_delete_data():
+    rs = ResultStore()
+    rs.add_selected_node("default", "a", "node-1")
+    rs.add_selected_node("default", "b", "node-2")
+    pa, pb = _obj("a", namespace="default"), _obj("b", namespace="default")
+    assert rs.get_stored_result(pa)[ann.SELECTED_NODE] == "node-1"
+    rs.delete_data(pa)
+    assert rs.get_stored_result(pa) is None
+    assert rs.get_stored_result(pb)[ann.SELECTED_NODE] == "node-2"
+
+
+def test_result_store_final_score_applies_weight():
+    rs = ResultStore(score_plugin_weight={"P": 3})
+    rs.add_score_result("default", "a", "node-1", "P", 50)
+    rs.add_normalized_score_result("default", "a", "node-1", "P", 80)
+    out = rs.get_stored_result(_obj("a", namespace="default"))
+    assert json.loads(out[ann.SCORE_RESULT])["node-1"]["P"] == "50"
+    # finalscore = normalized x weight (resultstore/store.go:488-507)
+    assert json.loads(out[ann.FINAL_SCORE_RESULT])["node-1"]["P"] == "240"
