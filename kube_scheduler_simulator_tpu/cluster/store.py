@@ -30,8 +30,12 @@ import threading
 import time
 import uuid
 
-# resource name -> (kind, namespaced) — the 7 kinds the simulator handles
-# (reference: recorder/recorder.go:45-53 DefaultGVRs)
+# resource name -> (kind, namespaced).  The first 7 are the kinds the
+# reference simulator watches/records/syncs (reference:
+# recorder/recorder.go:45-53 DefaultGVRs — see DEFAULT_GVRS below);
+# PodDisruptionBudgets are additionally storable so PDB-aware preemption
+# can honor them (the real scheduler reads PDBs from the apiserver even
+# though the simulator never syncs them).
 RESOURCES: dict[str, tuple[str, bool]] = {
     "namespaces": ("Namespace", False),
     "priorityclasses": ("PriorityClass", False),
@@ -40,11 +44,19 @@ RESOURCES: dict[str, tuple[str, bool]] = {
     "nodes": ("Node", False),
     "persistentvolumes": ("PersistentVolume", False),
     "pods": ("Pod", True),
+    "poddisruptionbudgets": ("PodDisruptionBudget", True),
 }
+
+# the reference's 7 DefaultGVRs — the watch/record/sync surface
+DEFAULT_GVRS = [
+    "namespaces", "priorityclasses", "storageclasses",
+    "persistentvolumeclaims", "nodes", "persistentvolumes", "pods",
+]
 
 API_VERSIONS = {
     "priorityclasses": "scheduling.k8s.io/v1",
     "storageclasses": "storage.k8s.io/v1",
+    "poddisruptionbudgets": "policy/v1",
 }
 
 ADDED, MODIFIED, DELETED = "ADDED", "MODIFIED", "DELETED"

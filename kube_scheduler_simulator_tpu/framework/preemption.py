@@ -19,11 +19,12 @@ pkg/scheduler/framework/plugins/defaultpreemption (v1.32):
      the pod then fits, reprieve victims most-important-first (priority
      desc, earlier creation first), keeping each one that still lets the
      pod fit — the rest are the victim set;
-  4. candidate selection (upstream pickOneNodeForPreemption, minus PDB
-     support — the reference cluster model has no PodDisruptionBudgets):
-     lowest highest-victim priority, then smallest priority sum, then
-     fewest victims, then latest highest-priority-victim creation, then
-     node order;
+  4. candidate selection (upstream pickOneNodeForPreemption): fewest PDB
+     violations first (PodDisruptionBudgets are storable even though they
+     are outside the 7 synced GVRs — the real scheduler honors any PDBs
+     present), then lowest highest-victim priority, then smallest
+     priority sum, then fewest victims, then latest
+     highest-priority-victim creation, then node order;
   5. execution: delete the victims, set the preemptor's
      status.nominatedNodeName.
 
@@ -86,6 +87,44 @@ def _pod_key(pod: dict) -> str:
 def _num_candidates(n_nodes: int) -> int:
     n = max(n_nodes * MIN_CANDIDATE_NODES_PERCENTAGE // 100, MIN_CANDIDATE_NODES_ABSOLUTE)
     return min(n, n_nodes)
+
+
+def filter_pods_with_pdb_violation(pods: list[dict], pdbs: list[dict]
+                                   ) -> tuple[list[dict], list[dict]]:
+    """(violating, non-violating) split, upstream
+    filterPodsWithPDBViolation semantics: each pod decrements every
+    matching PDB's remaining disruptionsAllowed; once a budget goes
+    negative, further matching pods (and that one) are violating."""
+    from ..state.selectors import label_selector_matches
+
+    allowed = [
+        int(((pdb.get("status") or {}).get("disruptionsAllowed")) or 0)
+        for pdb in pdbs
+    ]
+    violating, ok = [], []
+    for pod in pods:
+        meta = pod.get("metadata") or {}
+        ns = meta.get("namespace") or "default"
+        labels = {k: str(v) for k, v in (meta.get("labels") or {}).items()}
+        is_violating = False
+        for i, pdb in enumerate(pdbs):
+            pdb_ns = (pdb.get("metadata") or {}).get("namespace") or "default"
+            if pdb_ns != ns:
+                continue
+            selector = (pdb.get("spec") or {}).get("selector")
+            # upstream filterPodsWithPDBViolation: "A PDB with a nil or
+            # empty selector can't match anything" (unlike the eviction
+            # API, where {} selects the namespace)
+            if (not selector
+                    or (not selector.get("matchLabels")
+                        and not selector.get("matchExpressions"))
+                    or not label_selector_matches(selector, labels)):
+                continue
+            allowed[i] -= 1
+            if allowed[i] < 0:
+                is_violating = True
+        (violating if is_violating else ok).append(pod)
+    return violating, ok
 
 
 def first_fail_plugins(codes: np.ndarray, active_names: list[str]) -> list[str | None]:
@@ -178,6 +217,10 @@ class Preemptor:
             "pvs": self.store.list("persistentvolumes")[0],
             "storageclasses": self.store.list("storageclasses")[0],
         }
+        try:
+            self._pdbs = self.store.list("poddisruptionbudgets")[0]
+        except KeyError:
+            self._pdbs = []
         evaluated = [n for n, _ in failed]
         out = PreemptionOutcome(evaluated_nodes=evaluated)
 
@@ -199,13 +242,14 @@ class Preemptor:
                 by_node.setdefault(nn, []).append(p)
 
         budget = _num_candidates(len(potential))
-        candidates: list[tuple[str, list[dict]]] = []
+        candidates: list[tuple[str, list[dict], int]] = []
         for node in potential:
             if len(candidates) >= budget:
                 break
-            victims = self._victims_on(node, by_node.get(node, []), pod, pod_prio)
-            if victims is not None:
-                candidates.append((node, victims))
+            found = self._victims_on(node, by_node.get(node, []), pod, pod_prio)
+            if found is not None:
+                victims, violations = found
+                candidates.append((node, victims, violations))
         if not candidates:
             return out
 
@@ -219,12 +263,15 @@ class Preemptor:
         out.victims = victims
         return out
 
-    def _call_extenders(self, pod: dict, candidates: list[tuple[str, list[dict]]]
-                        ) -> list[tuple[str, list[dict]]]:
+    def _call_extenders(self, pod: dict,
+                        candidates: list[tuple[str, list[dict], int]]
+                        ) -> list[tuple[str, list[dict], int]]:
         """upstream preemption callExtenders: each preempt-capable extender
         receives ExtenderPreemptionArgs{Pod, NodeNameToVictims} and returns
-        a (possibly narrowed) node->victims map; an unignorable error
-        aborts preemption.  Each round-trip is recorded into
+        a (possibly narrowed) node->victims map — whose NumPDBViolations
+        REPLACES the locally computed count, as upstream builds the final
+        candidates from the extender's answer; an unignorable error aborts
+        preemption.  Each round-trip is recorded into
         extender-preempt-result by the service's store."""
         def _pods_of(victims_obj) -> list:
             # the k8s extender/v1 Victims json tag is lowercase "pods";
@@ -233,11 +280,16 @@ class Preemptor:
             v = victims_obj or {}
             return v.get("Pods") or v.get("pods") or []
 
+        def _nv_of(victims_obj) -> int:
+            v = victims_obj or {}
+            return int(v.get("NumPDBViolations")
+                       or v.get("numPDBViolations") or 0)
+
         node_to_victims: dict[str, dict] = {
-            node: {"Pods": victims, "NumPDBViolations": 0}
-            for node, victims in candidates
+            node: {"Pods": victims, "NumPDBViolations": violations}
+            for node, victims, violations in candidates
         }
-        order = [node for node, _ in candidates]
+        order = [node for node, _, _ in candidates]
         for idx, ext in enumerate(self.extender_service.extenders):
             if not ext.preempt_verb or not node_to_victims:
                 continue
@@ -278,50 +330,75 @@ class Preemptor:
                                  "NumPDBViolations": (mv or {}).get("NumPDBViolations")
                                  or (mv or {}).get("numPDBViolations") or 0}
             else:
-                ret = {n: {"Pods": _pods_of(v)} for n, v in ret.items()}
+                ret = {n: {"Pods": _pods_of(v), "NumPDBViolations": _nv_of(v)}
+                       for n, v in ret.items()}
             node_to_victims = {
                 n: v for n, v in ret.items() if n in node_to_victims
             }
         return [
-            (n, _pods_of(node_to_victims[n]))
+            (n, _pods_of(node_to_victims[n]), _nv_of(node_to_victims[n]))
             for n in order if n in node_to_victims
         ]
 
     def _victims_on(self, node: str, node_pods: list[dict], pod: dict,
-                    pod_prio: int) -> list[dict] | None:
-        """Minimal victim set on `node`, or None if removing every
-        lower-priority pod still doesn't make `pod` fit."""
+                    pod_prio: int) -> tuple[list[dict], int] | None:
+        """(minimal victim set on `node`, #PDB-violating victims), or None
+        if removing every lower-priority pod still doesn't make `pod` fit.
+
+        PDB handling follows upstream SelectVictimsOnNode: split the
+        potential victims into PDB-violating and non-violating, reprieve
+        the violating ones FIRST (so budget-covered pods are preferred as
+        the ones actually evicted), and count the violating pods that
+        could not be reprieved."""
         lower = [p for p in node_pods if _priority(p) < pod_prio]
         all_removed = frozenset(_pod_key(p) for p in lower)
         if not self._fits(pod, node, all_removed):
             return None
         # reprieve most-important-first (upstream MoreImportantPod order)
         lower.sort(key=lambda p: (-_priority(p), _creation(p), _pod_key(p)))
+        violating, non_violating = filter_pods_with_pdb_violation(
+            lower, self._pdbs or [])
         removed = set(all_removed)
         victims: list[dict] = []
-        for v in lower:
+        violations = 0
+
+        def reprieve(v: dict) -> bool:
             removed.discard(_pod_key(v))
             if not self._fits(pod, node, frozenset(removed)):
                 removed.add(_pod_key(v))
                 victims.append(v)
-        return victims
+                return False
+            return True
+
+        for v in violating:
+            if not reprieve(v):
+                violations += 1
+        for v in non_violating:
+            reprieve(v)
+        # keep victim list in MoreImportantPod order (execution + records)
+        order = {_pod_key(p): i for i, p in enumerate(lower)}
+        victims.sort(key=lambda p: order[_pod_key(p)])
+        return victims, violations
 
     @staticmethod
-    def _select(candidates: list[tuple[str, list[dict]]]) -> tuple[str, list[dict]]:
-        """upstream pickOneNodeForPreemption, PDB-less."""
+    def _select(candidates: list[tuple[str, list[dict], int]]
+                ) -> tuple[str, list[dict]]:
+        """upstream pickOneNodeForPreemption: fewest PDB violations, then
+        the victim-priority/count/age tie-break ladder."""
 
-        def rank(c: tuple[str, list[dict]]):
-            _, victims = c
-            if not victims:  # leading 0: no-victim candidates always win
-                return (0, 0, 0, 0, _InvStr(""))
+        def rank(c: tuple[str, list[dict], int]):
+            _, victims, violations = c
+            if not victims:  # no-victim candidates win their violation tier
+                return (violations, 0, 0, 0, 0, _InvStr(""))
             prios = [_priority(v) for v in victims]
             top = max(prios)
             # later creation must rank first; _InvStr inverts string order
             latest = max(_creation(v) for v in victims if _priority(v) == top)
-            return (1, top, sum(prios), len(victims), _InvStr(latest))
+            return (violations, 1, top, sum(prios), len(victims), _InvStr(latest))
 
         best = min(range(len(candidates)), key=lambda i: (rank(candidates[i]), i))
-        return candidates[best]
+        node, victims, _ = candidates[best]
+        return node, victims
 
 
 class _InvStr(str):

@@ -11,12 +11,21 @@ normalization, host selection, bind bookkeeping, annotation marshalling —
 on the master, exactly where upstream keeps it (scheduleOne runs
 selectHost and the binding cycle on one goroutine).
 
+Protocol note: Go goroutines share the result store by mutex, so the
+16-way fan-out costs no serialization; Python processes would pay pickle
+on every per-node message string.  To keep the comparison fair the wire
+protocol is compact — failure messages are interned per worker (shipped
+once), per-node filter outcomes travel as (node, #passed, msg_id) triples
+exploiting the framework's stop-at-first-fail rule, raw scores as int
+lists, and the bind broadcast piggybacks on the next cycle's request —
+and the master rebuilds the exact per-node annotation maps locally.
+
 Design: each worker holds a full SequentialScheduler replica and evaluates
 only its node slice [lo, hi); per cycle the master broadcasts the pod
-index, gathers each slice's (filter entries, feasible set, raw scores),
-merges, normalizes, selects, and broadcasts the bind so every replica's
-dynamic state (requested resources, topology counts, assigned pods) stays
-in lock-step.  Output is asserted identical to SequentialScheduler by
+index (+ the previous bind), gathers each slice's compact results, merges,
+normalizes, selects, and applies the bind so every replica's dynamic state
+(requested resources, topology counts, assigned pods) stays in lock-step.
+Output is asserted identical to SequentialScheduler by
 tests/test_parallel_oracle.py.
 """
 
@@ -38,42 +47,47 @@ DEFAULT_PARALLELISM = 16  # upstream parallelism default
 def _worker_main(conn, nodes, pods, config, bound_pods, volumes, lo, hi):
     seq = SequentialScheduler(nodes, pods, config, bound_pods=bound_pods,
                               volumes=volumes)
+    msg_ids: dict[str, int] = {}
     while True:
         msg = conn.recv()
         op = msg[0]
         if op == "eval":
-            _, i, active = msg
+            _, i, active, scorer_names, bind = msg
+            if bind is not None:
+                _apply_bind(seq, pods[bind[0]], bind[1])
             pod = pods[i]
             seq._cycle = {}
             req, nz = pod_resource_request(pod, seq.schema)
-            entries: dict[int, dict[str, str]] = {}
+            new_msgs: list[str] = []
+            fails: list[tuple[int, int, int]] = []  # (node, #passed, msg_id)
             feasible: list[int] = []
             for j in range(lo, hi):
-                entry: dict[str, str] = {}
-                ok = True
+                n_passed = 0
+                fail_msg = None
                 for name in active:
                     m = seq._filter(name, pod, req, j)
                     if m is None:
-                        entry[name] = ann.PASSED_FILTER_MESSAGE
+                        n_passed += 1
                     else:
-                        entry[name] = m
-                        ok = False
+                        fail_msg = m
                         break
-                if entry:
-                    entries[j] = entry
-                if ok:
+                if fail_msg is None:
                     feasible.append(j)
-            conn.send((entries, feasible))
-        elif op == "score":
-            _, i, scorer_names, feasible = msg
-            pod = pods[i]
-            req, nz = pod_resource_request(pod, seq.schema)
-            mine = [j for j in feasible if lo <= j < hi]
-            raws = {
-                name: {j: seq._score(name, pod, req, nz, j) for j in mine}
+                else:
+                    mid = msg_ids.get(fail_msg)
+                    if mid is None:
+                        mid = msg_ids[fail_msg] = len(msg_ids)
+                        new_msgs.append(fail_msg)
+                    fails.append((j, n_passed, mid))
+            # scores for the locally feasible nodes, same round-trip
+            # (feasibility is per-node independent; the master discards
+            # them when the GLOBAL feasible count is <= 1, matching the
+            # upstream skip of the score phase)
+            raws = [
+                [seq._score(name, pod, req, nz, j) for j in feasible]
                 for name in scorer_names
-            }
-            conn.send(raws)
+            ]
+            conn.send((fails, feasible, raws, new_msgs))
         elif op == "bind":
             _, i, selected = msg
             _apply_bind(seq, pods[i], selected)
@@ -113,6 +127,8 @@ class ParallelScheduler:
         ctx = mp.get_context("fork")
         self._conns = []
         self._procs = []
+        self._msgs: list[list[str]] = []  # per-worker interned msg tables
+        self._pending_bind: tuple[int, int] | None = None
         for k in range(workers):
             parent, child = ctx.Pipe()
             proc = ctx.Process(
@@ -125,6 +141,7 @@ class ParallelScheduler:
             child.close()
             self._conns.append(parent)
             self._procs.append(proc)
+            self._msgs.append([])
 
     def close(self):
         for c in self._conns:
@@ -164,15 +181,30 @@ class ParallelScheduler:
         active = [n for n in cfg.filters() if not m._filter_skip(n, pod)]
         scorer_names = [n for n in cfg.scorers() if not m._score_skip(n, pod)]
 
+        bind, self._pending_bind = self._pending_bind, None
         for c in self._conns:
-            c.send(("eval", pod_idx, active))
+            c.send(("eval", pod_idx, active, scorer_names, bind))
         filter_map: dict[str, dict[str, str]] = {}
         feasible: list[int] = []
-        for c in self._conns:
-            entries, feas = c.recv()
-            for j, entry in entries.items():
+        worker_raws: list[tuple[list[int], list[list[int]]]] = []
+        for w, c in enumerate(self._conns):
+            fails, feas, raws, new_msgs = c.recv()
+            self._msgs[w].extend(new_msgs)
+            table = self._msgs[w]
+            for j, n_passed, mid in fails:
+                entry: dict[str, str] = {}
+                for name in active[:n_passed]:
+                    entry[name] = ann.PASSED_FILTER_MESSAGE
+                entry[active[n_passed]] = table[mid]
                 filter_map[m.names[j]] = entry
+            for j in feas:
+                filter_map[m.names[j]] = {
+                    name: ann.PASSED_FILTER_MESSAGE for name in active
+                }
             feasible.extend(feas)
+            worker_raws.append((feas, raws))
+        if not active:
+            filter_map = {}
         feasible.sort()
 
         prescore: dict[str, str] = {}
@@ -184,13 +216,12 @@ class ParallelScheduler:
         elif len(feasible) > 1:
             for name in cfg.prescorers():
                 prescore[name] = "" if m._score_skip(name, pod) else ann.SUCCESS_MESSAGE
-            for c in self._conns:
-                c.send(("score", pod_idx, scorer_names, feasible))
             merged: dict[str, dict[int, int]] = {name: {} for name in scorer_names}
-            for c in self._conns:
-                raws = c.recv()
-                for name, d in raws.items():
-                    merged[name].update(d)
+            for feas, raws in worker_raws:
+                for s, name in enumerate(scorer_names):
+                    d = merged[name]
+                    for j, v in zip(feas, raws[s]):
+                        d[j] = v
             totals = {j: 0 for j in feasible}
             for name in scorer_names:
                 raw = merged[name]
@@ -206,8 +237,7 @@ class ParallelScheduler:
 
         if selected >= 0:
             _apply_bind(m, pod, selected)
-            for c in self._conns:
-                c.send(("bind", pod_idx, selected))
+            self._pending_bind = (pod_idx, selected)
 
         vb_on = ("VolumeBinding" in cfg.enabled and not cfg.is_custom("VolumeBinding"))
         reserve_map = (

@@ -126,6 +126,8 @@ def _make_handler(di: DIContainer):
             try:
                 if path in ("", "/", "/ui") and method == "GET":
                     return self._index()
+                if path.startswith("/web/") and method == "GET":
+                    return self._static(path[len("/web/"):])
                 if path == "/metrics" and method == "GET":
                     return self._metrics_text()
                 if path == "/api/v1/metrics" and method == "GET":
@@ -265,6 +267,21 @@ def _make_handler(di: DIContainer):
             self.send_response(200)
             self._cors()
             self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _static(self, name: str):
+            """UI assets (the js modules next to index.html); names are
+            restricted to flat .js/.css files so no path can escape."""
+            from ..web import static_file
+
+            body, ctype = static_file(name)
+            if body is None:
+                return self._json(404, {"message": f"no asset {name!r}"})
+            self.send_response(200)
+            self._cors()
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -16,7 +16,7 @@ import datetime
 import json
 import threading
 
-from ..cluster.store import ADDED, DELETED, MODIFIED, ObjectStore, RESOURCES
+from ..cluster.store import ADDED, DELETED, MODIFIED, ObjectStore, RESOURCES, DEFAULT_GVRS
 
 EVENT_NAMES = {ADDED: "Add", MODIFIED: "Update", DELETED: "Delete"}
 DEFAULT_FLUSH_INTERVAL = 5.0
@@ -29,7 +29,7 @@ class RecorderService:
         self.store = store
         self.path = path
         self.flush_interval = flush_interval
-        self.resources = resources or list(RESOURCES)
+        self.resources = resources or list(DEFAULT_GVRS)
         self._records: list[dict] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()

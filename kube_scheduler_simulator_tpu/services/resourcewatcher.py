@@ -16,7 +16,7 @@ from __future__ import annotations
 import json
 import threading
 
-from ..cluster.store import ObjectStore, RESOURCES, ADDED
+from ..cluster.store import ObjectStore, RESOURCES, ADDED, DEFAULT_GVRS
 
 # wire protocol: per-kind *LastResourceVersion query params a client passes
 # to resume (reference: server/handler/watcher.go:23-45 form values)
@@ -55,7 +55,7 @@ class StreamWriter:
 class ResourceWatcherService:
     def __init__(self, store: ObjectStore, resources: list[str] | None = None):
         self.store = store
-        self.resources = resources or list(RESOURCES)
+        self.resources = resources or list(DEFAULT_GVRS)
 
     def list_watch(self, stream: StreamWriter, last_resource_versions: dict[str, int] | None,
                    stop: threading.Event) -> None:

@@ -229,7 +229,7 @@ def compile_workload(
         # share the same term ids; then slice the per-pod xs back to the
         # queue and fold the bound rows into the initial carry.
         bound_manifests = [bp for bp, _ in bound_pods]
-        st, x_all, carry = interpod.build(
+        st, x_all, dom_mats = interpod.build(
             table, pods + bound_manifests,
             hard_weight=int((config.args.get("InterPodAffinity") or {})
                             .get("hardPodAffinityWeight")
@@ -239,8 +239,8 @@ def compile_workload(
         xs["InterPodAffinity"] = interpod.InterPodXS(
             *[v[:p] for v in x_all]
         )
-        carry = _prime_interpod_counts(carry, st, x_all, len(pods), bound_pods, name_idx)
-        init_carry["InterPodAffinity"] = carry
+        _prime_interpod_counts(dom_mats, st, x_all, len(pods), bound_pods, name_idx)
+        init_carry["InterPodAffinity"] = interpod.assemble_carry(st, dom_mats)
 
     cw = CompiledWorkload(
         schema=schema,
@@ -307,11 +307,12 @@ def _spread_groups(pods):
     return out
 
 
-def _prime_interpod_counts(carry, st, x_all, n_queue, bound_pods, name_idx):
-    """Fold bound pods (rows n_queue.. of x_all) into the interpod carry."""
+def _prime_interpod_counts(dom_mats, st, x_all, n_queue, bound_pods, name_idx):
+    """Fold bound pods (rows n_queue.. of x_all) into the domain-space
+    interpod count mats (in place; interpod.assemble_carry converts to the
+    node-space device carry afterwards)."""
     if not bound_pods:
-        return carry
-    mats = {k: np.asarray(v).copy() for k, v in carry._asdict().items()}
+        return
     dom_idx = np.asarray(st.dom_idx)
     t_matches = np.asarray(x_all.t_matches)
     h_req_anti = np.asarray(x_all.h_req_anti)
@@ -327,12 +328,11 @@ def _prime_interpod_counts(carry, st, x_all, n_queue, bound_pods, name_idx):
             dm = dom_idx[t_id, j]
             if dm < 0:
                 continue
-            mats["matched"][t_id, dm] += bool(t_matches[i, t_id])
-            mats["have_req_anti"][t_id, dm] += int(h_req_anti[i, t_id])
-            mats["have_req_aff"][t_id, dm] += int(h_req_aff[i, t_id])
-            mats["sym_pref_aff"][t_id, dm] += int(h_pref_aff_w[i, t_id])
-            mats["sym_pref_anti"][t_id, dm] += int(h_pref_anti_w[i, t_id])
-    return interpod.InterPodCarry(**{k: jnp.asarray(v) for k, v in mats.items()})
+            dom_mats["matched"][t_id, dm] += bool(t_matches[i, t_id])
+            dom_mats["have_req_anti"][t_id, dm] += int(h_req_anti[i, t_id])
+            dom_mats["have_req_aff"][t_id, dm] += int(h_req_aff[i, t_id])
+            dom_mats["sym_pref_aff"][t_id, dm] += int(h_pref_aff_w[i, t_id])
+            dom_mats["sym_pref_anti"][t_id, dm] += int(h_pref_anti_w[i, t_id])
 
 
 def _collect_host_flags(cw: CompiledWorkload):

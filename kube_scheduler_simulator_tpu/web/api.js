@@ -1,0 +1,77 @@
+// API client — the web/api/v1/*.ts analogue of the reference UI
+// (axios clients over the simulator API + direct resource CRUD; here the
+// simulator server exposes both surfaces, server/server.py).
+"use strict";
+
+async function api(method, path, body) {
+  const resp = await fetch(path, {
+    method,
+    headers: body !== undefined ? { "Content-Type": "application/json" } : {},
+    body: body !== undefined ? JSON.stringify(body) : undefined,
+  });
+  const text = await resp.text();
+  const data = text ? JSON.parse(text) : null;
+  if (!resp.ok) throw new Error((data && data.message) || resp.statusText);
+  return data;
+}
+
+const API = {
+  list: (r) => api("GET", "/api/v1/" + r),
+  create: (r, obj) => api("POST", "/api/v1/" + r, obj),
+  update: (r, obj) => {
+    const ns = obj.metadata.namespace, name = obj.metadata.name;
+    return api("PUT", "/api/v1/" + r + "/" + (ns ? ns + "/" : "") + name, obj);
+  },
+  remove: (r, ns, name) =>
+    api("DELETE", "/api/v1/" + r + "/" + (ns ? ns + "/" : "") + name),
+  getSchedulerConfig: () => api("GET", "/api/v1/schedulerconfiguration"),
+  applySchedulerConfig: (cfg) => api("POST", "/api/v1/schedulerconfiguration", cfg),
+  exportSnapshot: () => api("GET", "/api/v1/export"),
+  importSnapshot: (snap) => api("POST", "/api/v1/import", snap),
+  reset: () => api("PUT", "/api/v1/reset"),
+  scenarios: () => api("GET", "/api/v1/scenarios"),
+  submitScenario: (s) => api("POST", "/api/v1/scenarios", s),
+  metrics: () => api("GET", "/api/v1/metrics"),
+};
+
+// ---- watch stream (web/api/v1/watcher.ts analogue: fetch ReadableStream
+// over /listwatchresources, reference watcher.ts:11-12) ------------------
+function scanJson(s) { // length of first complete top-level JSON object, else 0
+  let depth = 0, inStr = false, esc = false;
+  for (let i = 0; i < s.length; i++) {
+    const c = s[i];
+    if (inStr) {
+      if (esc) esc = false;
+      else if (c === "\\") esc = true;
+      else if (c === '"') inStr = false;
+    } else if (c === '"') inStr = true;
+    else if (c === "{") depth++;
+    else if (c === "}") { depth--; if (depth === 0) return i + 1; }
+  }
+  return 0;
+}
+
+async function watchLoop(onEvent, onBatch, onStatus) {
+  for (;;) {
+    try {
+      const resp = await fetch("/api/v1/listwatchresources");
+      const reader = resp.body.getReader();
+      const dec = new TextDecoder();
+      onStatus(true);
+      let buf = "";
+      for (;;) {
+        const { done, value } = await reader.read();
+        if (done) break;
+        buf += dec.decode(value, { stream: true });
+        let i;
+        while ((i = scanJson(buf)) > 0) {
+          onEvent(JSON.parse(buf.slice(0, i)));
+          buf = buf.slice(i);
+        }
+        onBatch(); // one render per network chunk, not per event
+      }
+    } catch (e) { /* reconnect */ }
+    onStatus(false);
+    await new Promise((r) => setTimeout(r, 1000));
+  }
+}

@@ -7,6 +7,8 @@ PostFilter recording; resultstore/store.go:439-458 annotation shape).
 
 import json
 
+import pytest
+
 from kube_scheduler_simulator_tpu.cluster.store import NotFound, ObjectStore
 from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
 from kube_scheduler_simulator_tpu.store import annotations as ann
@@ -205,3 +207,94 @@ def test_preemption_runs_in_extender_path():
         assert pf == {"n1": {"DefaultPreemption": "preemption victim"}}
     finally:
         httpd.shutdown()
+
+
+def _pdb(name, match_labels, disruptions_allowed, namespace="default"):
+    return {
+        "apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"selector": {"matchLabels": match_labels}},
+        "status": {"disruptionsAllowed": disruptions_allowed},
+    }
+
+
+def _labeled(p, labels):
+    p["metadata"]["labels"] = labels
+    return p
+
+
+def test_pdb_violations_break_candidate_ties():
+    """Two equivalent candidate nodes; the victim on n1 is protected by an
+    exhausted PDB, the one on n2 is not — upstream pickOneNodeForPreemption
+    ranks by fewest PDB violations FIRST, so n2 must win even though node
+    order favors n1."""
+    s = ObjectStore()
+    s.create("nodes", node("n1", cpu="1"))
+    s.create("nodes", node("n2", cpu="1"))
+    s.create("pods", _labeled(pod("guarded", cpu="800m", node_name="n1"),
+                              {"app": "guarded"}))
+    s.create("pods", pod("plain", cpu="800m", node_name="n2"))
+    s.create("poddisruptionbudgets", _pdb("pdb", {"app": "guarded"}, 0))
+    s.create("pods", pod("pri", cpu="500m", priority=10))
+    engine = SchedulerEngine(s)
+    engine.schedule_pending()
+    with pytest.raises(NotFound):
+        s.get("pods", "plain")          # evicted
+    assert s.get("pods", "guarded")     # spared by its budget
+    assert s.get("pods", "pri")["spec"].get("nodeName") == "n2"
+
+
+def test_pdb_with_budget_does_not_count_as_violation():
+    """disruptionsAllowed=1 covers one eviction: no violation recorded, the
+    guarded pod is evictable like any other."""
+    s = ObjectStore()
+    s.create("nodes", node("n1", cpu="1"))
+    s.create("pods", _labeled(pod("guarded", cpu="800m", node_name="n1"),
+                              {"app": "guarded"}))
+    s.create("poddisruptionbudgets", _pdb("pdb", {"app": "guarded"}, 1))
+    s.create("pods", pod("pri", cpu="500m", priority=10))
+    engine = SchedulerEngine(s)
+    engine.schedule_pending()
+    with pytest.raises(NotFound):
+        s.get("pods", "guarded")
+    assert s.get("pods", "pri")["spec"].get("nodeName") == "n1"
+
+
+def test_pdb_reprieve_prefers_sparing_violating_pods():
+    """On one node with two equal victims where only one is PDB-protected,
+    the reprieve pass tries violating pods first — the unprotected pod is
+    the one evicted when evicting either would suffice."""
+    s = ObjectStore()
+    s.create("nodes", node("n1", cpu="2"))
+    s.create("pods", _labeled(pod("guarded", cpu="900m", node_name="n1",
+                                  created="2024-01-01T00:00:00Z"),
+                              {"app": "guarded"}))
+    s.create("pods", pod("plain", cpu="900m", node_name="n1",
+                         created="2024-01-01T00:00:00Z"))
+    s.create("poddisruptionbudgets", _pdb("pdb", {"app": "guarded"}, 0))
+    s.create("pods", pod("pri", cpu="900m", priority=10))
+    engine = SchedulerEngine(s)
+    engine.schedule_pending()
+    with pytest.raises(NotFound):
+        s.get("pods", "plain")
+    assert s.get("pods", "guarded")
+    assert s.get("pods", "pri")["spec"].get("nodeName") == "n1"
+
+
+def test_pdb_filter_split_budget_accounting():
+    """filterPodsWithPDBViolation: the budget is consumed in pod order —
+    with disruptionsAllowed=1 and two matching pods, only the second is
+    violating."""
+    from kube_scheduler_simulator_tpu.framework.preemption import (
+        filter_pods_with_pdb_violation,
+    )
+
+    pods = [
+        _labeled(pod("a"), {"app": "x"}),
+        _labeled(pod("b"), {"app": "x"}),
+        pod("c"),
+    ]
+    violating, ok = filter_pods_with_pdb_violation(
+        pods, [_pdb("pdb", {"app": "x"}, 1)])
+    assert [p["metadata"]["name"] for p in violating] == ["b"]
+    assert [p["metadata"]["name"] for p in ok] == ["a", "c"]

@@ -142,7 +142,14 @@ def test_web_ui_served(server):
         assert resp.status == 200
         assert resp.headers["Content-Type"].startswith("text/html")
         body = resp.read().decode()
-    # the SPA's load-bearing hooks: live watch, result tables, config panel
+    # the SPA loads its modules (api/store/components split like the
+    # reference's web/ layout); fetch them and check load-bearing hooks
+    for asset in ("yaml.js", "api.js", "store.js", "components.js", "app.js"):
+        assert f"/web/{asset}" in body, asset
+        with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/web/{asset}",
+                                    timeout=10) as resp:
+            assert resp.status == 200
+            body += resp.read().decode()
     for needle in ("listwatchresources", "finalscore-result", "schedulerconfiguration",
                    "watchLoop", "api/v1/scenarios"):
         assert needle in body, needle

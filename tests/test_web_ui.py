@@ -1,0 +1,385 @@
+"""Web UI asset serving + the YAML codec algorithm.
+
+No JS runtime exists in this image, so web/yaml.js cannot be executed
+directly; instead `_dump`/`_parse` below are line-for-line Python
+transcriptions of the JS algorithm, validated two ways over a corpus of
+real manifests: (1) round-trip equality, (2) the emitted text parses
+identically under PyYAML (i.e. the format the editor shows is standard
+YAML, so manifests users paste from elsewhere parse the same way).
+"""
+
+import json
+import re
+
+import pytest
+import yaml as pyyaml
+
+from kube_scheduler_simulator_tpu.web import index_html, static_file
+
+# ---------------------------------------------------------------- assets
+
+ASSETS = ["yaml.js", "api.js", "store.js", "components.js", "app.js"]
+
+
+def test_static_assets_exist_and_are_typed():
+    for name in ASSETS:
+        body, ctype = static_file(name)
+        assert body, name
+        assert ctype.startswith("text/javascript")
+
+
+def test_index_references_all_assets():
+    html = index_html().decode()
+    for name in ASSETS:
+        assert f"/web/{name}" in html
+
+
+@pytest.mark.parametrize("bad", [
+    "../__init__.py", "..%2f..%2fetc", ".hidden.js", "sub/dir.js",
+    "index.html", "yaml.py", "missing.js",
+])
+def test_static_rejects_traversal_and_unknown(bad):
+    body, _ = static_file(bad)
+    assert body is None
+
+
+def test_js_brace_balance_smoke():
+    """Crude syntax gate: braces/brackets/parens balance outside strings,
+    comments, and regex-literal contexts."""
+    for name in ASSETS:
+        src, _ = static_file(name)
+        depth = {"{": 0, "[": 0, "(": 0}
+        close = {"}": "{", "]": "[", ")": "("}
+        in_str = None
+        esc = False
+        in_line_comment = in_block_comment = False
+        prev = ""
+        skip_regex = False
+        text = src.decode()
+        for i, c in enumerate(text):
+            nxt = text[i + 1] if i + 1 < len(text) else ""
+            if in_line_comment:
+                if c == "\n":
+                    in_line_comment = False
+            elif in_block_comment:
+                if prev == "*" and c == "/":
+                    in_block_comment = False
+            elif in_str:
+                if esc:
+                    esc = False
+                elif c == "\\":
+                    esc = True
+                elif c == in_str:
+                    in_str = None
+            elif skip_regex:
+                if esc:
+                    esc = False
+                elif c == "\\":
+                    esc = True
+                elif c == "/":
+                    skip_regex = False
+            elif c == "/" and nxt == "/":
+                in_line_comment = True
+            elif c == "/" and nxt == "*":
+                in_block_comment = True
+            elif c == "/" and re.match(r"[=(,:\[!&|?+\n ]", prev or "\n"):
+                skip_regex = True
+            elif c in "\"'`":
+                in_str = c
+            elif c in depth:
+                depth[c] += 1
+            elif c in close:
+                depth[close[c]] -= 1
+                assert depth[close[c]] >= 0, f"{name}: unbalanced {c} at {i}"
+            prev = c
+        assert all(v == 0 for v in depth.values()), f"{name}: {depth}"
+
+
+# ------------------------------------------- YAML algorithm (JS mirror)
+
+PLAIN_OK = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9_./-]*$")
+RESERVED = {"null", "true", "false", "yes", "no", "on", "off"}
+
+
+def _scalar(v):
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return json.dumps(v)
+    s = str(v)
+    if s == "":
+        return '""'
+    if (PLAIN_OK.match(s) and s.lower() not in RESERVED
+            and not re.match(r"^[\d.+-]", s)):
+        return s
+    return json.dumps(s)
+
+
+def _dump(v, indent=0):
+    pad = "  " * indent
+    if isinstance(v, list):
+        if not v:
+            return pad + "[]"
+        out = []
+        for item in v:
+            if isinstance(item, (dict, list)) and len(item):
+                body = _dump(item, indent + 1)
+                out.append(pad + "-" + body[len(pad) + 1:])
+            else:
+                leaf = ("[]" if isinstance(item, list)
+                        else "{}" if isinstance(item, dict) else _scalar(item))
+                out.append(pad + "- " + leaf)
+        return "\n".join(out)
+    if isinstance(v, dict):
+        if not v:
+            return pad + "{}"
+        out = []
+        for k, val in v.items():
+            key = k if PLAIN_OK.match(k) else json.dumps(k)
+            if isinstance(val, (dict, list)) and len(val):
+                out.append(pad + key + ":\n" + _dump(val, indent + 1))
+            elif isinstance(val, str) and "\n" in val:
+                block = "|" if val.endswith("\n") else "|-"
+                body = val[:-1] if val.endswith("\n") else val
+                out.append(pad + key + ": " + block + "\n" + "\n".join(
+                    pad + "  " + line for line in body.split("\n")))
+            else:
+                leaf = ("[]" if isinstance(val, list)
+                        else "{}" if isinstance(val, dict) else _scalar(val))
+                out.append(pad + key + ": " + leaf)
+        return "\n".join(out)
+    return pad + _scalar(v)
+
+
+def dump(v):
+    return _dump(v) + "\n"
+
+
+MAP_RE = re.compile(r'^("(?:[^"\\]|\\.)*"|[^:]+):(?: (.*))?$')
+
+
+def _parse_scalar(tok):
+    tok = tok.strip()
+    if tok in ("", "~", "null"):
+        return None
+    if tok == "true":
+        return True
+    if tok == "false":
+        return False
+    if tok == "[]":
+        return []
+    if tok == "{}":
+        return {}
+    if tok[0] == '"':
+        return json.loads(tok)
+    if tok[0] == "'":
+        return tok[1:-1].replace("''", "'")
+    if tok[0] in "[{":
+        return _parse_flow(tok)
+    if re.match(r"^[+-]?\d+$", tok):
+        return int(tok)
+    if re.match(r"^[+-]?(\d+\.\d*|\.\d+|\d+)([eE][+-]?\d+)?$", tok):
+        return float(tok)
+    return tok
+
+
+def _parse_flow(s):
+    out, word = "", ""
+    in_str = esc = False
+
+    def flush(word, out):
+        w = word.strip()
+        if w:
+            out += json.dumps(_parse_scalar(w))
+        return out
+
+    for c in s:
+        if in_str:
+            out += c
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = False
+        elif c == '"':
+            out = flush(word, out)
+            word = ""
+            out += c
+            in_str = True
+        elif c in "[]{},:":
+            out = flush(word, out)
+            word = ""
+            out += c
+        else:
+            word += c
+    out = flush(word, out)
+    return json.loads(out)
+
+
+def parse(text):
+    lines = [l for l in text.split("\n")
+             if not re.match(r"^\s*(#|$)", l) and l.strip() != "---"]
+    pos = [0]
+
+    def indent_of(line):
+        return len(line) - len(line.lstrip(" "))
+
+    def parse_block(min_indent):
+        if pos[0] >= len(lines):
+            return None
+        ind = indent_of(lines[pos[0]])
+        if ind < min_indent:
+            return None
+        t = lines[pos[0]].strip()
+        if t.startswith("- ") or t == "-":
+            return parse_seq(ind)
+        return parse_map(ind)
+
+    def literal_block(parent_indent, keep_newline):
+        body, block_ind = [], None
+        while pos[0] < len(lines):
+            line = lines[pos[0]]
+            if line.strip() == "":
+                body.append("")
+                pos[0] += 1
+                continue
+            ind = indent_of(line)
+            if ind <= parent_indent:
+                break
+            if block_ind is None:
+                block_ind = ind
+            body.append(line[block_ind:])
+            pos[0] += 1
+        while body and body[-1] == "":
+            body.pop()
+        return "\n".join(body) + ("\n" if keep_newline else "")
+
+    def parse_map(ind):
+        obj = {}
+        while pos[0] < len(lines):
+            line = lines[pos[0]]
+            if line.strip() == "":
+                pos[0] += 1
+                continue
+            if indent_of(line) != ind:
+                break
+            m = MAP_RE.match(line.strip())
+            if not m:
+                raise ValueError("bad mapping line: " + line.strip())
+            key = json.loads(m.group(1)) if m.group(1)[0] == '"' else m.group(1).strip()
+            rest = (m.group(2) or "").strip()
+            pos[0] += 1
+            if rest in ("|", "|-"):
+                obj[key] = literal_block(ind, rest == "|")
+            elif rest == "":
+                obj[key] = parse_block(ind + 1)
+            else:
+                obj[key] = _parse_scalar(rest)
+        return obj
+
+    def parse_seq(ind):
+        arr = []
+        while pos[0] < len(lines):
+            line = lines[pos[0]]
+            if line.strip() == "":
+                pos[0] += 1
+                continue
+            t = line.strip()
+            if indent_of(line) != ind or not (t.startswith("- ") or t == "-"):
+                break
+            rest = "" if t == "-" else t[2:]
+            if rest == "":
+                pos[0] += 1
+                arr.append(parse_block(ind + 1))
+            elif (re.match(r'^"(?:[^"\\]|\\.)*":(?: .*)?$', rest)
+                  if rest[0] == '"' else
+                  (not re.match(r"^['\[{]", rest)
+                   and re.match(r"^[^:]+:(?: .*)?$", rest))):
+                item_indent = ind + 2
+                lines[pos[0]] = " " * item_indent + rest
+                arr.append(parse_map(item_indent))
+            else:
+                pos[0] += 1
+                arr.append(_parse_scalar(rest))
+        return arr
+
+    v = parse_block(0)
+    if pos[0] < len(lines):
+        raise ValueError("unparsed content at line: " + lines[pos[0]].strip())
+    return v
+
+
+def _corpus():
+    from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+    from kube_scheduler_simulator_tpu.scheduler.convert import default_scheduler_config
+
+    cases = [
+        {"kind": "Pod", "apiVersion": "v1",
+         "metadata": {"name": "p", "namespace": "default",
+                      "labels": {"app.kubernetes.io/name": "x"},
+                      "annotations": {"kube-scheduler-simulator.sigs.k8s.io/filter-result": '{"n":{"P":"passed"}}'}},
+         "spec": {"containers": [{"name": "c", "image": "nginx:1.25",
+                                  "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}}}],
+                  "nodeSelector": {}, "tolerations": []}},
+        {"empty_map": {}, "empty_list": [], "null_v": None, "b": True,
+         "f": 1.5, "neg": -3, "colon": "a: b", "hash": "#notcomment",
+         "multiline": "line1\nline2\n", "no_trail": "a\nb",
+         "reserved": "true", "numstr": "0755",
+         "tricky_list": ["x: y", {"a:b": 1}, {"plain": "v"}]},
+        make_nodes(3, seed=9, taint_fraction=0.5),
+        make_pods(4, seed=10, with_affinity=True, with_tolerations=True,
+                  with_spread=True, with_interpod=True),
+        default_scheduler_config(),
+    ]
+    return cases
+
+
+@pytest.mark.parametrize("i,case", list(enumerate(_corpus())))
+def test_yaml_roundtrip_and_pyyaml_compat(i, case):
+    text = dump(case)
+    assert parse(text) == case, f"case {i}: mirror round-trip"
+    assert pyyaml.safe_load(text) == case, f"case {i}: standard-YAML compat"
+    # dump is deterministic / normal-form stable
+    assert dump(parse(text)) == text
+
+
+def test_yaml_parse_handwritten_manifest():
+    text = """\
+# a hand-written manifest with flow styles and comments
+kind: Pod
+apiVersion: v1
+metadata:
+  name: demo
+  namespace: team-a
+spec:
+  containers:
+    - name: c
+      image: "nginx:1.25"
+      ports: [{containerPort: 80}]
+  nodeSelector: {zone: z1}
+  priority: 1000
+"""
+    obj = parse(text)
+    assert obj["spec"]["containers"][0]["image"] == "nginx:1.25"
+    assert obj["spec"]["containers"][0]["ports"] == [{"containerPort": 80}]
+    assert obj["spec"]["nodeSelector"] == {"zone": "z1"}
+    assert obj["spec"]["priority"] == 1000
+    assert obj == pyyaml.safe_load(text)
+
+
+def test_mirror_matches_js_source_expectations():
+    """Spot-check that the JS source encodes the same special cases the
+    mirror implements (guards against the transcription drifting)."""
+    src, _ = static_file("yaml.js")
+    js = src.decode()
+    for marker in [
+        'PLAIN_OK = /^[A-Za-z0-9_][A-Za-z0-9_.\\/-]*$/',
+        '"null", "true", "false", "yes", "no", "on", "off"',
+        '/^[\\d.+-]/',
+        '/^("(?:[^"\\\\]|\\\\.)*"|[^:]+):(?: (.*))?$/',
+        'val.endsWith("\\n") ? "|" : "|-"',
+    ]:
+        assert marker in js, f"yaml.js drifted from mirror: {marker!r} missing"
