@@ -187,25 +187,35 @@ def build(table: NodeTable, pods: list[dict]):
         filter_skip=jnp.asarray(filter_skip),
         score_skip=jnp.asarray(score_skip),
     )
-    init_counts = jnp.zeros((n_groups, d_max), dtype=jnp.int64)
-    return static, xs, init_counts
+    counts_dom = np.zeros((n_groups, d_max), dtype=np.int64)
+    return static, xs, counts_dom
+
+
+def assemble_counts(static: SpreadStatic, counts_dom: np.ndarray) -> jnp.ndarray:
+    """[C, D] domain-space counts (build + host priming) -> node-space
+    [C, N] int32 device carry (value at each node's domain, 0 where the
+    node lacks the key).  Node-space keeps the scan step free of the
+    TPU-hostile per-step gathers and scatters — see the InterPodCarry
+    docstring for the measured effect of the same transformation."""
+    dom = np.asarray(static.dom_idx)
+    vals = np.take_along_axis(counts_dom, np.maximum(dom, 0), axis=1)
+    return jnp.asarray(np.where(dom >= 0, vals, 0).astype(np.int32))
 
 
 def _per_constraint(static: SpreadStatic, pod, counts, m):
-    """Gathered quantities for constraint slot m: (active, dom[N], cnt[N], min_match)."""
+    """Per-constraint-slot quantities: (active, has_key[N], cnt[N], min_match).
+
+    counts is node-space [C, N]; min-over-present-domains equals the min
+    over eligible keyed NODES of the node-space counts (every present
+    domain is represented by at least one eligible node)."""
     cid = pod.c_id[m]
     active = cid >= 0
     c = jnp.maximum(cid, 0)
     dom = static.dom_idx[c]                      # [N]
     has_key = dom >= 0
-    counts_row = counts[c]                       # [D]
-    cnt = jnp.where(has_key, counts_row[jnp.maximum(dom, 0)], 0)
-    # domains present among eligible nodes
-    d = counts_row.shape[0]
-    present = jnp.zeros(d, dtype=bool).at[jnp.where(has_key & pod.eligible, dom, d - 1)].max(
-        has_key & pod.eligible
-    )
-    min_match = jnp.min(jnp.where(present, counts_row, _BIG))
+    cnt = counts[c]                              # [N] (0 where key missing)
+    min_match = jnp.min(
+        jnp.where(has_key & pod.eligible, cnt.astype(jnp.int64), _BIG))
     return active, has_key, cnt, min_match
 
 
@@ -251,15 +261,14 @@ def normalize(raw, ignored, feasible):
 
 
 def bind_update(static: SpreadStatic, pod, counts, sel):
-    """counts[c, dom_idx[c, sel]] += pm[c] for a bound pod (sel >= 0)."""
+    """Node-space bind: every node sharing the selected node's domain (per
+    group) takes the pm[c] increment — elementwise, no scatter."""
     bound = sel >= 0
     s = jnp.maximum(sel, 0)
-    dom = static.dom_idx[:, s]                      # [C]
-    inc = (pod.pm & bound & (dom >= 0)).astype(counts.dtype)
-    d = counts.shape[1]
-    safe_dom = jnp.where(dom >= 0, dom, d - 1)
-    inc = jnp.where(dom >= 0, inc, 0)
-    return counts.at[jnp.arange(counts.shape[0]), safe_dom].add(inc)
+    dom_col = static.dom_idx[:, s]                  # [C]
+    valid = bound & (dom_col >= 0) & pod.pm         # [C]
+    same = (static.dom_idx == dom_col[:, None]) & valid[:, None]  # [C, N]
+    return counts + same.astype(counts.dtype)
 
 
 def decode_filter(code: int, node_idx: int, host_aux) -> str:

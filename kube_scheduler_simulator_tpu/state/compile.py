@@ -171,11 +171,11 @@ def compile_workload(
     if "NodeName" in enabled:
         xs["NodeName"] = taints.build_nodename(table, pods)
     if "PodTopologySpread" in enabled:
-        st, x, counts = topologyspread.build(table, pods)
+        st, x, counts_dom = topologyspread.build(table, pods)
         statics["PodTopologySpread"] = st
         xs["PodTopologySpread"] = x
-        counts = _prime_spread_counts(counts, st, pods, bound_pods, name_idx)
-        init_carry["PodTopologySpread"] = counts
+        _prime_spread_counts(counts_dom, st, pods, bound_pods, name_idx)
+        init_carry["PodTopologySpread"] = topologyspread.assemble_counts(st, counts_dom)
     if any(name in enabled for name in VOLUME_PLUGINS):
         vt = build_volume_table(
             table, volumes.get("pvcs"), volumes.get("pvs"),
@@ -267,13 +267,13 @@ def _missing_pvc_message(vt, pod: dict) -> str | None:
     return None
 
 
-def _prime_spread_counts(counts, st, pods, bound_pods, name_idx):
-    """Fold already-bound pods into the per-domain match counts."""
+def _prime_spread_counts(counts_dom, st, pods, bound_pods, name_idx):
+    """Fold already-bound pods into the domain-space match counts (in
+    place; topologyspread.assemble_counts converts to node space after)."""
     if not bound_pods:
-        return counts
+        return
     from ..state.selectors import label_selector_matches
 
-    counts = np.asarray(counts).copy()
     dom_idx = np.asarray(st.dom_idx)
     # group selectors were interned during build; recompute matches for the
     # bound pods (they are not part of the queue, so not in x.pm)
@@ -286,8 +286,7 @@ def _prime_spread_counts(counts, st, pods, bound_pods, name_idx):
         labels = {k: str(v) for k, v in ((bp.get("metadata") or {}).get("labels") or {}).items()}
         for c_id, (gns, _, sel) in enumerate(groups):
             if gns == ns and label_selector_matches(sel, labels) and dom_idx[c_id, j] >= 0:
-                counts[c_id, dom_idx[c_id, j]] += 1
-    return jnp.asarray(counts)
+                counts_dom[c_id, dom_idx[c_id, j]] += 1
 
 
 def _spread_groups(pods):
