@@ -355,8 +355,11 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
                     "build_phased directly")
     # widening ladder: narrow groups -> int32 -> int64 (a raw overflowing
     # its group dtype triggers the next tier; int64 is the upstream score
-    # type and cannot overflow)
-    for wide in (None, "i32", "i64"):
+    # type and cannot overflow).  A compile-time-proven beyond-int32 bound
+    # skips straight to i64.
+    tiers = (("i64",) if "i64" in cw.host.get("score_dtypes", ())
+             else (None, "i32", "i64"))
+    for wide in tiers:
         result = _replay_run(cw, chunk, collect, unroll, mesh, wide=wide)
         if result is not None:
             return result

@@ -54,7 +54,7 @@ def _build_and_load():
     lib.encode_score_result.restype = ctypes.c_void_p
     lib.encode_score_result.argtypes = [
         ctypes.c_int32, ctypes.c_int32,
-        P(ctypes.c_int32), P(ctypes.c_uint8), P(ctypes.c_uint8),
+        P(ctypes.c_int64), P(ctypes.c_uint8), P(ctypes.c_uint8),
         P(ctypes.c_char_p), P(ctypes.c_char_p),
         P(ctypes.c_int32), P(ctypes.c_int32),
     ]

@@ -137,10 +137,11 @@ char* encode_filter_result(
 }
 
 // score-result / finalscore-result: {"node":{"Plugin":"<int>",...},...}
-// over feasible nodes only; plugins with sskip are omitted.
+// over feasible nodes only; plugins with sskip are omitted.  Values are
+// int64 (upstream node scores are int64; custom plugins can exceed int32).
 char* encode_score_result(
     int32_t n, int32_t s,
-    const int32_t* values,           // [S*N]
+    const int64_t* values,           // [S*N]
     const uint8_t* sskip,            // [S]
     const uint8_t* feasible,         // [N]
     const char* const* node_names,
@@ -170,8 +171,9 @@ char* encode_score_result(
             first_sc = false;
             append_escaped(out, score_names[q]);
             out.push_back(':');
-            char buf[16];
-            snprintf(buf, sizeof buf, "\"%d\"", values[(size_t)q * n + j]);
+            char buf[32];
+            snprintf(buf, sizeof buf, "\"%lld\"",
+                     (long long)values[(size_t)q * n + j]);
             out += buf;
         }
         out.push_back('}');

@@ -381,6 +381,26 @@ def _score_dtype(cw: CompiledWorkload, name: str) -> str:
         # raw = count of intolerable PreferNoSchedule taints on the node
         if max((len(t) for t in cw.node_table.taints), default=0) <= 127:
             return "i8"
+        return "i16"
+    # raws that are fully precompiled per (pod, node) have an exact
+    # compile-time bound (the kernels just emit the row)
+    x = cw.xs.get(name)
+    rows = None
+    if name == "NodeAffinity" and x is not None:
+        rows = x.pref_raw
+    elif cw.config.is_custom(name) and x is not None and hasattr(x, "scores"):
+        rows = x.scores
+    if rows is not None:
+        bound = int(np.abs(np.asarray(rows)).max(initial=0))
+        if bound <= 0x7F:
+            return "i8"
+        if bound <= 0x7FFF:
+            return "i16"
+        if bound <= 0x7FFFFFFF:
+            return "i32"
+        return "i64"  # replay starts its ladder at i64 directly
+    # dynamic raws (PodTopologySpread, InterPodAffinity): optimistic i16,
+    # the replay's widening ladder covers overflow
     return "i16"
 
 

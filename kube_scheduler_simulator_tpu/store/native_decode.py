@@ -141,6 +141,10 @@ def _i32p(a):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
 
 
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
 def _u8p(a):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
@@ -161,12 +165,12 @@ def encode_filter(ctx, codes: np.ndarray, active: np.ndarray) -> str:
 
 def encode_scores(ctx, values: np.ndarray, sskip: np.ndarray, feasible: np.ndarray) -> str:
     lib = ctx["lib"]
-    values = np.ascontiguousarray(values, dtype=np.int32)
+    values = np.ascontiguousarray(values, dtype=np.int64)
     sskip = np.ascontiguousarray(sskip, dtype=np.uint8)
     feasible = np.ascontiguousarray(feasible, dtype=np.uint8)
     ptr = lib.encode_score_result(
         ctx["n"], values.shape[0],
-        _i32p(values), _u8p(sskip), _u8p(feasible),
+        _i64p(values), _u8p(sskip), _u8p(feasible),
         ctx["node_names"], ctx["score_names"],
         _i32p(ctx["sorted_nodes"]), _i32p(ctx["sorted_scores"]),
     )

@@ -1,0 +1,145 @@
+"""Table-driven config-conversion tests.
+
+Modeled on the reference's deepest config tables: mergePluginSet
+(reference: simulator/scheduler/plugin/plugins.go:230-285, exercised by
+plugins_test.go) and ConvertConfigurationForSimulator
+(scheduler/scheduler.go:141-173, scheduler_test.go:24-80).
+"""
+
+import pytest
+
+from kube_scheduler_simulator_tpu.scheduler.convert import (
+    _merge_plugin_set,
+    convert_configuration_for_simulator,
+    default_scheduler_config,
+    parse_profiles,
+)
+
+DEFAULTS = {"enabled": [{"name": "A", "weight": 1}, {"name": "B"},
+                        {"name": "C", "weight": 3}]}
+
+MERGE_TABLE = [
+    # (name, default_set, custom_set, expected enabled names, expected weights)
+    ("no customization keeps defaults",
+     DEFAULTS, {}, ["A", "B", "C"], {"A": 1, "C": 3}),
+    ("disable one default",
+     DEFAULTS, {"disabled": [{"name": "B"}]}, ["A", "C"], {}),
+    ("disable star drops all defaults",
+     DEFAULTS, {"disabled": [{"name": "*"}], "enabled": [{"name": "X"}]},
+     ["X"], {}),
+    ("custom replaces same-named default in place",
+     DEFAULTS, {"enabled": [{"name": "B", "weight": 9}]},
+     ["A", "B", "C"], {"B": 9}),
+    ("new custom plugin appends after defaults",
+     DEFAULTS, {"enabled": [{"name": "X", "weight": 2}]},
+     ["A", "B", "C", "X"], {"X": 2}),
+    ("replacement and append together",
+     DEFAULTS, {"enabled": [{"name": "C", "weight": 7}, {"name": "X"}]},
+     ["A", "B", "C", "X"], {"C": 7}),
+    ("disabled default plus custom enable of another",
+     DEFAULTS, {"disabled": [{"name": "A"}], "enabled": [{"name": "X"}]},
+     ["B", "C", "X"], {}),
+    ("custom enable of a disabled name still appends",
+     # upstream: disabled suppresses the DEFAULT entry; the custom enabled
+     # list is honored independently
+     DEFAULTS, {"disabled": [{"name": "B"}], "enabled": [{"name": "B", "weight": 5}]},
+     ["A", "C", "B"], {"B": 5}),
+]
+
+
+@pytest.mark.parametrize("name,dset,cset,want,weights", MERGE_TABLE,
+                         ids=[t[0] for t in MERGE_TABLE])
+def test_merge_plugin_set(name, dset, cset, want, weights):
+    out = _merge_plugin_set(dset, cset)
+    got = [p["name"] for p in out["enabled"]]
+    assert got == want
+    for n, w in weights.items():
+        assert next(p for p in out["enabled"] if p["name"] == n)["weight"] == w
+
+
+def test_merge_does_not_mutate_inputs():
+    dset = {"enabled": [{"name": "A", "weight": 1}]}
+    cset = {"enabled": [{"name": "A", "weight": 9}]}
+    out = _merge_plugin_set(dset, cset)
+    out["enabled"][0]["weight"] = 42
+    assert dset["enabled"][0]["weight"] == 1
+    assert cset["enabled"][0]["weight"] == 9
+
+
+# ------------------------------------------------- conversion tables
+
+def _mp(cfg, profile=0):
+    return cfg["profiles"][profile]["plugins"]["multiPoint"]
+
+
+def test_convert_empty_config_wraps_full_default_lineup():
+    cfg = convert_configuration_for_simulator({})
+    default_names = [
+        p["name"] for p in
+        default_scheduler_config()["profiles"][0]["plugins"]["multiPoint"]["enabled"]
+    ]
+    got = [p["name"] for p in _mp(cfg)["enabled"]]
+    assert got == [n + "Wrapped" for n in default_names]
+    assert _mp(cfg)["disabled"] == [{"name": "*"}]
+
+
+def test_convert_preserves_weights_through_wrapping():
+    cfg = convert_configuration_for_simulator({"profiles": [{
+        "plugins": {"multiPoint": {"enabled": [
+            {"name": "NodeAffinity", "weight": 11},
+        ]}},
+    }]})
+    na = next(p for p in _mp(cfg)["enabled"] if p["name"] == "NodeAffinityWrapped")
+    assert na["weight"] == 11
+
+
+def test_convert_each_extension_point_wrapped():
+    cfg = convert_configuration_for_simulator({"profiles": [{
+        "plugins": {
+            "filter": {"enabled": [{"name": "NodeName"}]},
+            "score": {"enabled": [{"name": "ImageLocality", "weight": 4}],
+                      "disabled": [{"name": "TaintToleration"}]},
+        },
+    }]})
+    plugins = cfg["profiles"][0]["plugins"]
+    assert plugins["filter"]["enabled"] == [{"name": "NodeNameWrapped"}]
+    assert plugins["score"]["enabled"] == [{"name": "ImageLocalityWrapped", "weight": 4}]
+    assert {"name": "TaintTolerationWrapped"} in plugins["score"]["disabled"]
+
+
+def test_convert_multiple_profiles_independently():
+    cfg = convert_configuration_for_simulator({"profiles": [
+        {"schedulerName": "a", "plugins": {"multiPoint": {
+            "enabled": [{"name": "NodeResourcesFit", "weight": 2}]}}},
+        {"schedulerName": "b", "plugins": {"multiPoint": {
+            "disabled": [{"name": "*"}],
+            "enabled": [{"name": "TaintToleration", "weight": 6}]}}},
+    ]})
+    a = [p["name"] for p in _mp(cfg, 0)["enabled"]]
+    b = [p["name"] for p in _mp(cfg, 1)["enabled"]]
+    assert "NodeResourcesFitWrapped" in a and len(a) > 1  # merged with defaults
+    assert b == ["TaintTolerationWrapped"]                # star-disabled defaults
+
+
+def test_convert_keeps_scheduler_names_and_extenders():
+    cfg = convert_configuration_for_simulator({
+        "profiles": [{"schedulerName": "custom-sched"}],
+        "extenders": [{"urlPrefix": "http://e1", "filterVerb": "filter"}],
+    })
+    assert cfg["profiles"][0]["schedulerName"] == "custom-sched"
+    assert cfg["extenders"][0]["urlPrefix"] == "http://e1"
+
+
+def test_parse_profiles_routes_by_scheduler_name():
+    profiles = parse_profiles({"profiles": [
+        {"schedulerName": "a", "plugins": {"multiPoint": {
+            "disabled": [{"name": "*"}],
+            "enabled": [{"name": "NodeResourcesFit"}]}}},
+        {"schedulerName": "b", "plugins": {"multiPoint": {
+            "disabled": [{"name": "*"}],
+            "enabled": [{"name": "NodeResourcesFit"},
+                        {"name": "TaintToleration", "weight": 9}]}}},
+    ]})
+    assert set(profiles) == {"a", "b"}
+    assert profiles["a"].enabled == ["NodeResourcesFit"]
+    assert profiles["b"].weight("TaintToleration") == 9

@@ -173,3 +173,37 @@ def test_extender_duration_and_nodes_response():
     assert abs(c.timeout - 0.1) < 1e-9
     assert parse_duration_seconds("1m30s") == 90.0
     assert parse_duration_seconds(2) == 2.0
+
+
+class HugeScorer(CustomPlugin):
+    """Scores beyond int32 (upstream node scores are int64): the compact
+    replay must pick the i64 transfer tier straight from the compile-time
+    bound instead of rediscovering the overflow at runtime."""
+
+    name = "HugeScorer"
+    default_weight = 1
+
+    def score(self, pod, node):
+        return (1 << 33) + int(node["metadata"]["name"].rsplit("-", 1)[1])
+
+
+def test_custom_scores_beyond_int32_round_trip():
+    nodes = make_nodes(4, seed=30)
+    pods = make_pods(3, seed=31)
+    cfg = PluginSetConfig(
+        enabled=["NodeResourcesFit", "HugeScorer"],
+        custom={"HugeScorer": HugeScorer()},
+    )
+    cw = compile_workload(nodes, pods, cfg)
+    assert "i64" in cw.host["score_dtypes"]
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr = replay(cw, chunk=4)
+    for i, (sa, ss) in enumerate(seq):
+        da = decode_pod_result(rr, i)
+        assert int(rr.selected[i]) == ss
+        for k in sa:
+            assert da[k] == sa[k], f"pod {i} {k}"
+    # the huge raw survives the transfer exactly
+    sr = json.loads(seq[0][0][ann.SCORE_RESULT])
+    assert any(int(v["HugeScorer"]) > (1 << 33) - 1
+               for v in sr.values())
