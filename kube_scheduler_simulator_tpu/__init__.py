@@ -47,13 +47,18 @@ import os as _os
 if not _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
     from pathlib import Path as _Path
 
-    _cache = _Path(__file__).resolve().parent.parent / ".jax_cache"
-    try:
-        _cache.mkdir(exist_ok=True)
-        _jax.config.update("jax_compilation_cache_dir", str(_cache))
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-    except Exception:
-        pass
+    _parent = _Path(__file__).resolve().parent.parent
+    # only for checkout/editable installs (repo marker present) — a
+    # site-packages install must not grow a cache dir the package manager
+    # doesn't own; set JAX_COMPILATION_CACHE_DIR there instead
+    if (_parent / ".git").exists() or (_parent / "bench.py").exists():
+        _cache = _parent / ".jax_cache"
+        try:
+            _cache.mkdir(exist_ok=True)
+            _jax.config.update("jax_compilation_cache_dir", str(_cache))
+            _jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        except Exception:
+            pass
 
 __version__ = "0.1.0"
 

@@ -391,7 +391,9 @@ def _score_dtype(cw: CompiledWorkload, name: str) -> str:
     elif cw.config.is_custom(name) and x is not None and hasattr(x, "scores"):
         rows = x.scores
     if rows is not None:
-        bound = int(np.abs(np.asarray(rows)).max(initial=0))
+        a = np.asarray(rows)
+        # NOT np.abs: |int_min| overflows to a negative bound
+        bound = max(int(a.max(initial=0)), -int(a.min(initial=0)))
         if bound <= 0x7F:
             return "i8"
         if bound <= 0x7FFF:
