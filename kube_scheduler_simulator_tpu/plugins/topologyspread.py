@@ -27,11 +27,18 @@ topology key are ignored (score 0 after normalize).  NormalizeScore:
 score = 100 * (max + min - s) / max over scored feasible nodes, 100 for
 all when max == 0.
 
-Round-1 simplifications (documented in docs/SEMANTICS.md): minDomains,
-matchLabelKeys, nodeAffinityPolicy/nodeTaintsPolicy knobs and
+Modeled knobs: matchLabelKeys (merged into the selector per incoming pod,
+effective_constraints), minDomains (global minimum forced to 0 when fewer
+eligible domains exist), nodeAffinityPolicy (default Honor) and
+nodeTaintsPolicy (default Ignore) for the min-match domain eligibility.
+Remaining simplifications (documented in docs/SEMANTICS.md):
 system-default constraints derived from service/replicaset owners are not
-yet modeled; #domains for the normalizing weight is computed over all
-nodes with the key rather than the affinity-filtered subset.
+modeled; the inclusion policies filter the min-match DOMAIN set but not
+the per-domain pod counting (upstream also excludes filtered-out nodes'
+pods from TpPairToMatchNum — differs only on clusters where some nodes of
+a domain are excluded while others aren't); #domains for the normalizing
+weight is computed over all nodes with the key rather than the
+affinity-filtered subset.
 """
 
 from __future__ import annotations
@@ -71,13 +78,70 @@ class SpreadXS(NamedTuple):
     is_filter: jnp.ndarray   # [P, MC] bool (DoNotSchedule)
     is_score: jnp.ndarray    # [P, MC] bool (ScheduleAnyway)
     weight: jnp.ndarray      # [P, MC] float64 (topologyNormalizingWeight)
-    eligible: jnp.ndarray    # [P, N] bool (node matches pod's selector/affinity)
+    eligible: jnp.ndarray    # [P, N] bool (node matches pod's selector/
+    #   affinity; [P, MC, N] when any constraint sets a non-default
+    #   nodeAffinityPolicy/nodeTaintsPolicy — per-slot inclusion)
+    md_unsat: jnp.ndarray    # [P, MC] bool — minDomains unsatisfied: fewer
+    #   eligible domains than spec.minDomains -> global minimum becomes 0
     filter_skip: jnp.ndarray  # [P] bool
     score_skip: jnp.ndarray   # [P] bool
 
 
 def _pod_constraints(pod: dict) -> list[dict]:
     return (pod.get("spec") or {}).get("topologySpreadConstraints") or []
+
+
+def effective_constraints(pod: dict) -> list[dict]:
+    """The pod's first MAX_CONSTRAINTS topologySpreadConstraints with
+    matchLabelKeys merged into the labelSelector as In-expressions
+    (upstream enableMatchLabelKeysInPodTopologySpread, on by default since
+    1.27: keys the incoming pod doesn't carry are skipped).  Used by BOTH
+    the tensor build and the sequential oracle so group interning, counts
+    and self-match all see the same selector."""
+    meta = pod.get("metadata") or {}
+    pod_labels = {k: str(v) for k, v in (meta.get("labels") or {}).items()}
+    out = []
+    for c in _pod_constraints(pod)[:MAX_CONSTRAINTS]:
+        keys = c.get("matchLabelKeys") or []
+        extra = [
+            {"key": k, "operator": "In", "values": [pod_labels[k]]}
+            for k in keys if k in pod_labels
+        ]
+        if extra:
+            sel = dict(c.get("labelSelector") or {})
+            sel["matchExpressions"] = list(sel.get("matchExpressions") or []) + extra
+            c = dict(c, labelSelector=sel)
+        out.append(c)
+    return out
+
+
+def _intern_groups(pods: list[dict]):
+    """(group_list, per_pod_slots): unique (namespace, topologyKey,
+    selector) count groups over the workload's effective constraints in
+    first-seen order, plus each pod's [(group_id, constraint)] slots.
+    The single interning implementation behind both build() and the
+    engine's bound-pod priming."""
+    groups: dict[tuple, int] = {}
+    group_list: list[tuple[str, str, dict | None]] = []
+    per_pod: list[list[tuple[int, dict]]] = []
+    for pod in pods:
+        ns = (pod.get("metadata") or {}).get("namespace") or "default"
+        slots = []
+        for c in effective_constraints(pod):
+            sel = c.get("labelSelector")
+            gk = (ns, c.get("topologyKey", ""), json.dumps(sel, sort_keys=True))
+            if gk not in groups:
+                groups[gk] = len(group_list)
+                group_list.append((ns, c.get("topologyKey", ""), sel))
+            slots.append((groups[gk], c))
+        per_pod.append(slots)
+    return group_list, per_pod
+
+
+def constraint_groups(pods: list[dict]) -> list[tuple[str, str, dict | None]]:
+    """The group-id space shared by build(), the engine's bound-pod
+    priming (state/compile.py), and the carry layout."""
+    return _intern_groups(pods)[0]
 
 
 def _node_affinity_eligible(pod: dict, labels: list[dict], names: list[str]) -> np.ndarray:
@@ -100,26 +164,27 @@ def _node_affinity_eligible(pod: dict, labels: list[dict], names: list[str]) -> 
     return out
 
 
+def _taints_tolerated_row(pod: dict, table: NodeTable) -> np.ndarray:
+    """nodeTaintsPolicy Honor: a node is excluded when it carries a
+    NoSchedule/NoExecute taint the incoming pod doesn't tolerate
+    (upstream helper.DoNotScheduleTaintsFilterFunc)."""
+    from ..state.selectors import has_untolerated_do_not_schedule_taint
+
+    tols = (pod.get("spec") or {}).get("tolerations") or []
+    return np.asarray([
+        not has_untolerated_do_not_schedule_taint(table.taints[j], tols)
+        for j in range(table.n)
+    ], dtype=bool)
+
+
 def build(table: NodeTable, pods: list[dict]):
     labels = table.labels
     n, p = table.n, len(pods)
 
-    # --- collect unique count groups over the whole workload -------------
-    groups: dict[tuple, int] = {}  # (ns, key, selector_json) -> c_id
-    group_list: list[tuple[str, str, dict]] = []
-    per_pod: list[list[tuple[int, dict]]] = []
-    for pod in pods:
-        ns = (pod.get("metadata") or {}).get("namespace") or "default"
-        slots = []
-        for c in _pod_constraints(pod)[:MAX_CONSTRAINTS]:
-            sel = c.get("labelSelector")
-            gk = (ns, c.get("topologyKey", ""), json.dumps(sel, sort_keys=True))
-            if gk not in groups:
-                groups[gk] = len(group_list)
-                group_list.append((ns, c.get("topologyKey", ""), sel))
-            slots.append((groups[gk], c))
-        per_pod.append(slots)
-
+    # unique count groups + per-pod slots over the effective constraints
+    # (single interning implementation — the engine's bound-pod priming
+    # reads the same group-id space via constraint_groups)
+    group_list, per_pod = _intern_groups(pods)
     n_groups = max(len(group_list), 1)
 
     # --- domain indexing per group key -----------------------------------
@@ -148,23 +213,42 @@ def build(table: NodeTable, pods: list[dict]):
     is_filter = np.zeros((p, MAX_CONSTRAINTS), dtype=bool)
     is_score = np.zeros((p, MAX_CONSTRAINTS), dtype=bool)
     weight = np.zeros((p, MAX_CONSTRAINTS), dtype=np.float64)
-    eligible = np.ones((p, n), dtype=bool)
+    md_unsat = np.zeros((p, MAX_CONSTRAINTS), dtype=bool)
     filter_skip = np.ones(p, dtype=bool)
     score_skip = np.ones(p, dtype=bool)
-    eligible_rows: dict[str, np.ndarray] = {}  # unique selector spec -> [N]
+    # non-default nodeAffinityPolicy/nodeTaintsPolicy make inclusion
+    # per-constraint -> the eligible tensor grows a slot axis
+    per_slot_eligibility = any(
+        (c.get("nodeAffinityPolicy") or "Honor") != "Honor"
+        or (c.get("nodeTaintsPolicy") or "Ignore") != "Ignore"
+        for slots in per_pod for _, c in slots
+    )
+    eligible = (np.ones((p, MAX_CONSTRAINTS, n), dtype=bool)
+                if per_slot_eligibility else np.ones((p, n), dtype=bool))
+    eligible_rows: dict[str, np.ndarray] = {}  # unique inclusion spec -> [N]
+
+    def slot_eligible_row(pod: dict, c: dict) -> np.ndarray:
+        aff_policy = c.get("nodeAffinityPolicy") or "Honor"
+        taint_policy = c.get("nodeTaintsPolicy") or "Ignore"
+        pspec = pod.get("spec") or {}
+        ek = spec_key(
+            aff_policy, taint_policy,
+            (pspec.get("nodeSelector") or {}) if aff_policy == "Honor" else None,
+            (((pspec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
+                "requiredDuringSchedulingIgnoredDuringExecution")
+            if aff_policy == "Honor" else None,
+            (pspec.get("tolerations") or []) if taint_policy == "Honor" else None,
+        )
+        row = eligible_rows.get(ek)
+        if row is None:
+            row = (_node_affinity_eligible(pod, labels, table.names)
+                   if aff_policy == "Honor" else np.ones(n, dtype=bool))
+            if taint_policy == "Honor":
+                row = row & _taints_tolerated_row(pod, table)
+            eligible_rows[ek] = row
+        return row
+
     for i, slots in enumerate(per_pod):
-        if any(c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule" for _, c in slots):
-            pspec = pods[i].get("spec") or {}
-            ek = spec_key(
-                pspec.get("nodeSelector") or {},
-                (((pspec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
-                    "requiredDuringSchedulingIgnoredDuringExecution"),
-            )
-            row = eligible_rows.get(ek)
-            if row is None:
-                row = _node_affinity_eligible(pods[i], labels, table.names)
-                eligible_rows[ek] = row
-            eligible[i] = row
         for m, (cid, c) in enumerate(slots):
             c_id_arr[i, m] = cid
             max_skew[i, m] = int(c.get("maxSkew", 1))
@@ -172,6 +256,18 @@ def build(table: NodeTable, pods: list[dict]):
             is_filter[i, m] = hard
             is_score[i, m] = not hard
             weight[i, m] = math.log(float(n_domains[cid]) + 2.0)
+            if hard:
+                row = slot_eligible_row(pods[i], c)
+                if per_slot_eligibility:
+                    eligible[i, m] = row
+                else:
+                    eligible[i] = row
+                md = c.get("minDomains")
+                if md is not None:
+                    doms = np.unique(dom_idx[cid][(dom_idx[cid] >= 0) & row])
+                    # zero eligible domains: upstream's minMatchNum lookup
+                    # errors and the constraint is SKIPPED, not zeroed
+                    md_unsat[i, m] = 0 < len(doms) < int(md)
         filter_skip[i] = not is_filter[i].any()
         score_skip[i] = not is_score[i].any()
 
@@ -184,6 +280,7 @@ def build(table: NodeTable, pods: list[dict]):
         is_score=jnp.asarray(is_score),
         weight=jnp.asarray(weight),
         eligible=jnp.asarray(eligible),
+        md_unsat=jnp.asarray(md_unsat),
         filter_skip=jnp.asarray(filter_skip),
         score_skip=jnp.asarray(score_skip),
     )
@@ -202,12 +299,20 @@ def assemble_counts(static: SpreadStatic, counts_dom: np.ndarray) -> jnp.ndarray
     return jnp.asarray(np.where(dom >= 0, vals, 0).astype(np.int32))
 
 
+def _slot_eligible(pod, m):
+    """[N] inclusion mask for slot m ([P, MC, N] layout when any
+    constraint sets a non-default inclusion policy, else shared [P, N])."""
+    return pod.eligible[m] if pod.eligible.ndim == 2 else pod.eligible
+
+
 def _per_constraint(static: SpreadStatic, pod, counts, m):
     """Per-constraint-slot quantities: (active, has_key[N], cnt[N], min_match).
 
     counts is node-space [C, N]; min-over-present-domains equals the min
     over eligible keyed NODES of the node-space counts (every present
-    domain is represented by at least one eligible node)."""
+    domain is represented by at least one eligible node).  minDomains
+    (spec'd and unsatisfied -> md_unsat at build time) forces the global
+    minimum to 0, upstream getMinMatchNum semantics."""
     cid = pod.c_id[m]
     active = cid >= 0
     c = jnp.maximum(cid, 0)
@@ -215,7 +320,8 @@ def _per_constraint(static: SpreadStatic, pod, counts, m):
     has_key = dom >= 0
     cnt = counts[c]                              # [N] (0 where key missing)
     min_match = jnp.min(
-        jnp.where(has_key & pod.eligible, cnt.astype(jnp.int64), _BIG))
+        jnp.where(has_key & _slot_eligible(pod, m), cnt.astype(jnp.int64), _BIG))
+    min_match = jnp.where(pod.md_unsat[m], 0, min_match)
     return active, has_key, cnt, min_match
 
 

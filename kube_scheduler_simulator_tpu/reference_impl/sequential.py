@@ -512,8 +512,10 @@ class SequentialScheduler:
     # ---------------- PodTopologySpread helpers -------------------------
 
     def _spread_constraints(self, pod, hard: bool):
+        from ..plugins.topologyspread import effective_constraints
+
         out = []
-        for c in (_spec(pod).get("topologySpreadConstraints") or [])[:4]:
+        for c in effective_constraints(pod):
             is_hard = c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"
             if is_hard == hard:
                 out.append(c)
@@ -535,17 +537,30 @@ class SequentialScheduler:
                 counts[val] = counts.get(val, 0) + 1
         return counts
 
-    def _eligible_nodes(self, pod):
+    def _eligible_nodes(self, pod, c=None):
+        """Per-constraint node inclusion (upstream matchNodeInclusionPolicies):
+        nodeAffinityPolicy Honor (default) applies the pod's nodeSelector +
+        required node affinity; nodeTaintsPolicy Honor (default Ignore)
+        additionally excludes nodes with untolerated NoSchedule/NoExecute
+        taints."""
         spec = _spec(pod)
-        sel = spec.get("nodeSelector") or {}
-        req = (((spec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
+        aff_policy = (c or {}).get("nodeAffinityPolicy") or "Honor"
+        taint_policy = (c or {}).get("nodeTaintsPolicy") or "Ignore"
+        sel = spec.get("nodeSelector") or {} if aff_policy == "Honor" else {}
+        req = ((((spec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
             "requiredDuringSchedulingIgnoredDuringExecution"
-        )
+        ) if aff_policy == "Honor" else None)
+        tols = spec.get("tolerations") or []
         out = []
         for j in range(self.n):
             ok = all(self.labels[j].get(k) == str(v) for k, v in sel.items()) if sel else True
             if ok and req:
                 ok = node_selector_matches(req, self.labels[j], self.names[j])
+            if ok and taint_policy == "Honor":
+                from ..state.selectors import has_untolerated_do_not_schedule_taint
+
+                ok = not has_untolerated_do_not_schedule_taint(
+                    self.table.taints[j], tols)
             out.append(ok)
         return out
 
@@ -556,11 +571,9 @@ class SequentialScheduler:
             return self._cycle["spread_filter"]
         ns = _meta(pod).get("namespace") or "default"
         pod_labels = {k: str(v) for k, v in (_meta(pod).get("labels") or {}).items()}
-        eligible = None
         state = []
         for c in self._spread_constraints(pod, hard=True):
-            if eligible is None:
-                eligible = self._eligible_nodes(pod)
+            eligible = self._eligible_nodes(pod, c)
             key = c.get("topologyKey", "")
             sel = c.get("labelSelector")
             counts = self._count_by_domain(ns, sel, key)
@@ -570,6 +583,13 @@ class SequentialScheduler:
                 if eligible[k] and key in self.labels[k]
             }
             min_match = min((counts.get(d, 0) for d in domains), default=None)
+            md = c.get("minDomains")
+            if md is not None and 0 < len(domains) < int(md):
+                # upstream getMinMatchNum: fewer (but nonzero — a zero-
+                # domain key errors upstream and the constraint is
+                # skipped) eligible domains than minDomains -> the global
+                # minimum is treated as 0
+                min_match = 0
             state.append({
                 "key": key,
                 "max_skew": int(c.get("maxSkew", 1)),

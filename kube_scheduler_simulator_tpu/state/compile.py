@@ -290,20 +290,10 @@ def _prime_spread_counts(counts_dom, st, pods, bound_pods, name_idx):
 
 
 def _spread_groups(pods):
-    import json
-
-    seen, out = set(), []
-    for pod in pods:
-        ns = (pod.get("metadata") or {}).get("namespace") or "default"
-        for c in ((pod.get("spec") or {}).get("topologySpreadConstraints") or [])[
-            : topologyspread.MAX_CONSTRAINTS
-        ]:
-            sel = c.get("labelSelector")
-            gk = (ns, c.get("topologyKey", ""), json.dumps(sel, sort_keys=True))
-            if gk not in seen:
-                seen.add(gk)
-                out.append((ns, c.get("topologyKey", ""), sel))
-    return out
+    # MUST intern identically to topologyspread.build (same effective
+    # constraints incl. matchLabelKeys merge) or bound-pod priming would
+    # credit the wrong count groups
+    return topologyspread.constraint_groups(pods)
 
 
 def _prime_interpod_counts(dom_mats, st, x_all, n_queue, bound_pods, name_idx):

@@ -145,3 +145,16 @@ def pods_match_label_selector(selector: dict | None, pods: list[dict]) -> np.nda
         labels = {k: str(v) for k, v in ((pod.get("metadata") or {}).get("labels") or {}).items()}
         out[i] = label_selector_matches(selector, labels)
     return out
+
+
+def has_untolerated_do_not_schedule_taint(taints, tolerations) -> bool:
+    """upstream helper.DoNotScheduleTaintsFilterFunc: does the node carry a
+    NoSchedule/NoExecute taint the pod's tolerations don't cover?
+    taints: [(key, value, effect)] as NodeTable.taints stores them."""
+    from .nodes import NO_EXECUTE, NO_SCHEDULE
+
+    for key, value, eff in taints:
+        if eff in (NO_SCHEDULE, NO_EXECUTE) and not tolerations_tolerate(
+                tolerations, key, value, eff):
+            return True
+    return False

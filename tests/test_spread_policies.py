@@ -1,0 +1,184 @@
+"""PodTopologySpread v1.27+ knobs: matchLabelKeys, minDomains,
+nodeAffinityPolicy / nodeTaintsPolicy (upstream
+pkg/scheduler/framework/plugins/podtopologyspread; defaults Honor/Ignore).
+Each case is asserted two ways: tensor replay == sequential oracle
+(byte-identical annotations) AND a hand-computed placement expectation.
+"""
+
+import json
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store import annotations as ann
+from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+
+def node(name, zone=None, taints=None, extra_labels=None):
+    labels = {"kubernetes.io/hostname": name}
+    if zone:
+        labels["zone"] = zone
+    labels.update(extra_labels or {})
+    n = {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name, "labels": labels},
+        "spec": {},
+        "status": {"allocatable": {"cpu": "8", "memory": "32Gi", "pods": "110"},
+                   "capacity": {"cpu": "8", "memory": "32Gi", "pods": "110"}},
+    }
+    if taints:
+        n["spec"]["taints"] = taints
+    return n
+
+
+def pod(name, labels=None, constraints=None, tolerations=None):
+    p = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "labels": labels or {}},
+        "spec": {"containers": [{"name": "c",
+                                 "resources": {"requests": {"cpu": "100m"}}}]},
+    }
+    if constraints:
+        p["spec"]["topologySpreadConstraints"] = constraints
+    if tolerations:
+        p["spec"]["tolerations"] = tolerations
+    return p
+
+
+def assert_parity(nodes, pods, cfg_plugins=("NodeResourcesFit", "PodTopologySpread")):
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    cfg = PluginSetConfig(enabled=list(cfg_plugins))
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=8)
+    for i, (sa, ss) in enumerate(seq):
+        da = decode_pod_result(rr, i)
+        assert int(rr.selected[i]) == ss, f"pod {i} selected"
+        for k in sa:
+            assert da[k] == sa[k], f"pod {i} {k}\n dev={da[k][:200]}\n seq={sa[k][:200]}"
+    return seq, rr
+
+
+SPREAD = {"maxSkew": 1, "topologyKey": "zone",
+          "whenUnsatisfiable": "DoNotSchedule",
+          "labelSelector": {"matchLabels": {"app": "web"}}}
+
+
+def test_match_label_keys_narrows_counting():
+    """Two generations of 'web' pods distinguished by pod-template-hash;
+    matchLabelKeys: the new generation spreads among ITSELF, ignoring the
+    old generation's placement."""
+    nodes = [node("n0", zone="a"), node("n1", zone="b")]
+    c = dict(SPREAD, matchLabelKeys=["pod-template-hash"])
+    old = [pod(f"old-{i}", labels={"app": "web", "pod-template-hash": "v1"},
+               constraints=[c]) for i in range(2)]
+    new = [pod(f"new-{i}", labels={"app": "web", "pod-template-hash": "v2"},
+               constraints=[c]) for i in range(3)]
+    seq, _ = assert_parity(nodes, old + new)
+    # without matchLabelKeys, v1 pods on both zones would constrain v2;
+    # with it, v2 spreads 2/1 over zones regardless of v1 placement
+    zones = {}
+    for (annos, sel), p in zip(seq, old + new):
+        if sel >= 0 and p["metadata"]["name"].startswith("new"):
+            zones.setdefault(sel, 0)
+            zones[sel] += 1
+    assert sorted(zones.values()) == [1, 2]
+    # and the selector recorded nothing about v1 pods blocking v2: all new
+    # pods scheduled
+    assert all(sel >= 0 for (_, sel) in seq)
+
+
+def test_min_domains_blocks_single_domain_pileup():
+    """minDomains=2 with only one zone present: the global minimum is
+    treated as 0, so once maxSkew pods sit in the lone zone the next pod
+    is unschedulable (without minDomains it would pile up forever)."""
+    nodes = [node("n0", zone="a"), node("n1", zone="a")]
+    c = dict(SPREAD, minDomains=2)
+    pods = [pod(f"w-{i}", labels={"app": "web"}, constraints=[c]) for i in range(3)]
+    seq, _ = assert_parity(nodes, pods)
+    sels = [s for _, s in seq]
+    assert sels[0] >= 0
+    # second pod: count(a)=1 + self 1 - 0 = 2 > maxSkew 1 -> unschedulable
+    assert sels[1] == -1 and sels[2] == -1
+    annos = seq[1][0]
+    fr = json.loads(annos[ann.FILTER_RESULT])
+    assert "topology spread" in fr["n0"]["PodTopologySpread"]
+
+
+def test_without_min_domains_single_domain_pileup_allowed():
+    nodes = [node("n0", zone="a"), node("n1", zone="a")]
+    pods = [pod(f"w-{i}", labels={"app": "web"}, constraints=[dict(SPREAD)])
+            for i in range(3)]
+    seq, _ = assert_parity(nodes, pods)
+    assert all(s >= 0 for _, s in seq)  # skew vs global min 0? no: min is
+    # over the only domain, which grows with each bind -> skew stays 1
+
+
+def test_node_taints_policy_honor_excludes_tainted_domain():
+    """nodeTaintsPolicy Honor: a zone whose only node is untolerably
+    tainted doesn't count toward the minimum, so pods keep landing in the
+    open zone instead of going unschedulable."""
+    taint = [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+    nodes = [node("n0", zone="a"), node("n1", zone="b", taints=taint)]
+    c = dict(SPREAD, nodeTaintsPolicy="Honor")
+    pods = [pod(f"w-{i}", labels={"app": "web"}, constraints=[c])
+            for i in range(2)]
+    # TaintToleration makes n1 infeasible; the knob under test controls
+    # whether its EMPTY zone still drags the spread minimum down
+    seq, _ = assert_parity(
+        nodes, pods,
+        cfg_plugins=("NodeResourcesFit", "TaintToleration", "PodTopologySpread"))
+    assert [s for _, s in seq] == [0, 0]  # both land on n0, no skew fail
+
+
+def test_node_taints_policy_default_ignore_counts_tainted_domain():
+    """Default (Ignore): the tainted zone still counts, so the second pod
+    fails the skew check against the empty-but-counted zone b."""
+    taint = [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+    nodes = [node("n0", zone="a"), node("n1", zone="b", taints=taint)]
+    pods = [pod(f"w-{i}", labels={"app": "web"}, constraints=[dict(SPREAD)])
+            for i in range(2)]
+    seq, _ = assert_parity(
+        nodes, pods,
+        cfg_plugins=("NodeResourcesFit", "TaintToleration", "PodTopologySpread"))
+    assert [s for _, s in seq] == [0, -1]
+
+
+def test_node_affinity_policy_ignore_counts_unselectable_domain():
+    """nodeAffinityPolicy Ignore: a zone excluded by the pod's own
+    nodeSelector still participates in the minimum, making the second pod
+    unschedulable; with the default Honor it schedules."""
+    nodes = [node("n0", zone="a", extra_labels={"pool": "x"}),
+             node("n1", zone="b")]
+    base = {"maxSkew": 1, "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "web"}}}
+
+    def with_selector(c):
+        p = [pod(f"w-{i}", labels={"app": "web"}, constraints=[c])
+             for i in range(2)]
+        for q in p:
+            q["spec"]["nodeSelector"] = {"pool": "x"}
+        return p
+
+    plugins = ("NodeResourcesFit", "NodeAffinity", "PodTopologySpread")
+    seq, _ = assert_parity(nodes, with_selector(dict(base)), cfg_plugins=plugins)
+    assert [s for _, s in seq] == [0, 0]  # Honor: zone b not eligible
+    seq, _ = assert_parity(nodes, with_selector(dict(base, nodeAffinityPolicy="Ignore")),
+                           cfg_plugins=plugins)
+    assert [s for _, s in seq] == [0, -1]  # Ignore: zone b counts, skew fails
+
+
+def test_min_domains_zero_eligible_domains_is_skipped():
+    """Upstream: a topology key with ZERO eligible domains errors in
+    minMatchNum and the constraint is skipped, not zeroed — minDomains
+    must not make such pods unschedulable (review r3 finding)."""
+    taint = [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+    # the only zoned node is untolerably tainted: with nodeTaintsPolicy
+    # Honor there are 0 eligible domains for the constraint
+    nodes = [node("n0", zone="a", taints=taint)]
+    c = dict(SPREAD, minDomains=2, nodeTaintsPolicy="Honor")
+    pods = [pod("w-0", labels={"app": "web"}, constraints=[c])]
+    seq, _ = assert_parity(nodes, pods)
+    assert seq[0][1] == 0  # schedulable: the constraint was skipped
