@@ -319,6 +319,7 @@ class SchedulerEngine:
             cw = compile_workload(
                 nodes, pending, self.plugin_config, bound_pods=bound,
                 volumes=volumes, reuse=getattr(self, "_last_cw", None),
+                namespaces=self.store.list("namespaces")[0],
             )
             self._last_cw = NodeTableReuse(cw)
         if self._needs_host_path():

@@ -184,6 +184,7 @@ class Preemptor:
         cw = compile_workload(
             nodes, [pod], self.plugin_config, bound_pods=bound,
             volumes=self._volumes, reuse=getattr(self, "_fit_cw", None),
+            namespaces=self._namespaces,
         )
         self._fit_cw = NodeTableReuse(cw)  # shared across fit hypotheses
         rr = replay(cw, chunk=1, filter_only=True)
@@ -221,6 +222,7 @@ class Preemptor:
             self._pdbs = self.store.list("poddisruptionbudgets")[0]
         except KeyError:
             self._pdbs = []
+        self._namespaces = self.store.list("namespaces")[0]
         evaluated = [n for n, _ in failed]
         out = PreemptionOutcome(evaluated_nodes=evaluated)
 

@@ -35,10 +35,15 @@ with hardWeight = args.hardPodAffinityWeight (default 1).
 NormalizeScore: fScore = 100 * (score - min) / (max - min) over feasible
 nodes, float64 then int64 truncation, 0 when max == min.
 
-Round-1 simplifications (docs/SEMANTICS.md): namespaceSelector in terms and
-matchLabelKeys are not modeled; PreFilter never returns Skip when any pod
-in the workload carries required anti-affinity terms (coarser than
-upstream's per-cycle check, applied identically in the CPU reference).
+Term normalization (effective_terms, shared with the CPU oracle):
+namespaceSelector resolved against the namespace manifests supplied at
+compile time (explicit namespaces union selector matches; {} matches all
+known namespaces), matchLabelKeys / mismatchLabelKeys merged into the
+selector as In / NotIn expressions over the incoming pod's own values.
+Remaining simplification (docs/SEMANTICS.md): PreFilter never returns
+Skip when any pod in the workload carries required anti-affinity terms
+(coarser than upstream's per-cycle check, applied identically in the CPU
+reference).
 """
 
 from __future__ import annotations
@@ -111,8 +116,59 @@ def _terms_of(pod: dict, field: str, preferred: bool) -> list[tuple[dict, int]]:
     return [(t, 1) for t in aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []]
 
 
+def effective_terms(pod: dict, field: str, preferred: bool,
+                    namespaces: list[dict] | None = None) -> list[tuple[dict, int]]:
+    """The pod's [anti-]affinity terms, normalized the way upstream's
+    framework.AffinityTerm constructor does:
+
+    * matchLabelKeys / mismatchLabelKeys merged into the labelSelector as
+      In / NotIn expressions over the incoming pod's own label values
+      (MatchLabelKeysInPodAffinity, beta default-on since v1.31; keys the
+      pod doesn't carry are skipped);
+    * the namespace set resolved: explicit `namespaces` union namespaces
+      whose labels match `namespaceSelector` (an empty selector {} matches
+      every known namespace; nil adds nothing); neither field -> the
+      pod's own namespace.  Resolution is against the `namespaces`
+      manifests supplied at compile time — the engine passes the store's
+      live list, matching upstream's per-cycle namespace lister read.
+
+    Shared by the tensor build and the sequential oracle so term
+    interning and match semantics can never diverge."""
+    meta = pod.get("metadata") or {}
+    pod_ns = meta.get("namespace") or "default"
+    pod_labels = {k: str(v) for k, v in (meta.get("labels") or {}).items()}
+    out = []
+    for term, w in _terms_of(pod, field, preferred):
+        extra = []
+        for k in term.get("matchLabelKeys") or []:
+            if k in pod_labels:
+                extra.append({"key": k, "operator": "In", "values": [pod_labels[k]]})
+        for k in term.get("mismatchLabelKeys") or []:
+            if k in pod_labels:
+                extra.append({"key": k, "operator": "NotIn", "values": [pod_labels[k]]})
+        sel = term.get("labelSelector")
+        if extra:
+            sel = dict(sel or {})
+            sel["matchExpressions"] = list(sel.get("matchExpressions") or []) + extra
+        ns_selector = term.get("namespaceSelector")
+        ns_set = set(term.get("namespaces") or [])
+        if ns_selector is not None:
+            for ns_obj in namespaces or []:
+                ns_meta = ns_obj.get("metadata") or {}
+                labels = {k: str(v) for k, v in (ns_meta.get("labels") or {}).items()}
+                if label_selector_matches(ns_selector, labels):
+                    ns_set.add(ns_meta.get("name", ""))
+        if not ns_set and ns_selector is None:
+            ns_set = {pod_ns}
+        term = dict(term, labelSelector=sel, namespaces=sorted(ns_set))
+        term.pop("namespaceSelector", None)
+        out.append((term, w))
+    return out
+
+
 def build(table: NodeTable, pods: list[dict],
-          hard_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT):
+          hard_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT,
+          namespaces: list[dict] | None = None):
     labels = table.labels
     n, p = table.n, len(pods)
 
@@ -120,8 +176,10 @@ def build(table: NodeTable, pods: list[dict],
     terms: dict[tuple, int] = {}
     term_list: list[tuple[str, dict | None, tuple[str, ...]]] = []  # (key, selector, namespaces)
 
-    def intern_term(term: dict, pod_ns: str) -> int:
-        nss = tuple(sorted(term.get("namespaces") or [pod_ns]))
+    def intern_term(term: dict) -> int:
+        # effective_terms already resolved the namespace set and merged
+        # matchLabelKeys into the selector
+        nss = tuple(term.get("namespaces") or ())
         sel = term.get("labelSelector")
         tk = (term.get("topologyKey", ""), json.dumps(sel, sort_keys=True), nss)
         if tk not in terms:
@@ -131,7 +189,6 @@ def build(table: NodeTable, pods: list[dict],
 
     per_pod: list[dict[str, list[tuple[int, int]]]] = []
     for pod in pods:
-        ns = (pod.get("metadata") or {}).get("namespace") or "default"
         entry = {}
         for kind, field, preferred in (
             ("req_aff", "podAffinity", False),
@@ -139,7 +196,10 @@ def build(table: NodeTable, pods: list[dict],
             ("pref_aff", "podAffinity", True),
             ("pref_anti", "podAntiAffinity", True),
         ):
-            entry[kind] = [(intern_term(t, ns), w) for t, w in _terms_of(pod, field, preferred)]
+            entry[kind] = [
+                (intern_term(t), w)
+                for t, w in effective_terms(pod, field, preferred, namespaces)
+            ]
         per_pod.append(entry)
 
     t_count = max(len(term_list), 1)

@@ -44,9 +44,10 @@ MAX_NODE_SCORE = seq_mod.MAX_NODE_SCORE
 DEFAULT_PARALLELISM = 16  # upstream parallelism default
 
 
-def _worker_main(conn, nodes, pods, config, bound_pods, volumes, lo, hi):
+def _worker_main(conn, nodes, pods, config, bound_pods, volumes, namespaces,
+                 lo, hi):
     seq = SequentialScheduler(nodes, pods, config, bound_pods=bound_pods,
-                              volumes=volumes)
+                              volumes=volumes, namespaces=namespaces)
     msg_ids: dict[str, int] = {}
     while True:
         msg = conn.recv()
@@ -114,9 +115,10 @@ class ParallelScheduler:
     fanned over `parallelism` worker processes."""
 
     def __init__(self, nodes, pods, config=None, bound_pods=None, volumes=None,
-                 parallelism: int = DEFAULT_PARALLELISM):
+                 namespaces=None, parallelism: int = DEFAULT_PARALLELISM):
         self.master = SequentialScheduler(nodes, pods, config,
-                                          bound_pods=bound_pods, volumes=volumes)
+                                          bound_pods=bound_pods, volumes=volumes,
+                                          namespaces=namespaces)
         if self.master.config.custom:
             raise ValueError("parallel oracle does not support custom plugins "
                              "(worker processes cannot pickle them reliably)")
@@ -134,7 +136,7 @@ class ParallelScheduler:
             proc = ctx.Process(
                 target=_worker_main,
                 args=(child, nodes, pods, self.master.config, bound_pods,
-                      volumes, bounds[k], bounds[k + 1]),
+                      volumes, namespaces, bounds[k], bounds[k + 1]),
                 daemon=True,
             )
             proc.start()

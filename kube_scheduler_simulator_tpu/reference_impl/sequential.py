@@ -42,12 +42,16 @@ def _spec(pod):
 
 class SequentialScheduler:
     def __init__(self, nodes, pods, config: PluginSetConfig | None = None, bound_pods=None,
-                 volumes=None):
+                 volumes=None, namespaces=None):
         from ..state.volumes import build_volume_table
 
         self.config = config or PluginSetConfig()
         self.pods = pods
         self.node_manifests = nodes
+        # namespace manifests back InterPodAffinity namespaceSelector
+        # resolution (interpod.effective_terms)
+        self.namespaces = namespaces or []
+        self._term_cache: dict = {}
         self.schema = ResourceSchema.discover(pods + [bp for bp, _ in (bound_pods or [])], nodes)
         self.table = build_node_table(nodes, self.schema)
         volumes = volumes or {}
@@ -662,18 +666,28 @@ class SequentialScheduler:
 
     # ---------------- InterPodAffinity helpers --------------------------
 
-    @staticmethod
-    def _pod_terms(pod, field, preferred):
-        aff = (_spec(pod).get("affinity") or {}).get(field) or {}
-        if preferred:
-            return [
-                (wt.get("podAffinityTerm") or {}, int(wt.get("weight", 0)))
-                for wt in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []
-            ]
-        return [(t, 1) for t in aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []]
+    def _pod_terms(self, pod, field, preferred):
+        """Normalized terms (matchLabelKeys merged, namespaces resolved) —
+        the same interpod.effective_terms the tensor build uses.  Memoized
+        per pod object: terms and the namespace list are fixed for this
+        scheduler's lifetime, and the per-cycle loops call this for every
+        queue + assigned pod."""
+        key = (id(pod), field, preferred)
+        hit = self._term_cache.get(key)
+        if hit is None:
+            from ..plugins.interpod import effective_terms
+
+            hit = effective_terms(pod, field, preferred, self.namespaces)
+            self._term_cache[key] = hit
+        return hit
 
     def _term_matches_pod(self, term, owner_ns, target_pod) -> bool:
-        nss = term.get("namespaces") or [owner_ns]
+        # a resolved-but-EMPTY namespace set matches nothing (upstream:
+        # a namespaceSelector matching no namespace selects no pods);
+        # only a term lacking the key falls back to the owner namespace
+        nss = term.get("namespaces")
+        if nss is None:
+            nss = [owner_ns]
         tns = _meta(target_pod).get("namespace") or "default"
         if tns not in nss:
             return False

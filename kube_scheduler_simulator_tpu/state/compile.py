@@ -83,6 +83,7 @@ def compile_workload(
     bound_pods: list[tuple[dict, str]] | None = None,
     volumes: dict | None = None,
     reuse: "CompiledWorkload | NodeTableReuse | None" = None,
+    namespaces: list[dict] | None = None,
 ) -> CompiledWorkload:
     """Compile (nodes, queue pods, already-bound pods) into device tensors.
 
@@ -234,6 +235,7 @@ def compile_workload(
             hard_weight=int((config.args.get("InterPodAffinity") or {})
                             .get("hardPodAffinityWeight")
                             or interpod.DEFAULT_HARD_POD_AFFINITY_WEIGHT),
+            namespaces=namespaces,
         )
         statics["InterPodAffinity"] = st
         xs["InterPodAffinity"] = interpod.InterPodXS(

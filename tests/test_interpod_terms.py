@@ -1,0 +1,155 @@
+"""InterPodAffinity term normalization: namespaceSelector (v1.24+) and
+matchLabelKeys / mismatchLabelKeys (MatchLabelKeysInPodAffinity, beta
+default-on since v1.31).  Tensor replay vs sequential oracle parity plus
+hand-computed placements."""
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+
+def node(name, zone):
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": name,
+                     "labels": {"kubernetes.io/hostname": name, "zone": zone}},
+        "spec": {},
+        "status": {"allocatable": {"cpu": "8", "memory": "32Gi", "pods": "110"},
+                   "capacity": {"cpu": "8", "memory": "32Gi", "pods": "110"}},
+    }
+
+
+def pod(name, namespace="default", labels=None, affinity=None, anti=None):
+    p = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace, "labels": labels or {}},
+        "spec": {"containers": [{"name": "c",
+                                 "resources": {"requests": {"cpu": "100m"}}}]},
+    }
+    aff = {}
+    if affinity:
+        aff["podAffinity"] = affinity
+    if anti:
+        aff["podAntiAffinity"] = anti
+    if aff:
+        p["spec"]["affinity"] = aff
+    return p
+
+
+def ns(name, labels=None):
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": name, "labels": labels or {}}}
+
+
+def assert_parity(nodes, pods, namespaces=None):
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit", "InterPodAffinity"])
+    seq = SequentialScheduler(nodes, pods, cfg, namespaces=namespaces).schedule_all()
+    cw = compile_workload(nodes, pods, cfg, namespaces=namespaces)
+    rr = replay(cw, chunk=8)
+    for i, (sa, ss) in enumerate(seq):
+        da = decode_pod_result(rr, i)
+        assert int(rr.selected[i]) == ss, f"pod {i} selected"
+        for k in sa:
+            assert da[k] == sa[k], f"pod {i} {k}"
+    return [s for _, s in seq], rr
+
+
+def test_namespace_selector_expands_anti_affinity_scope():
+    """Anti-affinity with a namespaceSelector matching team namespaces:
+    a pod in team-b repels the new team-a pod from its zone (the plain
+    namespaces default would only see team-a)."""
+    nodes = [node("n0", "a"), node("n1", "b")]
+    namespaces = [ns("team-a", {"team": "yes"}), ns("team-b", {"team": "yes"}),
+                  ns("other")]
+    anti_term = {"topologyKey": "zone",
+                 "labelSelector": {"matchLabels": {"app": "web"}},
+                 "namespaceSelector": {"matchLabels": {"team": "yes"}}}
+    first = pod("w0", namespace="team-b", labels={"app": "web"})
+    second = pod("w1", namespace="team-a", labels={"app": "web"},
+                 anti={"requiredDuringSchedulingIgnoredDuringExecution": [anti_term]})
+    sels, rr = assert_parity(nodes, [first, second], namespaces=namespaces)
+    assert sels[0] == 0          # w0 -> zone a
+    assert sels[1] == 1          # w1 repelled cross-namespace -> zone b
+    assert int(rr.feasible_count[1]) == 1  # zone a infeasible for w1
+
+
+def test_without_namespace_selector_cross_namespace_invisible():
+    nodes = [node("n0", "a"), node("n1", "b")]
+    anti_term = {"topologyKey": "zone",
+                 "labelSelector": {"matchLabels": {"app": "web"}}}
+    first = pod("w0", namespace="team-b", labels={"app": "web"})
+    second = pod("w1", namespace="team-a", labels={"app": "web"},
+                 anti={"requiredDuringSchedulingIgnoredDuringExecution": [anti_term]})
+    sels, rr = assert_parity(nodes, [first, second])
+    # w1 only sees team-a pods: nothing repels it — both zones feasible
+    assert sels[0] == 0
+    assert int(rr.feasible_count[1]) == 2
+
+
+def test_empty_namespace_selector_matches_all_known_namespaces():
+    nodes = [node("n0", "a"), node("n1", "b")]
+    namespaces = [ns("team-a"), ns("team-b")]
+    anti_term = {"topologyKey": "zone",
+                 "labelSelector": {"matchLabels": {"app": "web"}},
+                 "namespaceSelector": {}}
+    first = pod("w0", namespace="team-b", labels={"app": "web"})
+    second = pod("w1", namespace="team-a", labels={"app": "web"},
+                 anti={"requiredDuringSchedulingIgnoredDuringExecution": [anti_term]})
+    sels, rr = assert_parity(nodes, [first, second], namespaces=namespaces)
+    assert sels[0] == 0 and sels[1] == 1
+    assert int(rr.feasible_count[1]) == 1
+
+
+def test_match_label_keys_scopes_anti_affinity_to_generation():
+    """Self-anti-affinity with matchLabelKeys on pod-template-hash: only
+    same-generation replicas repel each other."""
+    nodes = [node("n0", "a"), node("n1", "b")]
+    anti = {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "topologyKey": "zone",
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "matchLabelKeys": ["pod-template-hash"],
+    }]}
+    v1 = pod("v1-0", labels={"app": "web", "pod-template-hash": "v1"}, anti=anti)
+    v2a = pod("v2-0", labels={"app": "web", "pod-template-hash": "v2"}, anti=anti)
+    v2b = pod("v2-1", labels={"app": "web", "pod-template-hash": "v2"}, anti=anti)
+    sels, rr = assert_parity(nodes, [v1, v2a, v2b])
+    # v2-0 may land anywhere (different hash doesn't repel it from v1);
+    # v2-1 is repelled by v2-0 from ITS zone
+    assert int(rr.feasible_count[1]) == 2   # v1 doesn't repel v2-0
+    assert int(rr.feasible_count[2]) == 1   # v2-0 repels v2-1
+    assert sels[2] != sels[1]
+
+
+def test_mismatch_label_keys_repels_other_generations():
+    """mismatchLabelKeys inverts the scope: the term targets pods with a
+    DIFFERENT value of the key."""
+    nodes = [node("n0", "a"), node("n1", "b")]
+    anti = {"requiredDuringSchedulingIgnoredDuringExecution": [{
+        "topologyKey": "zone",
+        "labelSelector": {"matchLabels": {"app": "web"}},
+        "mismatchLabelKeys": ["pod-template-hash"],
+    }]}
+    v1 = pod("v1-0", labels={"app": "web", "pod-template-hash": "v1"})
+    v2 = pod("v2-0", labels={"app": "web", "pod-template-hash": "v2"}, anti=anti)
+    sels, rr = assert_parity(nodes, [v1, v2])
+    # v2 avoids zones holding OTHER generations of web -> zone b
+    assert sels[0] == 0 and sels[1] == 1
+    assert int(rr.feasible_count[1]) == 1
+
+
+def test_unmatched_namespace_selector_matches_nothing():
+    """A namespaceSelector matching NO known namespace resolves to an
+    empty set, which must match no pods — not fall back to the owner
+    namespace (review r3 finding)."""
+    nodes = [node("n0", "a"), node("n1", "b")]
+    namespaces = [ns("team-a")]  # no labels
+    anti_term = {"topologyKey": "zone",
+                 "labelSelector": {"matchLabels": {"app": "web"}},
+                 "namespaceSelector": {"matchLabels": {"team": "nope"}}}
+    first = pod("w0", namespace="team-a", labels={"app": "web"})
+    second = pod("w1", namespace="team-a", labels={"app": "web"},
+                 anti={"requiredDuringSchedulingIgnoredDuringExecution": [anti_term]})
+    sels, rr = assert_parity(nodes, [first, second], namespaces=namespaces)
+    assert int(rr.feasible_count[1]) == 2  # nothing repels w1
