@@ -28,8 +28,9 @@ import numpy as np
 from .base import default_normalize_score
 from ..state.nodes import NodeTable
 from ..state.selectors import (
-    node_selector_matches,
-    node_selector_term_matches,
+    match_labels_rows,
+    node_selector_rows,
+    node_selector_term_rows,
     spec_key,
 )
 
@@ -47,7 +48,6 @@ class NodeAffinityXS(NamedTuple):
 def build(table: NodeTable, pods: list[dict],
           args: dict | None = None) -> NodeAffinityXS:
     n, p = table.n, len(pods)
-    labels = table.labels
     required_ok = np.ones((p, n), dtype=bool)
     pref_raw = np.zeros((p, n), dtype=np.int32)
     filter_skip = np.zeros(p, dtype=bool)
@@ -56,21 +56,18 @@ def build(table: NodeTable, pods: list[dict],
     # addedAffinity (NodeAffinityArgs): admin-configured affinity ANDed
     # onto every pod (upstream node_affinity.go); with it present,
     # PreFilter/PreScore never Skip
+    idx = table.label_index  # columnar: one vector op per expression
+
     added = (args or {}).get("addedAffinity") or {}
     added_req = added.get("requiredDuringSchedulingIgnoredDuringExecution")
     added_pref = added.get("preferredDuringSchedulingIgnoredDuringExecution") or []
-    added_req_row = None
-    if added_req:
-        added_req_row = np.array(
-            [node_selector_matches(added_req, labels[j], table.names[j])
-             for j in range(n)], dtype=bool)
+    added_req_row = node_selector_rows(added_req, idx) if added_req else None
     added_pref_row = None
     if added_pref:
-        added_pref_row = np.array(
-            [sum(int(t.get("weight", 0)) for t in added_pref
-                 if node_selector_term_matches(t.get("preference") or {},
-                                               labels[j], table.names[j]))
-             for j in range(n)], dtype=np.int32)
+        added_pref_row = np.zeros(n, dtype=np.int32)
+        for t in added_pref:
+            added_pref_row += int(t.get("weight", 0)) * node_selector_term_rows(
+                t.get("preference") or {}, idx)
 
     req_rows: dict[str, np.ndarray] = {}   # unique spec -> [N] row
     pref_rows: dict[str, np.ndarray] = {}
@@ -88,13 +85,10 @@ def build(table: NodeTable, pods: list[dict],
             row = req_rows.get(key)
             if row is None:
                 row = np.ones(n, dtype=bool)
-                for j in range(n):
-                    ok = True
-                    if node_sel:
-                        ok = all(labels[j].get(k) == str(v) for k, v in node_sel.items())
-                    if ok and required:
-                        ok = node_selector_matches(required, labels[j], table.names[j])
-                    row[j] = ok
+                if node_sel:
+                    row &= match_labels_rows(node_sel, idx)
+                if required:
+                    row &= node_selector_rows(required, idx)
                 req_rows[key] = row
             required_ok[i] = row if added_req_row is None else (row & added_req_row)
 
@@ -105,13 +99,9 @@ def build(table: NodeTable, pods: list[dict],
             row = pref_rows.get(key)
             if row is None:
                 row = np.zeros(n, dtype=np.int32)
-                for j in range(n):
-                    s = 0
-                    for term in preferred:
-                        w = int(term.get("weight", 0))
-                        if node_selector_term_matches(term.get("preference") or {}, labels[j], table.names[j]):
-                            s += w
-                    row[j] = s
+                for term in preferred:
+                    row += int(term.get("weight", 0)) * node_selector_term_rows(
+                        term.get("preference") or {}, idx)
                 pref_rows[key] = row
             pref_raw[i] = row if added_pref_row is None else (row + added_pref_row)
 

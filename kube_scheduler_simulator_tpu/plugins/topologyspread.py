@@ -54,7 +54,8 @@ from .base import MAX_NODE_SCORE
 from ..state.nodes import NodeTable
 from ..state.selectors import (
     label_selector_matches,
-    node_selector_matches,
+    match_labels_rows,
+    node_selector_rows,
     spec_key,
 )
 
@@ -144,7 +145,7 @@ def constraint_groups(pods: list[dict]) -> list[tuple[str, str, dict | None]]:
     return _intern_groups(pods)[0]
 
 
-def _node_affinity_eligible(pod: dict, labels: list[dict], names: list[str]) -> np.ndarray:
+def _node_affinity_eligible(pod: dict, table: NodeTable) -> np.ndarray:
     """nodeAffinityPolicy: Honor — domains for minMatchNum only count nodes
     matching the pod's nodeSelector + required node affinity."""
     spec = pod.get("spec") or {}
@@ -152,15 +153,11 @@ def _node_affinity_eligible(pod: dict, labels: list[dict], names: list[str]) -> 
     req = (((spec.get("affinity") or {}).get("nodeAffinity")) or {}).get(
         "requiredDuringSchedulingIgnoredDuringExecution"
     )
-    n = len(labels)
-    out = np.ones(n, dtype=bool)
-    if not sel and not req:
-        return out
-    for j in range(n):
-        ok = all(labels[j].get(k) == str(v) for k, v in sel.items()) if sel else True
-        if ok and req:
-            ok = node_selector_matches(req, labels[j], names[j])
-        out[j] = ok
+    out = np.ones(table.n, dtype=bool)
+    if sel:
+        out &= match_labels_rows(sel, table.label_index)
+    if req:
+        out &= node_selector_rows(req, table.label_index)
     return out
 
 
@@ -241,7 +238,7 @@ def build(table: NodeTable, pods: list[dict]):
         )
         row = eligible_rows.get(ek)
         if row is None:
-            row = (_node_affinity_eligible(pod, labels, table.names)
+            row = (_node_affinity_eligible(pod, table)
                    if aff_policy == "Honor" else np.ones(n, dtype=bool))
             if taint_policy == "Honor":
                 row = row & _taints_tolerated_row(pod, table)

@@ -42,6 +42,18 @@ class NodeTable:
     def n(self) -> int:
         return len(self.names)
 
+    @property
+    def label_index(self):
+        """Lazy columnar label index for vectorized selector matching
+        (state/selectors.LabelIndex); cached on the table."""
+        idx = getattr(self, "_label_index", None)
+        if idx is None:
+            from .selectors import LabelIndex
+
+            idx = LabelIndex(self.labels, self.names)
+            object.__setattr__(self, "_label_index", idx)
+        return idx
+
 
 def build_node_table(nodes: list[dict], schema: ResourceSchema) -> NodeTable:
     n = len(nodes)

@@ -158,3 +158,90 @@ def has_untolerated_do_not_schedule_taint(taints, tolerations) -> bool:
                 tolerations, key, value, eff):
             return True
     return False
+
+
+# ---------------------------------------------------------------- vectorized
+# Columnar matching over ALL nodes at once: workload compilation evaluates
+# a few hundred unique selector specs against thousands of nodes, and the
+# per-(spec, node) scalar walk above dominated compile_workload at 5k
+# nodes.  A LabelIndex interns each label key into one object-dtype numpy
+# column; each expression then evaluates as one vector op over [N].
+
+class LabelIndex:
+    """Per-key columns of node label values (None = key absent)."""
+
+    def __init__(self, labels: list[dict[str, str]], names: list[str]):
+        self.n = len(labels)
+        self.names = np.asarray(names, dtype=object)
+        self._labels = labels
+        self._cols: dict[str, np.ndarray] = {}
+
+    def column(self, key: str) -> np.ndarray:
+        col = self._cols.get(key)
+        if col is None:
+            col = np.array([lab.get(key) for lab in self._labels], dtype=object)
+            self._cols[key] = col
+        return col
+
+
+def _expr_rows(expr: dict, idx: LabelIndex, col: np.ndarray) -> np.ndarray:
+    """_expr_matches_labels vectorized: [N] bool for one expression."""
+    op = expr.get("operator", "")
+    values = expr.get("values") or []
+    has = np.not_equal(col, None)
+    if op == "In":
+        return has & np.isin(col, np.array(values, dtype=object))
+    if op == "NotIn":
+        return has & ~np.isin(col, np.array(values, dtype=object))
+    if op == "Exists":
+        return has
+    if op == "DoesNotExist":
+        return ~has
+    if op in ("Gt", "Lt"):
+        if len(values) != 1:
+            return np.zeros(idx.n, dtype=bool)
+        try:
+            val = int(values[0])
+        except ValueError:
+            return np.zeros(idx.n, dtype=bool)
+        out = np.zeros(idx.n, dtype=bool)
+        for j in np.flatnonzero(has):
+            try:
+                lab = int(col[j])
+            except ValueError:
+                continue
+            out[j] = lab > val if op == "Gt" else lab < val
+        return out
+    return np.zeros(idx.n, dtype=bool)
+
+
+def node_selector_term_rows(term: dict, idx: LabelIndex) -> np.ndarray:
+    """node_selector_term_matches over all nodes: [N] bool."""
+    exprs = term.get("matchExpressions") or []
+    fields = term.get("matchFields") or []
+    if not exprs and not fields:
+        return np.zeros(idx.n, dtype=bool)
+    out = np.ones(idx.n, dtype=bool)
+    for e in exprs:
+        out &= _expr_rows(e, idx, idx.column(e.get("key", "")))
+    for f in fields:
+        if f.get("key") != "metadata.name":
+            return np.zeros(idx.n, dtype=bool)
+        out &= _expr_rows(f, idx, idx.names)
+    return out
+
+
+def node_selector_rows(selector: dict, idx: LabelIndex) -> np.ndarray:
+    """node_selector_matches over all nodes: [N] bool (OR over terms)."""
+    out = np.zeros(idx.n, dtype=bool)
+    for t in selector.get("nodeSelectorTerms") or []:
+        out |= node_selector_term_rows(t, idx)
+    return out
+
+
+def match_labels_rows(match_labels: dict, idx: LabelIndex) -> np.ndarray:
+    """nodeSelector-style exact matchLabels over all nodes: [N] bool."""
+    out = np.ones(idx.n, dtype=bool)
+    for k, v in match_labels.items():
+        out &= np.equal(idx.column(k), str(v))
+    return out
