@@ -63,8 +63,9 @@ class CompactOut(NamedTuple):
     filter plugin and its code (the framework stops at the first failure;
     everything before it records "passed"), so all F filter codes pack
     into one integer per node — as small as uint8 when the compile-time
-    code bounds allow (PACK_MODES), with PodTopologySpread's per-node
-    ignore mask riding a spare bit.  finalscore is a pure
+    code bounds allow (PACK_MODES); PodTopologySpread's ignore mask is
+    static (dom_idx + the pod's scored slots) and is recomputed on host
+    rather than transferred.  finalscore is a pure
     host-recomputable function of the raw scores + feasibility
     (framework/hostnorm.py), so only raw travels — split into int8/int16
     dtype groups by compile-time per-plugin bounds
@@ -73,7 +74,7 @@ class CompactOut(NamedTuple):
     end-to-end bottleneck on a tunneled TPU link.
     """
 
-    packed_filter: jnp.ndarray   # [N]; 0 = all pass (and not tsp-ignored)
+    packed_filter: jnp.ndarray   # [N]; 0 = all filter plugins passed
     raw8: jnp.ndarray            # [S8, N] int8 raw scores (provably |x|<=127)
     raw16: jnp.ndarray           # [S16, N] int16 raw scores
     raw32: jnp.ndarray           # [S32, N] int32 raw scores (wide rerun)
@@ -83,22 +84,20 @@ class CompactOut(NamedTuple):
     prefilter_reject: jnp.ndarray  # int32
 
 
-# packed-filter layouts: mode -> (dtype, code bits, ff bits, has ignored bit).
-# Layout (LSB first): [code][first_fail_idx + 1][tsp_ignored?].  A word of
-# 0 in the filter bits means "all filter plugins passed".
+# packed-filter layouts: mode -> (dtype, code bits, ff bits).
+# Layout (LSB first): [code][first_fail_idx + 1].  A word of 0 means
+# "all filter plugins passed".
 PACK_MODES = {
-    "p8": (jnp.uint8, 5, 3, False),
-    "p16": (jnp.uint16, 8, 7, True),
-    "p32": (jnp.int32, 16, 14, True),
-    "p64": (jnp.int64, 32, 16, True),
+    "p8": (jnp.uint8, 5, 3),
+    "p16": (jnp.uint16, 8, 8),
+    "p32": (jnp.int32, 16, 15),
+    "p64": (jnp.int64, 32, 16),
 }
 
 
-def choose_pack_mode(max_code: int, n_filters: int, tsp_on: bool) -> str:
+def choose_pack_mode(max_code: int, n_filters: int) -> str:
     for mode in ("p8", "p16", "p32", "p64"):
-        _, code_bits, ff_bits, has_ign = PACK_MODES[mode]
-        if tsp_on and not has_ign:
-            continue
+        _, code_bits, ff_bits = PACK_MODES[mode]
         # the packed word stores first_fail_idx + 1, max value n_filters
         if max_code < (1 << code_bits) and n_filters < (1 << ff_bits):
             return mode
@@ -319,13 +318,10 @@ def _prefilter_reject(cw, carry, sl) -> jnp.ndarray:
     return code
 
 
-def pack_filter_codes(filter_codes: jnp.ndarray, n: int, mode: str,
-                      ignored=None) -> jnp.ndarray:
-    """[F, N] codes -> [N] packed first-fail word (see PACK_MODES): 0 in
-    the filter bits = all pass, else (first_fail_idx + 1) << code_bits |
-    code, with PodTopologySpread's ignore mask on the top spare bit when
-    the layout carries one."""
-    dtype, code_bits, _, has_ign = PACK_MODES[mode]
+def pack_filter_codes(filter_codes: jnp.ndarray, n: int, mode: str) -> jnp.ndarray:
+    """[F, N] codes -> [N] packed first-fail word (see PACK_MODES): 0 =
+    all pass, else (first_fail_idx + 1) << code_bits | code."""
+    dtype, code_bits, _ = PACK_MODES[mode]
     acc_dtype = jnp.int64 if mode == "p64" else jnp.int32
     if filter_codes.shape[0] == 0:
         packed = jnp.zeros(n, dtype=acc_dtype)
@@ -339,10 +335,6 @@ def pack_filter_codes(filter_codes: jnp.ndarray, n: int, mode: str,
             ((ff.astype(acc_dtype) + 1) << code_bits) | code_at.astype(acc_dtype),
             0,
         )
-    if ignored is not None and has_ign:
-        _, code_bits, ff_bits, _ = PACK_MODES[mode]
-        ign_shift = code_bits + ff_bits
-        packed = packed | (ignored.astype(acc_dtype) << ign_shift)
     return packed.astype(dtype)
 
 
@@ -377,12 +369,6 @@ def build_step(cw, out_mode: str = "full", pack_mode: str = "p16",
 
         new_carry = _bind_phase(cw, carry, sl, selected)
         if out_mode == "compact":
-            ignored = None
-            if "PodTopologySpread" in score_names:
-                # same call as inside _score_one — XLA CSE dedupes it
-                _, ignored = topologyspread.score_kernel(
-                    cw.statics["PodTopologySpread"], sl["PodTopologySpread"],
-                    carry["PodTopologySpread"])
             groups: dict[str, list] = {"i8": [], "i16": [], "i32": []}
             for s in range(len(score_names)):
                 g = "i32" if wide_raw else score_dtypes[s]
@@ -410,8 +396,7 @@ def build_step(cw, out_mode: str = "full", pack_mode: str = "p16",
                 full = jnp.stack(groups["i32"])
                 ovf = jnp.any(full != raw32.astype(full.dtype))
             out: Any = CompactOut(
-                packed_filter=pack_filter_codes(
-                    filter_codes, n, pack_mode, ignored=ignored),
+                packed_filter=pack_filter_codes(filter_codes, n, pack_mode),
                 raw8=raw8,
                 raw16=raw16,
                 raw32=raw32,

@@ -147,7 +147,7 @@ class ReplayResult:
             packed = np.asarray(cc.packed[ci])
             c, n = packed.shape
             f = len(self.cw.config.filters())
-            _, code_bits, ff_bits, has_ign = PACK_MODES[cc.pack_mode]
+            _, code_bits, ff_bits = PACK_MODES[cc.pack_mode]
             p_int = packed.astype(np.int64)
             code = p_int & ((1 << code_bits) - 1)
             ffp = (p_int >> code_bits) & ((1 << ff_bits) - 1)  # 0 == all pass
@@ -156,14 +156,12 @@ class ReplayResult:
                 idx = np.clip(ffp - 1, 0, f - 1)[:, None, :]
                 np.put_along_axis(codes, idx, np.where(ffp > 0, code, 0)[:, None, :], axis=1)
             feasible = ffp == 0
-            if has_ign:
-                ignored = ((p_int >> (code_bits + ff_bits)) & 1).astype(bool)
-            else:
-                ignored = np.zeros((c, n), bool)
-            d = {"codes": codes, "feasible": feasible, "ignored": ignored}
+            d = {"codes": codes, "feasible": feasible}
             self._recon_ci, self._recon = ci, d
         if scores:
             c, n = d["feasible"].shape
+            if "ignored" not in d:  # scores-only cost; codes path skips it
+                d["ignored"] = self._tsp_ignored_chunk(ci, c, n)
             raw = np.empty((c, len(cc.score_cols), n), np.int64)
             for s, (group, row) in enumerate(cc.score_cols):
                 raw[:, s, :] = getattr(cc, group)[ci][:, row, :]
@@ -171,6 +169,26 @@ class ReplayResult:
             d["final"] = hostnorm.finalize_chunk(
                 self.cw, raw, d["feasible"], d["ignored"], ci * cc.chunk)
         return d
+
+    def _tsp_ignored_chunk(self, ci: int, c: int, n: int) -> np.ndarray:
+        """PodTopologySpread's score-ignore mask for chunk ci, recomputed
+        from STATIC inputs (a node is ignored when it lacks the topology
+        key of any of the pod's scored constraints) — dom_idx and the
+        per-pod slots never change during a replay, so this never needs to
+        travel from the device."""
+        tsp = self.cw.host.get("tsp_ignore")
+        if tsp is None:
+            return np.zeros((c, n), bool)
+        dom_neg, c_id, is_score = tsp  # [C, N] bool, [P, MC], [P, MC]
+        lo = ci * self._compact.chunk
+        hi = min(lo + c, c_id.shape[0])
+        out = np.zeros((c, n), bool)
+        for m in range(c_id.shape[1]):
+            cid = c_id[lo:hi, m]
+            scored = is_score[lo:hi, m] & (cid >= 0)
+            rows = dom_neg[np.maximum(cid, 0)]       # [hi-lo, N]
+            out[: hi - lo] |= scored[:, None] & rows
+        return out
 
     def _materialize(self) -> None:
         """Fill the whole-array caches in ONE pass over the chunks (the
@@ -373,7 +391,6 @@ def _compact_plan(cw: CompiledWorkload, wide: str | None):
     pack_mode = choose_pack_mode(
         cw.host.get("max_filter_code", 1 << 62),
         len(cw.config.filters()),
-        tsp_on="PodTopologySpread" in cw.config.scorers(),
     )
     score_dtypes = cw.host.get(
         "score_dtypes", tuple("i16" for _ in cw.config.scorers()))

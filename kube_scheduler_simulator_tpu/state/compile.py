@@ -342,6 +342,16 @@ def _collect_host_flags(cw: CompiledWorkload):
     cw.host["filter_skip"] = skips_filter
     cw.host["score_skip"] = skips_score
     cw.host["max_filter_code"] = _max_filter_code(cw)
+    if "PodTopologySpread" in cw.config.scorers():
+        # static inputs for the host-side recompute of the score-ignore
+        # mask (framework/replay.py _tsp_ignored_chunk)
+        st = cw.statics["PodTopologySpread"]
+        x = cw.xs["PodTopologySpread"]
+        cw.host["tsp_ignore"] = (
+            np.asarray(st.dom_idx) < 0,
+            np.asarray(x.c_id),
+            np.asarray(x.is_score),
+        )
     cw.host["score_dtypes"] = tuple(
         _score_dtype(cw, name) for name in cw.config.scorers()
     )

@@ -53,7 +53,7 @@ def test_some_pods_schedule():
 
 @pytest.mark.parametrize("seed", [11, 23, 47])
 def test_full_plugin_set_fuzz_parity(seed):
-    """Catch-all: the WHOLE default filter/score plugin lineup (all 12
+    """Catch-all: the WHOLE default filter/score plugin lineup (all 14
     tensorized plugins incl. the volume family), randomized pods with
     affinity + tolerations + spread + interpod terms, volumes, namespaces
     and a mixed node fleet — every annotation byte-identical between the
