@@ -186,6 +186,8 @@ class ReplayResult:
         for m in range(c_id.shape[1]):
             cid = c_id[lo:hi, m]
             scored = is_score[lo:hi, m] & (cid >= 0)
+            if not scored.any():
+                continue  # slot unused by this chunk: skip the gather
             rows = dom_neg[np.maximum(cid, 0)]       # [hi-lo, N]
             out[: hi - lo] |= scored[:, None] & rows
         return out

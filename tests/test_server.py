@@ -153,3 +153,44 @@ def test_web_ui_served(server):
     for needle in ("listwatchresources", "finalscore-result", "schedulerconfiguration",
                    "watchLoop", "api/v1/scenarios"):
         assert needle in body, needle
+
+
+def test_listwatch_resume_skips_old_events(server):
+    """The reconnect contract (reference handler/watcher.go takes
+    *LastResourceVersion form values): a client resuming with the RV it
+    already saw gets no replayed ADDED for old objects, only newer
+    events."""
+    _, created = req(server, "POST", "/api/v1/nodes",
+                     {"metadata": {"name": "old-node"},
+                      "status": {"allocatable": {"cpu": "1"}}})
+    rv = created["metadata"]["resourceVersion"]
+    url = (f"http://127.0.0.1:{server.port}/api/v1/listwatchresources"
+           f"?nodesLastResourceVersion={rv}")
+    events = []
+
+    def read_stream():
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            dec = json.JSONDecoder()
+            buf = ""
+            while not any(e["kind"] == "Node" for e in events):
+                chunk = resp.read1(65536).decode()
+                if not chunk:
+                    break
+                buf += chunk
+                while buf:
+                    try:
+                        obj, end = dec.raw_decode(buf)
+                    except json.JSONDecodeError:
+                        break
+                    events.append(obj)
+                    buf = buf[end:]
+
+    t = threading.Thread(target=read_stream, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    req(server, "POST", "/api/v1/nodes", {"metadata": {"name": "new-node"},
+                                          "status": {"allocatable": {"cpu": "1"}}})
+    t.join(timeout=5)
+    node_names = [e["obj"]["metadata"]["name"] for e in events if e["kind"] == "Node"]
+    assert "new-node" in node_names
+    assert "old-node" not in node_names  # resumed past it
