@@ -158,8 +158,17 @@ class SchedulerEngine:
         rejected = {(ns, name) for kind, ns, name in results if kind == "rejected"}
         return bound, rejected
 
+    def _list_shared(self, resource: str) -> list[dict]:
+        """Read-only listing without per-object deep copies (the store's
+        informer-cache contract); falls back for stores without the fast
+        path (e.g. the remote HTTP cluster client)."""
+        try:
+            return self.store.list(resource, copy_objects=False)[0]
+        except TypeError:
+            return self.store.list(resource)[0]
+
     def pending_pods(self) -> list[dict]:
-        pods, _ = self.store.list("pods")
+        pods = self._list_shared("pods")
         pending = [
             p for p in pods
             if not ((p.get("spec") or {}).get("nodeName"))
@@ -298,8 +307,8 @@ class SchedulerEngine:
                 ]
         if not pending:
             return 0, None
-        nodes, _ = self.store.list("nodes")
-        pods_all, _ = self.store.list("pods")
+        nodes = self._list_shared("nodes")
+        pods_all = self._list_shared("pods")
         bound = [
             (p, p["spec"]["nodeName"]) for p in pods_all
             if (p.get("spec") or {}).get("nodeName")
@@ -309,9 +318,9 @@ class SchedulerEngine:
         # (reference: recorder/recorder.go:45-53), so limits come only from
         # callers using compile_workload directly
         volumes = {
-            "pvcs": self.store.list("persistentvolumeclaims")[0],
-            "pvs": self.store.list("persistentvolumes")[0],
-            "storageclasses": self.store.list("storageclasses")[0],
+            "pvcs": self._list_shared("persistentvolumeclaims"),
+            "pvs": self._list_shared("persistentvolumes"),
+            "storageclasses": self._list_shared("storageclasses"),
         }
         with TRACER.span("compile_workload", pods=len(pending), nodes=len(nodes)):
             from ..state.compile import NodeTableReuse
@@ -319,7 +328,7 @@ class SchedulerEngine:
             cw = compile_workload(
                 nodes, pending, self.plugin_config, bound_pods=bound,
                 volumes=volumes, reuse=getattr(self, "_last_cw", None),
-                namespaces=self.store.list("namespaces")[0],
+                namespaces=self._list_shared("namespaces"),
             )
             self._last_cw = NodeTableReuse(cw)
         if self._needs_host_path():

@@ -210,19 +210,26 @@ class Preemptor:
     def preempt(self, pod: dict, failed: list[tuple[str, str | None]]) -> PreemptionOutcome:
         """failed: (node name, first failing plugin or None) for every node
         evaluated in the failed scheduling cycle."""
+        def _shared(resource):
+            # read-only snapshot, no per-object deep copies
+            try:
+                return self.store.list(resource, copy_objects=False)[0]
+            except TypeError:
+                return self.store.list(resource)[0]
+
         self._fit_cache.clear()
-        self._nodes, _ = self.store.list("nodes")
-        self._pods_all, _ = self.store.list("pods")
+        self._nodes = _shared("nodes")
+        self._pods_all = _shared("pods")
         self._volumes = {
-            "pvcs": self.store.list("persistentvolumeclaims")[0],
-            "pvs": self.store.list("persistentvolumes")[0],
-            "storageclasses": self.store.list("storageclasses")[0],
+            "pvcs": _shared("persistentvolumeclaims"),
+            "pvs": _shared("persistentvolumes"),
+            "storageclasses": _shared("storageclasses"),
         }
         try:
-            self._pdbs = self.store.list("poddisruptionbudgets")[0]
+            self._pdbs = _shared("poddisruptionbudgets")
         except KeyError:
             self._pdbs = []
-        self._namespaces = self.store.list("namespaces")[0]
+        self._namespaces = _shared("namespaces")
         evaluated = [n for n, _ in failed]
         out = PreemptionOutcome(evaluated_nodes=evaluated)
 
