@@ -313,3 +313,29 @@ class ObjectStore:
                     obj = copy.deepcopy(obj)
                     self._objects[resource][key] = obj
                     self._notify(resource, ADDED, copy.deepcopy(obj), self._next_rv())
+
+
+def list_shared(store, resource: str) -> list[dict]:
+    """Read-only listing without per-object deep copies — the engine's
+    informer-cache fast path (callers MUST NOT mutate the returned
+    manifests).  Stores without a `copy_objects` parameter (e.g. the
+    remote HTTP cluster client) fall back to the plain listing.  The
+    capability is probed ONCE per store by signature inspection and
+    cached on the store object, so a TypeError raised inside a
+    conforming store's list body propagates instead of being
+    misread as "no fast path"."""
+    fast = getattr(store, "_shared_list_ok", None)
+    if fast is None:
+        import inspect
+
+        try:
+            fast = "copy_objects" in inspect.signature(store.list).parameters
+        except (TypeError, ValueError):
+            fast = False
+        try:
+            store._shared_list_ok = fast
+        except AttributeError:
+            pass  # __slots__ store: re-probe next time
+    if fast:
+        return store.list(resource, copy_objects=False)[0]
+    return store.list(resource)[0]

@@ -162,12 +162,16 @@ class SchedulerEngine:
         """Read-only listing without per-object deep copies (the store's
         informer-cache contract); falls back for stores without the fast
         path (e.g. the remote HTTP cluster client)."""
-        try:
-            return self.store.list(resource, copy_objects=False)[0]
-        except TypeError:
-            return self.store.list(resource)[0]
+        from ..cluster.store import list_shared
+
+        return list_shared(self.store, resource)
 
     def pending_pods(self) -> list[dict]:
+        """Unscheduled pods in PrioritySort order.
+
+        Returns SHARED store manifests (the informer-cache contract) —
+        callers must not mutate them; take a deepcopy before handing one
+        to anything that might."""
         pods = self._list_shared("pods")
         pending = [
             p for p in pods
@@ -356,13 +360,21 @@ class SchedulerEngine:
                 ns, name = meta.get("namespace") or "default", meta.get("name", "")
                 annotations = all_annotations[i]
                 self.result_store.put_decoded(ns, name, annotations)
-                for hook in self._extenders_map().values():
-                    hook.after_cycle(pod, annotations, self.result_store)
+                emap = self._extenders_map()
+                # one private copy serves every third-party surface this
+                # cycle (hooks and plugins must not reach shared manifests)
+                pod_priv = (copy.deepcopy(pod)
+                            if emap or self._custom_lifecycle_plugins()
+                            else None)
+                if emap:
+                    for hook in emap.values():
+                        hook.after_cycle(pod_priv, annotations, self.result_store)
                 sel = int(rr.selected[i])
                 if sel >= 0:
                     lc = self._run_custom_lifecycle(
-                        pod, ns, name, cw.node_table.names[sel],
-                        allow_async=True)
+                        pod_priv if pod_priv is not None else pod,
+                        ns, name, cw.node_table.names[sel],
+                        allow_async=True, private=True)
                     if lc == "deferred":
                         # Permit "wait" parked the pod; its waiter thread
                         # finishes the binding cycle + reflect.  The carry
@@ -382,7 +394,10 @@ class SchedulerEngine:
                             exclude.add((ns, name))
                         return n_bound, "rejected"
                     self._bind(ns, name, cw.node_table.names[sel])
-                    self._run_custom_postbind(pod, cw.node_table.names[sel])
+                    self._run_custom_postbind(
+                        pod_priv if pod_priv is not None else pod,
+                        cw.node_table.names[sel],
+                        private=pod_priv is not None)
                     n_bound += 1
                 else:
                     # PreFilter-rejected pods skip preemption: the static
@@ -404,7 +419,8 @@ class SchedulerEngine:
         ]
 
     def _run_custom_lifecycle(self, pod, ns: str, name: str, node_name: str,
-                              allow_async: bool = False):
+                              allow_async: bool = False,
+                              private: bool = False):
         """Reserve -> Permit -> PreBind -> (caller binds) -> PostBind for
         custom plugins, upstream phase ordering (all Reserves, then all
         Permits, then all PreBinds; Unreserve runs for ALL reserve plugins
@@ -426,6 +442,11 @@ class SchedulerEngine:
         plugins = self._custom_lifecycle_plugins()
         if not plugins:
             return True
+        if not private:
+            # third-party plugin code must never see the store's shared
+            # manifests — a mutating plugin would corrupt live cluster
+            # state with no resourceVersion bump and no watch event
+            pod = copy.deepcopy(pod)
         from .waiting import WaitingPod
         from ..scheduler.debuggable import has_hook
         from ..utils.duration import parse_duration_seconds
@@ -569,7 +590,8 @@ class SchedulerEngine:
             elif self._lifecycle_prebind(pod, ns, name, node, plugins, emap,
                                          unreserve_all):
                 self._bind(ns, name, node_name)
-                self._run_custom_postbind(pod, node_name)
+                # pod here is the lifecycle's private copy
+                self._run_custom_postbind(pod, node_name, private=True)
                 outcome = "bound"
         except Exception:
             try:
@@ -587,21 +609,44 @@ class SchedulerEngine:
             with self._waiter_lock:
                 self._waiter_results.append((outcome, ns, name))
 
-    def _run_custom_postbind(self, pod, node_name: str) -> None:
+    def _unreserve_custom(self, pod, node_name: str,
+                          private: bool = False) -> None:
+        """Unreserve ALL custom reserve plugins in reverse order — upstream
+        runs RunReservePluginsUnreserve on ANY failure after Reserve
+        succeeded, including a bind failure (scheduleOne's binding-cycle
+        error path)."""
+        plugins = [p for p in self._custom_lifecycle_plugins() if p.has_unreserve]
+        if not plugins:
+            return
+        if not private:
+            pod = copy.deepcopy(pod)
+        try:
+            node = self.store.get("nodes", node_name)
+        except NotFound:
+            node = None
+        for p in reversed(plugins):
+            p.unreserve(pod, node)
+
+    def _run_custom_postbind(self, pod, node_name: str,
+                             private: bool = False) -> None:
         """PostBind (observation only, after the successful bind)."""
+        plugins = [p for p in self._custom_lifecycle_plugins() if p.has_post_bind]
+        if not plugins:
+            return
+        if not private:
+            pod = copy.deepcopy(pod)  # plugins must not reach shared manifests
         emap = self._extenders_map()
         try:
             node = self.store.get("nodes", node_name)
         except NotFound:
             node = None
-        for p in self._custom_lifecycle_plugins():
-            if p.has_post_bind:
-                ext = emap.get(p.name)
-                if ext is not None:
-                    getattr(ext, "before_post_bind", lambda *a: None)(pod, node)
-                p.post_bind(pod, node)
-                if ext is not None:
-                    getattr(ext, "after_post_bind", lambda *a: None)(pod, node)
+        for p in plugins:
+            ext = emap.get(p.name)
+            if ext is not None:
+                getattr(ext, "before_post_bind", lambda *a: None)(pod, node)
+            p.post_bind(pod, node)
+            if ext is not None:
+                getattr(ext, "after_post_bind", lambda *a: None)(pod, node)
 
     def _run_postfilter(self, cw, filter_codes, pod_idx, pod, ns: str, name: str) -> bool:
         """Run DefaultPreemption for an unschedulable pod; record the
@@ -735,6 +780,8 @@ class SchedulerEngine:
         from ..scheduler.debuggable import has_hook
         from ..store.decode import decode_filter_message
 
+        pod = copy.deepcopy(pod)  # hooks must not reach shared manifests
+
         fskip = cw.host["filter_skip"]
         active = []  # (filter idx, name, before hook or None, after hook or None)
         for f, nm in enumerate(cw.config.filters()):
@@ -783,6 +830,8 @@ class SchedulerEngine:
         from .pipeline import renormalize
         from ..scheduler.debuggable import has_hook
 
+        if hooks:
+            pod = copy.deepcopy(pod)  # hooks must not reach shared manifests
         sskip = cw.host["score_skip"]
         score_names = cw.config.scorers()
         n = len(names)
@@ -902,17 +951,29 @@ class SchedulerEngine:
             if hook_filter_map is not None and not pf_reject:
                 annotations[ann.FILTER_RESULT] = ann.marshal(hook_filter_map)
             self.result_store.put_decoded(ns, name, annotations)
-            for hook in self._extenders_map().values():
-                hook.after_cycle(pod, annotations, self.result_store)
+            emap = self._extenders_map()
+            if emap:
+                hook_pod = copy.deepcopy(pod)  # hooks must not reach shared manifests
+                for hook in emap.values():
+                    hook.after_cycle(hook_pod, annotations, self.result_store)
 
             bind_ok = sel >= 0 and not ext_error
             lifecycle_rejected = False
-            if bind_ok and not self._run_custom_lifecycle(pod, ns, name, names[sel]):
-                # here the carry only folds on a successful bind, so a
-                # rejection needs no wave re-run (sequential path)
-                bind_ok = False
-                lifecycle_rejected = True
-                sel = -1
+            lifecycle_ok = False
+            # one private copy serves every third-party surface this cycle
+            pod_priv = (copy.deepcopy(pod)
+                        if bind_ok and self._custom_lifecycle_plugins() else None)
+            if bind_ok:
+                if self._run_custom_lifecycle(
+                        pod_priv if pod_priv is not None else pod,
+                        ns, name, names[sel], private=True):
+                    lifecycle_ok = True
+                else:
+                    # here the carry only folds on a successful bind, so a
+                    # rejection needs no wave re-run (sequential path)
+                    bind_ok = False
+                    lifecycle_rejected = True
+                    sel = -1
             if bind_ok:
                 bound_node = names[sel]
                 extenders = self.extender_service.extenders if self.extender_service else []
@@ -932,9 +993,16 @@ class SchedulerEngine:
                             bind_ok = False
                     except Exception:
                         bind_ok = False
+                    if not bind_ok and lifecycle_ok:
+                        # upstream RunReservePluginsUnreserve on bind failure
+                        self._unreserve_custom(pod_priv, bound_node,
+                                               private=True)
             if bind_ok:
                 carry = bind_fn(carry, sl, sel)
                 self._bind(ns, name, names[sel])
+                self._run_custom_postbind(
+                    pod_priv if pod_priv is not None else pod, names[sel],
+                    private=pod_priv is not None)
                 n_bound += 1
             else:
                 # FitError (no feasible node) runs PostFilter, like the

@@ -210,12 +210,11 @@ class Preemptor:
     def preempt(self, pod: dict, failed: list[tuple[str, str | None]]) -> PreemptionOutcome:
         """failed: (node name, first failing plugin or None) for every node
         evaluated in the failed scheduling cycle."""
+        from ..cluster.store import list_shared
+
         def _shared(resource):
             # read-only snapshot, no per-object deep copies
-            try:
-                return self.store.list(resource, copy_objects=False)[0]
-            except TypeError:
-                return self.store.list(resource)[0]
+            return list_shared(self.store, resource)
 
         self._fit_cache.clear()
         self._nodes = _shared("nodes")

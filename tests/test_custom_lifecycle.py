@@ -273,3 +273,67 @@ def test_permit_wait_does_not_stall_other_pods():
         assert (store.get("pods", name)["spec"]).get("nodeName")
     annos = _pod_annotations(store)
     assert json.loads(annos[ann.PERMIT_STATUS_RESULT])["A"] == "wait"
+
+
+def test_mutating_plugin_cannot_corrupt_store_state():
+    """Third-party plugin code receives private copies: a plugin that
+    mutates the pod it is handed must not change live cluster state
+    (the engine's fast-path listings share the stored manifests)."""
+    class Mutator(LifecyclePlugin):
+        def reserve(self, pod, node):
+            pod.setdefault("metadata", {}).setdefault(
+                "labels", {})["rogue"] = "yes"
+            if node is not None:
+                node.setdefault("metadata", {}).setdefault(
+                    "labels", {})["rogue"] = "yes"
+            return None
+
+        def post_bind(self, pod, node):
+            pod["spec"]["nodeName"] = "hijacked"
+
+    engine, store = _engine([Mutator("M", [])])
+    assert engine.schedule_pending() == 1
+    pod = store.get("pods", "pod-00000")
+    assert "rogue" not in (pod["metadata"].get("labels") or {})
+    assert pod["spec"]["nodeName"] != "hijacked"
+    for n in store.list("nodes")[0]:
+        assert "rogue" not in (n["metadata"].get("labels") or {})
+
+
+def test_host_path_runs_postbind_after_successful_bind():
+    """The host-interleaved path (forced here by a cycle hook) must run
+    PostBind after a successful bind, like the batched wave path and the
+    async waiter path do."""
+    from kube_scheduler_simulator_tpu.scheduler.debuggable import PluginExtender
+
+    class NoopHook(PluginExtender):
+        def before_filter(self, pod, node_name):
+            return None
+
+    log = []
+    engine, store = _engine([LifecyclePlugin("A", log)])
+    engine.plugin_extenders = {"NodeResourcesFit": NoopHook()}
+    assert engine._needs_host_path()
+    assert engine.schedule_pending() == 1
+    assert ("A", "post_bind") in log
+    assert store.get("pods", "pod-00000")["spec"].get("nodeName")
+
+
+def test_bind_extender_failure_unreserves_custom_plugins():
+    """Upstream runs RunReservePluginsUnreserve on ANY failure after a
+    successful Reserve — including a bind-verb extender failing the
+    binding cycle (host path)."""
+    from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderService
+
+    log = []
+    engine, store = _engine([LifecyclePlugin("A", log)])
+    # bindVerb on an unreachable host: the bind call fails the cycle
+    svc = ExtenderService([{"urlPrefix": "http://127.0.0.1:1",
+                            "bindVerb": "bind"}])
+    engine.set_extenders(svc)
+    assert engine.schedule_pending() == 0
+    # reserve ran, bind failed at the extender -> unreserve must run
+    assert ("A", "reserve") in log
+    assert ("A", "unreserve") in log
+    assert ("A", "post_bind") not in log
+    assert not store.get("pods", "pod-00000")["spec"].get("nodeName")

@@ -84,3 +84,165 @@ def test_golden_unschedulable_filter_message():
     fr = json.loads(anns[ann.FILTER_RESULT])
     assert fr["node-a"]["NodeResourcesFit"] == "Insufficient cpu"
     assert anns[ann.SELECTED_NODE] == ""
+
+
+def _schedule(nodes, pods, enabled, weights=None):
+    store = ObjectStore()
+    for n in nodes:
+        store.create("nodes", n)
+    engine = SchedulerEngine(store)
+    engine.set_plugin_config(PluginSetConfig(enabled=enabled,
+                                             weights=weights or {}))
+    for p in pods:
+        store.create("pods", p)
+    engine.schedule_pending()
+    return {p["metadata"]["name"]:
+            p["metadata"].get("annotations", {})
+            for p in store.list("pods")[0]}
+
+
+def _assert_golden(anns: dict, golden: dict):
+    for key, want in golden.items():
+        assert anns[key] == want, f"{key}\n  got:  {anns[key]}\n  want: {want}"
+
+
+# Integer-division rounding, hand-derived from upstream v1.32 semantics
+# (noderesources/least_allocated + balanced_allocation, int64 math):
+#   node-a 4cpu/8Gi, node-b 2cpu/4Gi; pod requests 1cpu/1Gi.
+#   LeastAllocated per resource = (allocatable-req)*100/allocatable, int64 div:
+#     a.cpu (4000-1000)*100/4000 = 75
+#     a.mem 7516192768*100/8589934592 = 87.4999... -> 87   (the rounding case)
+#     a = (75+87)/2 = 81
+#     b.cpu (2000-1000)*100/2000 = 50;  b.mem 3Gi*100/4Gi = 75 exact
+#     b = (50+75)/2 = 125/2 -> 62                           (odd-sum division)
+#   BalancedAllocation (2 resources): std = |f_cpu - f_mem|/2,
+#   score = int64((1-std)*100):
+#     a: |0.25-0.125|/2 = 0.0625 -> 93.75 -> 93
+#     b: |0.5-0.25|/2   = 0.125  -> 87.5  -> 87
+#   Totals: a 81+93=174 > b 62+87=149 -> node-a selected.
+GOLDEN_ROUNDING = {
+    ann.PRE_FILTER_STATUS_RESULT: '{"NodeResourcesFit":"success"}',
+    ann.PRE_FILTER_RESULT: "{}",
+    ann.FILTER_RESULT:
+        '{"node-a":{"NodeResourcesFit":"passed"},"node-b":{"NodeResourcesFit":"passed"}}',
+    ann.PRE_SCORE_RESULT:
+        '{"NodeResourcesBalancedAllocation":"success","NodeResourcesFit":"success"}',
+    ann.SCORE_RESULT:
+        '{"node-a":{"NodeResourcesBalancedAllocation":"93","NodeResourcesFit":"81"},'
+        '"node-b":{"NodeResourcesBalancedAllocation":"87","NodeResourcesFit":"62"}}',
+    ann.FINAL_SCORE_RESULT:
+        '{"node-a":{"NodeResourcesBalancedAllocation":"93","NodeResourcesFit":"81"},'
+        '"node-b":{"NodeResourcesBalancedAllocation":"87","NodeResourcesFit":"62"}}',
+    ann.BIND_RESULT: '{"DefaultBinder":"success"}',
+    ann.SELECTED_NODE: "node-a",
+}
+
+
+def test_golden_integer_division_rounding():
+    anns = _schedule(
+        nodes=[
+            {"metadata": {"name": "node-a"},
+             "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}}},
+            {"metadata": {"name": "node-b"},
+             "status": {"allocatable": {"cpu": "2", "memory": "4Gi", "pods": "10"}}},
+        ],
+        pods=[{"metadata": {"name": "p1"}, "spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}]}}],
+        enabled=["NodeResourcesFit", "NodeResourcesBalancedAllocation"],
+    )
+    _assert_golden(anns["p1"], GOLDEN_ROUNDING)
+
+
+# TaintToleration, hand-derived from upstream v1.32 semantics
+# (tainttoleration.go + helper.DefaultNormalizeScore reverse=true, weight 3):
+#   node-a PreferNoSchedule dedicated=gpu (intolerable but not filtering),
+#   node-b untainted, node-c NoSchedule dedicated=gpu (filters the pod).
+#   Raw score = count of intolerable PreferNoSchedule taints: a=1, b=0.
+#   Reverse-normalize over feasible nodes, max=1:
+#     a: 100 - 100*1/1 = 0;  b: 100 - 100*0/1 = 100
+#   finalscore = normalized x weight(3): a "0", b "300"; raw score-result
+#   keeps the UN-normalized counts ("1"/"0") per AddScoreResult.
+GOLDEN_TAINTS = {
+    ann.PRE_FILTER_STATUS_RESULT: "{}",
+    ann.PRE_FILTER_RESULT: "{}",
+    ann.FILTER_RESULT:
+        '{"node-a":{"TaintToleration":"passed"},'
+        '"node-b":{"TaintToleration":"passed"},'
+        '"node-c":{"TaintToleration":'
+        '"node(s) had untolerated taint {dedicated: gpu}"}}',
+    ann.PRE_SCORE_RESULT: '{"TaintToleration":"success"}',
+    ann.SCORE_RESULT:
+        '{"node-a":{"TaintToleration":"1"},"node-b":{"TaintToleration":"0"}}',
+    ann.FINAL_SCORE_RESULT:
+        '{"node-a":{"TaintToleration":"0"},"node-b":{"TaintToleration":"300"}}',
+    ann.BIND_RESULT: '{"DefaultBinder":"success"}',
+    ann.SELECTED_NODE: "node-b",
+}
+
+
+def test_golden_taint_reverse_normalize_weight():
+    anns = _schedule(
+        nodes=[
+            {"metadata": {"name": "node-a"},
+             "spec": {"taints": [{"key": "dedicated", "value": "gpu",
+                                  "effect": "PreferNoSchedule"}]},
+             "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}}},
+            {"metadata": {"name": "node-b"},
+             "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}}},
+            {"metadata": {"name": "node-c"},
+             "spec": {"taints": [{"key": "dedicated", "value": "gpu",
+                                  "effect": "NoSchedule"}]},
+             "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}}},
+        ],
+        pods=[{"metadata": {"name": "p1"},
+               "spec": {"containers": [{"name": "c"}]}}],
+        enabled=["TaintToleration"],
+    )
+    _assert_golden(anns["p1"], GOLDEN_TAINTS)
+
+
+# NodeAffinity preferred terms, hand-derived from upstream v1.32 semantics
+# (node_affinity.go Score = sum of matching preferred-term weights;
+# NormalizeScore = DefaultNormalizeScore reverse=false; plugin weight 2):
+#   node-a disk=ssd, node-b disk=hdd; preferred terms weight 5 (ssd) and
+#   3 (hdd); required term disk In [ssd,hdd] matches both (keeps PreFilter
+#   from skipping).  Raw: a=5, b=3; normalize max=5: a=100, b=100*3/5=60;
+#   x2 -> "200"/"120".
+GOLDEN_AFFINITY = {
+    ann.PRE_FILTER_STATUS_RESULT: '{"NodeAffinity":"success"}',
+    ann.PRE_FILTER_RESULT: "{}",
+    ann.FILTER_RESULT:
+        '{"node-a":{"NodeAffinity":"passed"},"node-b":{"NodeAffinity":"passed"}}',
+    ann.PRE_SCORE_RESULT: '{"NodeAffinity":"success"}',
+    ann.SCORE_RESULT:
+        '{"node-a":{"NodeAffinity":"5"},"node-b":{"NodeAffinity":"3"}}',
+    ann.FINAL_SCORE_RESULT:
+        '{"node-a":{"NodeAffinity":"200"},"node-b":{"NodeAffinity":"120"}}',
+    ann.BIND_RESULT: '{"DefaultBinder":"success"}',
+    ann.SELECTED_NODE: "node-a",
+}
+
+
+def test_golden_node_affinity_preferred_weights():
+    affinity = {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [{"matchExpressions": [
+                {"key": "disk", "operator": "In", "values": ["ssd", "hdd"]}]}]},
+        "preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 5, "preference": {"matchExpressions": [
+                {"key": "disk", "operator": "In", "values": ["ssd"]}]}},
+            {"weight": 3, "preference": {"matchExpressions": [
+                {"key": "disk", "operator": "In", "values": ["hdd"]}]}},
+        ]}}
+    anns = _schedule(
+        nodes=[
+            {"metadata": {"name": "node-a", "labels": {"disk": "ssd"}},
+             "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}}},
+            {"metadata": {"name": "node-b", "labels": {"disk": "hdd"}},
+             "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}}},
+        ],
+        pods=[{"metadata": {"name": "p1"},
+               "spec": {"containers": [{"name": "c"}], "affinity": affinity}}],
+        enabled=["NodeAffinity"],
+    )
+    _assert_golden(anns["p1"], GOLDEN_AFFINITY)
