@@ -167,7 +167,9 @@ class SchedulerEngine:
         return list_shared(self.store, resource)
 
     def pending_pods(self) -> list[dict]:
-        """Unscheduled pods in PrioritySort order.
+        """Unscheduled pods in queue order: a custom QueueSort plugin's
+        less() when one is enabled (upstream allows exactly one,
+        wrappedplugin.go:754-771), else PrioritySort.
 
         Returns SHARED store manifests (the informer-cache contract) —
         callers must not mutate them; take a deepcopy before handing one
@@ -179,6 +181,13 @@ class SchedulerEngine:
             and ((p.get("metadata") or {}).get("namespace") or "default",
                  (p.get("metadata") or {}).get("name", "")) not in self.waiting_pods
         ]
+        qs = self._queue_sort_plugin()
+        if qs is not None:
+            import functools
+
+            pending.sort(key=functools.cmp_to_key(
+                lambda a, b: -1 if qs.less(a, b) else (1 if qs.less(b, a) else 0)))
+            return pending
         # PrioritySort: priority desc, FIFO (creation resourceVersion) within
         pending.sort(
             key=lambda p: (
@@ -187,6 +196,19 @@ class SchedulerEngine:
             )
         )
         return pending
+
+    def _queue_sort_plugin(self):
+        """The enabled custom QueueSort plugin, if any (first match in
+        plugin order across the active profiles)."""
+        cfgs = ([self.plugin_config] if not self.profiles
+                else list(self.profiles.values()))
+        for cfg in cfgs:
+            for name in cfg.enabled:
+                if cfg.is_custom(name):
+                    p = cfg.custom[name]
+                    if getattr(p, "has_queue_sort", False):
+                        return p
+        return None
 
     def schedule_pending(self) -> int:
         """One scheduling wave over all pending pods (plus retry waves for

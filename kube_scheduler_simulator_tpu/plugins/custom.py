@@ -70,6 +70,19 @@ class CustomPlugin:
     def post_bind(self, pod: dict, node: dict) -> None:  # pragma: no cover
         raise NotImplementedError
 
+    def less(self, pod_a: dict, pod_b: dict) -> bool:  # pragma: no cover
+        """QueueSort extension point: True when pod_a should be scheduled
+        before pod_b.  A custom plugin overriding this replaces the
+        default PrioritySort queue order, the way the reference wraps a
+        user QueueSort plugin (wrappedplugin.go:754-771
+        wrappedPluginWithQueueSort; upstream allows exactly one enabled
+        QueueSort plugin)."""
+        raise NotImplementedError
+
+    @property
+    def has_queue_sort(self) -> bool:
+        return type(self).less is not CustomPlugin.less
+
     @property
     def has_filter(self) -> bool:
         return type(self).filter is not CustomPlugin.filter

@@ -207,3 +207,37 @@ def test_custom_scores_beyond_int32_round_trip():
     sr = json.loads(seq[0][0][ann.SCORE_RESULT])
     assert any(int(v["HugeScorer"]) > (1 << 33) - 1
                for v in sr.values())
+
+
+def test_custom_queue_sort_replaces_priority_sort():
+    """A custom plugin overriding less() controls the scheduling order
+    (wrappedPluginWithQueueSort analogue, wrappedplugin.go:754-771);
+    without one, PrioritySort orders by priority desc then FIFO."""
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    class NameSort(CustomPlugin):
+        name = "NameSort"
+
+        def less(self, a, b):  # reverse-alphabetical by name
+            return a["metadata"]["name"] > b["metadata"]["name"]
+
+    store = ObjectStore()
+    store.create("nodes", {"metadata": {"name": "n1"},
+                           "status": {"allocatable": {"cpu": "8", "memory": "16Gi",
+                                                      "pods": "100"}}})
+    for name, prio in [("a", 0), ("b", 50), ("c", 0)]:
+        store.create("pods", {"metadata": {"name": name},
+                              "spec": {"priority": prio,
+                                       "containers": [{"name": "c"}]}})
+    eng = SchedulerEngine(store, plugin_config=PluginSetConfig(
+        enabled=["NodeResourcesFit", "NameSort"],
+        custom={"NameSort": NameSort()}))
+    assert [p["metadata"]["name"] for p in eng.pending_pods()] == ["c", "b", "a"]
+
+    # without the custom sorter: priority desc, then FIFO
+    eng2 = SchedulerEngine(store, plugin_config=PluginSetConfig(
+        enabled=["NodeResourcesFit"]))
+    assert [p["metadata"]["name"] for p in eng2.pending_pods()] == ["b", "a", "c"]

@@ -1,0 +1,221 @@
+"""Table-driven result-store semantics, mirroring the behaviors the
+reference pins in its deepest suite (resultstore/store_test.go, 1.3k LoC):
+score vs normalized-score interplay, weight application, post-filter
+nomination shape, permit timeout, custom results, delete isolation, and
+the merge-over-decoded contract.
+
+Reference: simulator/scheduler/plugin/resultstore/store.go:423-507 (adds),
+:133-198 (GetStoredResult), :509-520 (DeleteData), :617-626 (custom).
+"""
+
+import json
+
+import pytest
+
+from kube_scheduler_simulator_tpu.store import annotations as ann
+from kube_scheduler_simulator_tpu.store.resultstore import ResultStore
+
+
+def _pod(name="p1", ns="default"):
+    return {"metadata": {"name": name, "namespace": ns}}
+
+
+# ---------------------------------------------------------------- score math
+
+SCORE_CASES = [
+    # (weights, adds, expected score-result, expected finalscore-result)
+    # AddScoreResult records the raw value AND pre-populates finalscore
+    # with raw x weight (store.go:461-478 calls the normalize add itself)
+    ("raw_prepopulates_final", {"P": 2},
+     [("score", "n1", "P", 7)],
+     {"n1": {"P": "7"}}, {"n1": {"P": "14"}}),
+    # a later AddNormalizedScoreResult OVERWRITES finalscore (the plugin
+    # had a NormalizeScore extension) but score-result keeps the raw
+    ("normalize_overwrites_final", {"P": 2},
+     [("score", "n1", "P", 7), ("norm", "n1", "P", 100)],
+     {"n1": {"P": "7"}}, {"n1": {"P": "200"}}),
+    # weight missing from the map multiplies by zero (Go zero-value)
+    ("missing_weight_is_zero", {},
+     [("score", "n1", "P", 50)],
+     {"n1": {"P": "50"}}, {"n1": {"P": "0"}}),
+    # negative scores pass through untouched (extenders may produce them)
+    ("negative_scores", {"P": 3},
+     [("score", "n1", "P", -5)],
+     {"n1": {"P": "-5"}}, {"n1": {"P": "-15"}}),
+    # independent nodes and plugins do not cross-contaminate
+    ("per_node_per_plugin", {"A": 1, "B": 2},
+     [("score", "n1", "A", 1), ("score", "n2", "A", 2),
+      ("score", "n1", "B", 3), ("norm", "n1", "B", 10)],
+     {"n1": {"A": "1", "B": "3"}, "n2": {"A": "2"}},
+     {"n1": {"A": "1", "B": "20"}, "n2": {"A": "2"}}),
+]
+
+
+@pytest.mark.parametrize("name,weights,adds,want_score,want_final",
+                         [(c[0], c[1], c[2], c[3], c[4]) for c in SCORE_CASES])
+def test_score_tables(name, weights, adds, want_score, want_final):
+    rs = ResultStore(score_plugin_weight=weights)
+    for kind, node, plugin, val in adds:
+        if kind == "score":
+            rs.add_score_result("default", "p1", node, plugin, val)
+        else:
+            rs.add_normalized_score_result("default", "p1", node, plugin, val)
+    out = rs.get_stored_result(_pod())
+    assert json.loads(out[ann.SCORE_RESULT]) == want_score
+    assert json.loads(out[ann.FINAL_SCORE_RESULT]) == want_final
+
+
+# ------------------------------------------------------------- post filter
+
+def test_postfilter_nominated_shape():
+    """Every candidate node appears; only the nominated one carries the
+    'preemption victim' message (store.go:443-459)."""
+    rs = ResultStore()
+    rs.add_post_filter_result("default", "p1", "n2", "DefaultPreemption",
+                              ["n1", "n2", "n3"])
+    out = rs.get_stored_result(_pod())
+    assert json.loads(out[ann.POST_FILTER_RESULT]) == {
+        "n1": {}, "n2": {"DefaultPreemption": "preemption victim"}, "n3": {},
+    }
+
+
+def test_postfilter_no_nomination_all_empty():
+    rs = ResultStore()
+    rs.add_post_filter_result("default", "p1", "", "DefaultPreemption",
+                              ["n1", "n2"])
+    out = rs.get_stored_result(_pod())
+    assert json.loads(out[ann.POST_FILTER_RESULT]) == {"n1": {}, "n2": {}}
+
+
+# ----------------------------------------------------------------- permit
+
+def test_permit_records_status_and_timeout_keys():
+    rs = ResultStore()
+    rs.add_permit_result("default", "p1", "GateKeeper", "wait", "10s")
+    out = rs.get_stored_result(_pod())
+    assert json.loads(out[ann.PERMIT_STATUS_RESULT]) == {"GateKeeper": "wait"}
+    assert json.loads(out[ann.PERMIT_TIMEOUT_RESULT]) == {"GateKeeper": "10s"}
+
+
+# ------------------------------------------------------------- custom keys
+
+def test_custom_results_ride_alongside_standard_keys():
+    rs = ResultStore()
+    rs.add_filter_result("default", "p1", "n1", "P", "passed")
+    rs.add_custom_result("default", "p1", "my.example.com/depth", "3")
+    rs.add_custom_result("default", "p1", "my.example.com/depth", "4")  # last wins
+    out = rs.get_stored_result(_pod())
+    assert out["my.example.com/depth"] == "4"
+    assert json.loads(out[ann.FILTER_RESULT]) == {"n1": {"P": "passed"}}
+
+
+# -------------------------------------------------------- presence contract
+
+def test_all_thirteen_keys_present_even_when_empty():
+    """GetStoredResult emits every standard key for a known pod, empty
+    maps as '{}' and selected-node as '' (store.go:133-198 emits each
+    add*ToMap unconditionally)."""
+    rs = ResultStore()
+    rs.add_pre_score_result("default", "p1", "P", "success")  # make it known
+    out = rs.get_stored_result(_pod())
+    for key in (ann.PRE_FILTER_RESULT, ann.PRE_FILTER_STATUS_RESULT,
+                ann.FILTER_RESULT, ann.POST_FILTER_RESULT,
+                ann.SCORE_RESULT, ann.FINAL_SCORE_RESULT,
+                ann.RESERVE_RESULT, ann.PERMIT_STATUS_RESULT,
+                ann.PERMIT_TIMEOUT_RESULT, ann.PRE_BIND_RESULT,
+                ann.BIND_RESULT):
+        assert out[key] == "{}", key
+    assert out[ann.PRE_SCORE_RESULT] == '{"P":"success"}'
+    assert out[ann.SELECTED_NODE] == ""
+
+
+def test_unknown_pod_returns_none():
+    rs = ResultStore()
+    rs.add_filter_result("default", "p1", "n1", "P", "passed")
+    assert rs.get_stored_result(_pod(name="other")) is None
+    assert rs.get_stored_result(_pod(name="p1", ns="kube-system")) is None
+
+
+# -------------------------------------------------------------- delete
+
+def test_delete_data_is_per_pod_and_idempotent():
+    rs = ResultStore()
+    rs.add_filter_result("default", "a", "n1", "P", "passed")
+    rs.add_filter_result("default", "b", "n1", "P", "passed")
+    rs.delete_data(_pod(name="a"))
+    assert rs.get_stored_result(_pod(name="a")) is None
+    assert rs.get_stored_result(_pod(name="b")) is not None
+    rs.delete_data(_pod(name="a"))  # no error on double delete
+    # re-adding after delete starts clean
+    rs.add_score_result("default", "a", "n1", "P", 1)
+    out = rs.get_stored_result(_pod(name="a"))
+    assert json.loads(out[ann.FILTER_RESULT]) == {}
+
+
+# --------------------------------------------------- merge-over-decoded
+
+def test_granular_adds_merge_over_decoded_blob():
+    """A custom plugin's granular add must not erase the decoded (tensor-
+    path) entries under the same key, and vice versa."""
+    rs = ResultStore(score_plugin_weight={"Custom": 1})
+    rs.put_decoded("default", "p1", {
+        ann.FILTER_RESULT: '{"n1":{"NodeResourcesFit":"passed"}}',
+        ann.RESERVE_RESULT: '{"VolumeBinding":"success"}',
+    })
+    rs.add_filter_result("default", "p1", "n1", "Custom", "passed")
+    rs.add_reserve_result("default", "p1", "Custom", "success")
+    out = rs.get_stored_result(_pod())
+    assert json.loads(out[ann.FILTER_RESULT]) == {
+        "n1": {"Custom": "passed", "NodeResourcesFit": "passed"}}
+    assert json.loads(out[ann.RESERVE_RESULT]) == {
+        "Custom": "success", "VolumeBinding": "success"}
+
+
+def test_selected_node_granular_overrides_decoded():
+    rs = ResultStore()
+    rs.put_decoded("default", "p1", {ann.SELECTED_NODE: "n1"})
+    out = rs.get_stored_result(_pod())
+    assert out[ann.SELECTED_NODE] == "n1"  # decoded survives when no granular
+    rs.add_selected_node("default", "p1", "n2")
+    out = rs.get_stored_result(_pod())
+    assert out[ann.SELECTED_NODE] == "n2"
+
+
+# ------------------------------------------------- extender result store
+
+def test_extender_store_four_keys_and_unknown_pod():
+    """Same pattern as the plugin store: per-verb map[host]->result, all
+    four keys emitted, None for unknown pods (extender/resultstore/
+    resultstore.go:70-102)."""
+    from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderResultStore
+
+    es = ExtenderResultStore()
+    args = {"Pod": _pod()}
+    es.add_filter_result(args, {"NodeNames": ["n1"]}, "ext-a:8080")
+    es.add_prioritize_result(args, [{"Host": "n1", "Score": 7}], "ext-a:8080")
+    es.add_preempt_result(args, {"NodeNameToMetaVictims": {}}, "ext-b:9090")
+    es.add_bind_result({"PodNamespace": "default", "PodName": "p1"},
+                       {"Error": ""}, "ext-a:8080")
+    out = es.get_stored_result(_pod())
+    assert json.loads(out[ann.EXTENDER_FILTER_RESULT]) == {
+        "ext-a:8080": {"NodeNames": ["n1"]}}
+    assert json.loads(out[ann.EXTENDER_PRIORITIZE_RESULT]) == {
+        "ext-a:8080": [{"Host": "n1", "Score": 7}]}
+    assert json.loads(out[ann.EXTENDER_PREEMPT_RESULT]) == {
+        "ext-b:9090": {"NodeNameToMetaVictims": {}}}
+    assert json.loads(out[ann.EXTENDER_BIND_RESULT]) == {
+        "ext-a:8080": {"Error": ""}}
+    assert es.get_stored_result(_pod(name="ghost")) is None
+    es.delete_data(_pod())
+    assert es.get_stored_result(_pod()) is None
+
+
+def test_extender_store_last_result_per_host_wins():
+    from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderResultStore
+
+    es = ExtenderResultStore()
+    args = {"Pod": _pod()}
+    es.add_filter_result(args, {"NodeNames": ["n1"]}, "h")
+    es.add_filter_result(args, {"NodeNames": ["n2"]}, "h")
+    out = es.get_stored_result(_pod())
+    assert json.loads(out[ann.EXTENDER_FILTER_RESULT]) == {"h": {"NodeNames": ["n2"]}}
