@@ -133,10 +133,15 @@ class RemoteCluster:
             rv = 0
         return items, rv
 
-    def create(self, resource: str, obj: dict) -> dict:
+    def create(self, resource: str, obj: dict, owned: bool = False) -> dict:
+        # owned accepted for in-process-store signature parity; a
+        # serialized HTTP POST never aliases the caller's dict
         return self._request("POST", f"/api/v1/{resource}", obj)
 
-    def update(self, resource: str, obj: dict) -> dict:
+    def update(self, resource: str, obj: dict, owned: bool = False) -> dict:
+        # owned is the in-process store's ownership-transfer hint; a
+        # serialized HTTP PUT never aliases the caller's dict, so it is
+        # accepted and ignored here
         meta = obj.get("metadata") or {}
         path = self._obj_path(resource, meta.get("name", ""), meta.get("namespace"))
         return self._request("PUT", path, obj)

@@ -161,11 +161,14 @@ class ObjectStore:
 
     # ----------------------------------------------------------- CRUD
 
-    def create(self, resource: str, obj: dict) -> dict:
+    def create(self, resource: str, obj: dict, owned: bool = False) -> dict:
+        """owned=True transfers ownership of obj (no entry copy) — see
+        update()."""
         if resource not in RESOURCES:
             raise NotFound(f"unknown resource {resource}")
         _, namespaced = RESOURCES[resource]
-        obj = copy.deepcopy(obj)
+        if not owned:
+            obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
         if namespaced:
             meta.setdefault("namespace", "default")
@@ -184,12 +187,21 @@ class ObjectStore:
             )
             self._stamp_kind(resource, obj)
             self._objects[resource][key] = obj
-            self._notify(resource, ADDED, copy.deepcopy(obj), rv)
-            return copy.deepcopy(obj)
+            # events and the return share the stored dict (see update():
+            # stored objects are replaced, never mutated in place)
+            self._notify(resource, ADDED, obj, rv)
+            return obj
 
-    def update(self, resource: str, obj: dict) -> dict:
+    def update(self, resource: str, obj: dict, owned: bool = False) -> dict:
+        """owned=True transfers ownership of obj to the store (no entry
+        copy) — the caller MUST NOT touch obj afterwards.  The return
+        value and watch events share the stored dict: stored objects are
+        never mutated in place (updates REPLACE them), and consumers must
+        not mutate what they receive (the informer-cache contract, same
+        as list_shared)."""
         _, namespaced = RESOURCES[resource]
-        obj = copy.deepcopy(obj)
+        if not owned:
+            obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
         if namespaced:
             meta.setdefault("namespace", "default")
@@ -226,8 +238,8 @@ class ObjectStore:
             meta.setdefault("creationTimestamp", cur["metadata"].get("creationTimestamp"))
             self._stamp_kind(resource, obj)
             self._objects[resource][key] = obj
-            self._notify(resource, MODIFIED, copy.deepcopy(obj), rv)
-            return copy.deepcopy(obj)
+            self._notify(resource, MODIFIED, obj, rv)
+            return obj
 
     def delete(self, resource: str, name: str, namespace: str | None = None) -> None:
         _, namespaced = RESOURCES[resource]
@@ -237,7 +249,7 @@ class ObjectStore:
             if cur is None:
                 raise NotFound(f"{resource} \"{key}\" not found")
             rv = self._next_rv()
-            self._notify(resource, DELETED, copy.deepcopy(cur), rv)
+            self._notify(resource, DELETED, cur, rv)  # popped: share freely
 
     def get(self, resource: str, name: str, namespace: str | None = None) -> dict:
         _, namespaced = RESOURCES[resource]
@@ -307,12 +319,12 @@ class ObjectStore:
             for resource in RESOURCES:
                 for key in list(self._objects[resource]):
                     cur = self._objects[resource].pop(key)
-                    self._notify(resource, DELETED, copy.deepcopy(cur), self._next_rv())
+                    self._notify(resource, DELETED, cur, self._next_rv())
             for resource, objs in kvs.items():
                 for key, obj in objs.items():
                     obj = copy.deepcopy(obj)
                     self._objects[resource][key] = obj
-                    self._notify(resource, ADDED, copy.deepcopy(obj), self._next_rv())
+                    self._notify(resource, ADDED, obj, self._next_rv())
 
 
 def list_shared(store, resource: str) -> list[dict]:

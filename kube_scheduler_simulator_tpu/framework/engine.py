@@ -17,6 +17,7 @@ update, which also carries their result annotations out.
 from __future__ import annotations
 
 import copy
+import functools
 import time
 
 from .replay import replay
@@ -183,8 +184,6 @@ class SchedulerEngine:
         ]
         qs = self._queue_sort_plugin()
         if qs is not None:
-            import functools
-
             pending.sort(key=functools.cmp_to_key(
                 lambda a, b: -1 if qs.less(a, b) else (1 if qs.less(b, a) else 0)))
             return pending
@@ -198,17 +197,24 @@ class SchedulerEngine:
         return pending
 
     def _queue_sort_plugin(self):
-        """The enabled custom QueueSort plugin, if any (first match in
-        plugin order across the active profiles)."""
+        """The enabled custom QueueSort plugin, if any.  Upstream allows
+        exactly one QueueSort across ALL profiles (the scheduler refuses
+        to start otherwise) — a config with two distinct queue-sort
+        plugins is rejected here the same way."""
         cfgs = ([self.plugin_config] if not self.profiles
                 else list(self.profiles.values()))
+        found: dict[str, object] = {}
         for cfg in cfgs:
             for name in cfg.enabled:
                 if cfg.is_custom(name):
                     p = cfg.custom[name]
                     if getattr(p, "has_queue_sort", False):
-                        return p
-        return None
+                        found[name] = p
+        if len(found) > 1:
+            raise ValueError(
+                "only one QueueSort plugin can be enabled across profiles, "
+                f"got {sorted(found)}")
+        return next(iter(found.values()), None)
 
     def schedule_pending(self) -> int:
         """One scheduling wave over all pending pods (plus retry waves for
@@ -1054,7 +1060,8 @@ class SchedulerEngine:
                 return
             mutate(pod)
             try:
-                self.store.update("pods", pod)
+                # get() returned a private copy; hand it to the store
+                self.store.update("pods", pod, owned=True)
                 return
             except Conflict:
                 time.sleep(0.001)

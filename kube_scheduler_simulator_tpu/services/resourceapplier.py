@@ -99,7 +99,8 @@ class ResourceApplier:
         obj = _strip_immutable(obj)
         for m in self._mutate_create.get(resource, []):
             obj = m(resource, obj)
-        return self.store.create(resource, obj)
+        # _strip_immutable already made a private copy: transfer ownership
+        return self.store.create(resource, obj, owned=True)
 
     def update(self, resource: str, obj: dict) -> dict | None:
         for f in self._filter_update.get(resource, []):
@@ -108,7 +109,8 @@ class ResourceApplier:
         obj = _strip_immutable(obj)
         for m in self._mutate_update.get(resource, []):
             obj = m(resource, obj)
-        return self.store.update(resource, obj)
+        # _strip_immutable already made a private copy: transfer ownership
+        return self.store.update(resource, obj, owned=True)
 
     def delete(self, resource: str, obj: dict) -> None:
         meta = obj.get("metadata") or {}

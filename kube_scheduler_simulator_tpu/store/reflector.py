@@ -84,7 +84,9 @@ class StoreReflector:
             except ValueError:
                 pass  # log-and-continue, as the reference does
             try:
-                self.store.update("pods", pod)
+                # get() returned a private copy; transfer ownership (the
+                # pod dict is only read below, which the contract allows)
+                self.store.update("pods", pod, owned=True)
             except NotFound:
                 return True, None
             except Conflict:

@@ -241,3 +241,32 @@ def test_custom_queue_sort_replaces_priority_sort():
     eng2 = SchedulerEngine(store, plugin_config=PluginSetConfig(
         enabled=["NodeResourcesFit"]))
     assert [p["metadata"]["name"] for p in eng2.pending_pods()] == ["b", "a", "c"]
+
+
+def test_two_queue_sort_plugins_rejected():
+    """Upstream refuses to start with more than one QueueSort plugin;
+    the engine rejects such configs the same way."""
+    import pytest
+
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    class SortA(CustomPlugin):
+        name = "SortA"
+
+        def less(self, a, b):
+            return False
+
+    class SortB(SortA):
+        name = "SortB"
+
+    store = ObjectStore()
+    store.create("pods", {"metadata": {"name": "p"},
+                          "spec": {"containers": [{"name": "c"}]}})
+    eng = SchedulerEngine(store, plugin_config=PluginSetConfig(
+        enabled=["NodeResourcesFit", "SortA", "SortB"],
+        custom={"SortA": SortA(), "SortB": SortB()}))
+    with pytest.raises(ValueError, match="one QueueSort"):
+        eng.pending_pods()

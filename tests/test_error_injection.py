@@ -34,22 +34,22 @@ class FaultyStore(ObjectStore):
         self.conflict_times: int = 0  # fail the next N updates w/ Conflict
         self.calls: list[tuple[str, str, str]] = []
 
-    def create(self, resource, obj):
+    def create(self, resource, obj, **kwargs):
         self.calls.append(("create", resource,
                            (obj.get("metadata") or {}).get("name", "")))
         err = self.fail.get(("create", resource))
         if err is not None:
             raise err
-        return super().create(resource, obj)
+        return super().create(resource, obj, **kwargs)
 
-    def update(self, resource, obj):
+    def update(self, resource, obj, **kwargs):
         if self.conflict_times > 0:
             self.conflict_times -= 1
             raise Conflict(f"injected conflict for {resource}")
         err = self.fail.get(("update", resource))
         if err is not None:
             raise err
-        return super().update(resource, obj)
+        return super().update(resource, obj, **kwargs)
 
 
 class FakeScheduler:

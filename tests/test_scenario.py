@@ -114,9 +114,9 @@ def test_scenario_delete_cancels_and_recreate_is_clean():
         """Store proxy whose create blocks until released."""
         def __getattr__(self, a):
             return getattr(store, a)
-        def create(self, resource, obj):
+        def create(self, resource, obj, **kwargs):
             gate.wait(5)
-            return store.create(resource, obj)
+            return store.create(resource, obj, **kwargs)
 
     svc.store = GateStore()
     node1 = make_nodes(2, seed=43)[0]
