@@ -112,7 +112,10 @@ class RemoteCluster:
 
     # ----------------------------------------------------------- CRUD
 
-    def get(self, resource: str, name: str, namespace: str | None = None) -> dict:
+    def get(self, resource: str, name: str, namespace: str | None = None,
+            copy_object: bool = True) -> dict:
+        # copy_object accepted for in-process-store signature parity; an
+        # HTTP GET always materializes a fresh dict
         return self._request("GET", self._obj_path(resource, name, namespace))
 
     def list(self, resource: str, namespace: str | None = None,

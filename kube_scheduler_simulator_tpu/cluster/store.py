@@ -251,14 +251,18 @@ class ObjectStore:
             rv = self._next_rv()
             self._notify(resource, DELETED, cur, rv)  # popped: share freely
 
-    def get(self, resource: str, name: str, namespace: str | None = None) -> dict:
+    def get(self, resource: str, name: str, namespace: str | None = None,
+            copy_object: bool = True) -> dict:
+        """copy_object=False returns the STORED object (no deep copy) —
+        the read-only fast path; the caller must not mutate it (writers
+        build a new object copy-on-write and update(owned=True))."""
         _, namespaced = RESOURCES[resource]
         key = f"{namespace or 'default'}/{name}" if namespaced else name
         with self._lock:
             cur = self._objects[resource].get(key)
             if cur is None:
                 raise NotFound(f"{resource} \"{key}\" not found")
-            return copy.deepcopy(cur)
+            return copy.deepcopy(cur) if copy_object else cur
 
     def list(self, resource: str, namespace: str | None = None,
              label_selector: dict | None = None,

@@ -1052,15 +1052,23 @@ class SchedulerEngine:
 
     def _update_pod(self, ns: str, name: str, mutate) -> None:
         """Re-fetch + mutate + update with conflict retry (the engine-side
-        analogue of the reflector's conflict-retry write)."""
+        analogue of the reflector's conflict-retry write).
+
+        Copy-on-write: the callback receives a pod whose top level and
+        metadata/spec/status dicts are fresh; anything deeper is SHARED
+        with the stored object and must be replaced, not mutated in place
+        (all current callbacks rebuild the lists they change)."""
         for _ in range(5):
             try:
-                pod = self.store.get("pods", name, ns)
+                cur = self.store.get("pods", name, ns, copy_object=False)
             except NotFound:
                 return
+            pod = dict(cur)
+            pod["metadata"] = dict(cur.get("metadata") or {})
+            pod["spec"] = dict(cur.get("spec") or {})
+            pod["status"] = dict(cur.get("status") or {})
             mutate(pod)
             try:
-                # get() returned a private copy; hand it to the store
                 self.store.update("pods", pod, owned=True)
                 return
             except Conflict:

@@ -68,16 +68,24 @@ class StoreReflector:
 
         def attempt() -> tuple[bool, Exception | None]:
             try:
-                pod = self.store.get("pods", name, namespace)
+                cur = self.store.get("pods", name, namespace,
+                                     copy_object=False)
             except NotFound:
                 return True, None
             result_set: dict[str, str] = {}
             for rs in self.result_stores.values():
-                m = rs.get_stored_result(pod) or {}
+                m = rs.get_stored_result(cur) or {}
                 result_set.update(m)
             if not result_set:
                 return True, None
-            annotations = pod.setdefault("metadata", {}).setdefault("annotations", {})
+            # copy-on-write along the touched path (metadata.annotations):
+            # everything else stays shared with the stored object, which
+            # is replaced — never mutated — by update()
+            pod = dict(cur)
+            meta = dict(cur.get("metadata") or {})
+            annotations = dict(meta.get("annotations") or {})
+            meta["annotations"] = annotations
+            pod["metadata"] = meta
             annotations.update(result_set)
             try:
                 update_result_history(pod, result_set)
