@@ -86,6 +86,10 @@ def main(argv=None) -> None:
                     help="schedule currently-pending pods, then exit")
     args = ap.parse_args(argv)
 
+    from ..utils.platform import apply_env_platform
+
+    apply_env_platform()  # JAX_PLATFORMS=cpu must never touch the TPU tunnel
+
     import yaml
 
     from ..cluster.remote import RemoteCluster

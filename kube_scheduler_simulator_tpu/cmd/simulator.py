@@ -19,6 +19,10 @@ def main(argv=None) -> None:
                     help="simulator config.yaml path (env vars override)")
     args = ap.parse_args(argv)
 
+    from ..utils.platform import apply_env_platform
+
+    apply_env_platform()  # JAX_PLATFORMS=cpu must never touch the TPU tunnel
+
     from ..config.config import load_config
     from ..server.di import DIContainer
     from ..server.server import SimulatorServer

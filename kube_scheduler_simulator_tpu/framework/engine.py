@@ -366,20 +366,26 @@ class SchedulerEngine:
         if self._needs_host_path():
             return self._schedule_host_path(cw, pending)
 
-        with TRACER.span("device_replay", pods=len(pending), nodes=len(nodes)):
-            rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                        mesh=self.mesh)
-        postfilter_on = bool(self.plugin_config.postfilters())
-
-        from ..store.decode import decode_all_parallel
+        from ..store.decode import decode_chunk_into
 
         if self._custom_lifecycle_plugins():
             # a custom Reserve/Permit/PreBind can reject mid-wave and abort
             # the rest — decode per pod so an aborted wave wastes nothing
+            with TRACER.span("device_replay", pods=len(pending), nodes=len(nodes)):
+                rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
+                            mesh=self.mesh)
             all_annotations = _LazyDecode(rr)
         else:
-            with TRACER.span("decode_batch", pods=len(pending)):
-                all_annotations = decode_all_parallel(rr, len(pending))
+            # stream: each chunk decodes (host, thread pool) as soon as its
+            # transfer lands, overlapping the device's later chunks
+            all_annotations = [None] * len(pending)
+            with TRACER.span("replay_and_decode_stream", pods=len(pending),
+                             nodes=len(nodes)):
+                rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
+                            mesh=self.mesh,
+                            on_chunk=lambda rr_, lo, hi: decode_chunk_into(
+                                rr_, lo, hi, all_annotations))
+        postfilter_on = bool(self.plugin_config.postfilters())
         n_bound = 0
         retry: str | None = None
         with TRACER.span("commit_and_reflect", pods=len(pending)):

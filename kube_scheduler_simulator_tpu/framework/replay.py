@@ -342,7 +342,7 @@ def _fetch_chunk(out) -> dict[str, np.ndarray]:
 
 def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
            unroll: int = 1, filter_only: bool = False,
-           mesh=None) -> ReplayResult:
+           mesh=None, on_chunk=None) -> ReplayResult:
     """Run the full queue; returns host-side result arrays.
 
     collect=False skips device->host transfer of the per-node tensors
@@ -359,6 +359,11 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
     max/min, select argmax ride ICI); results are bit-identical to the
     unsharded replay (tests/test_mesh.py parity gate).  The node count
     must divide by the mesh's "nodes" extent.
+    on_chunk: optional callback (rr, lo, hi) fired as each chunk's host
+    fetch lands, while the device runs later chunks — stream consumers
+    (the engine's decode) overlap host work with device compute.  May
+    re-fire from the first chunk if a score width tier overflows, so
+    per-pod writes must be idempotent.
     """
     if mesh is not None:
         from ..parallel.mesh import shard_workload
@@ -380,7 +385,8 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
     tiers = (("i64",) if "i64" in cw.host.get("score_dtypes", ())
              else (None, "i32", "i64"))
     for wide in tiers:
-        result = _replay_run(cw, chunk, collect, unroll, mesh, wide=wide)
+        result = _replay_run(cw, chunk, collect, unroll, mesh, wide=wide,
+                             on_chunk=on_chunk)
         if result is not None:
             return result
     raise AssertionError("unreachable: i64 replay cannot overflow")
@@ -424,7 +430,7 @@ class _TinyOut:
 
 
 def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
-                mesh, wide: str | None) -> ReplayResult | None:
+                mesh, wide: str | None, on_chunk=None) -> ReplayResult | None:
     p = cw.n_pods
     chunk = min(chunk, max(p, 1))
     pack_mode, score_dtypes, score_cols = _compact_plan(cw, wide)
@@ -436,59 +442,85 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
     carry = jax.tree.map(jnp.array, cw.init_carry)
     from concurrent.futures import ThreadPoolExecutor
 
-    chunks: list = []
+    if not collect:
+        outs: list = []
+        for lo in range(0, p, chunk):
+            hi = min(lo + chunk, p)
+            xs_chunk = _slice_xs(cw.xs, lo, hi, chunk)
+            xs_chunk["is_pad"] = (jnp.arange(chunk) >= (hi - lo))
+            carry, out = scan_jit(carry, xs_chunk)
+            outs.append(_TinyOut(out))
+        chunks = [
+            {f: np.asarray(getattr(o, f)) for f in _TinyOut._fields}
+            for o in outs
+        ]
+
+        def cat(field: str) -> np.ndarray:
+            pieces = [c[field] for c in chunks]
+            if not pieces:
+                return np.zeros((0,), dtype=np.int32)
+            return np.concatenate(pieces, axis=0)[:p]
+
+        return ReplayResult(
+            cw=cw, selected=cat("selected"),
+            feasible_count=cat("feasible_count"),
+            prefilter_reject=cat("prefilter_reject"),
+        )
+
+    # collect: chunks are ingested in dispatch order the moment their
+    # fetch lands, so a caller's on_chunk(rr, lo, hi) can decode pods
+    # lo..hi while the device is still running later chunks (the host
+    # decode overlaps device compute; dispatch stays ahead by up to
+    # _MAX_INFLIGHT chunks).  On a width-tier overflow this returns None
+    # mid-stream — the caller re-runs wider and on_chunk fires again from
+    # the first chunk, so its writes must be idempotent per pod index.
+    compact = _CompactChunks(
+        packed=[], raw8=[], raw16=[], raw32=[],
+        chunk=chunk, pack_mode=pack_mode, score_cols=score_cols,
+    )
+    selected = np.full(p, -1, dtype=np.int32)
+    feasible_count = np.zeros(p, dtype=np.int32)
+    prefilter_reject = np.zeros(p, dtype=np.int32)
+    rr = ReplayResult(
+        cw=cw, selected=selected, feasible_count=feasible_count,
+        prefilter_reject=prefilter_reject, compact=compact,
+    )
+    check_overflow = wide != "i64"
+
+    def ingest(c: dict, lo: int) -> bool:
+        if check_overflow and c["raw_overflow"].any():
+            return False  # caller reruns at the next width tier
+        hi = min(lo + chunk, p)
+        m = hi - lo
+        compact.packed.append(c["packed_filter"])
+        compact.raw8.append(c["raw8"])
+        compact.raw16.append(c["raw16"])
+        compact.raw32.append(c["raw32"])
+        selected[lo:hi] = c["selected"][:m]
+        feasible_count[lo:hi] = c["feasible_count"][:m]
+        prefilter_reject[lo:hi] = c["prefilter_reject"][:m]
+        if on_chunk is not None:
+            on_chunk(rr, lo, hi)
+        return True
+
     futures: list = []
+    drained = 0
     with ThreadPoolExecutor(max_workers=3) as pool:
         for lo in range(0, p, chunk):
             hi = min(lo + chunk, p)
             xs_chunk = _slice_xs(cw.xs, lo, hi, chunk)
             xs_chunk["is_pad"] = (jnp.arange(chunk) >= (hi - lo))
             carry, out = scan_jit(carry, xs_chunk)
-            if collect:
-                # dispatch returns immediately; a fetch thread blocks on
-                # this chunk's transfer while the device runs later chunks
-                futures.append(pool.submit(_fetch_chunk, out))
-                del out
-                while len(futures) - len(chunks) > _MAX_INFLIGHT:
-                    chunks.append(futures[len(chunks)].result())
-            else:
-                futures.append(_TinyOut(out))
-        if collect:
-            chunks.extend(f.result() for f in futures[len(chunks):])
-        else:
-            chunks = [
-                {f: np.asarray(getattr(o, f)) for f in _TinyOut._fields}
-                for o in futures
-            ]
-
-    def cat(field: str) -> np.ndarray:
-        pieces = [c[field] for c in chunks]
-        if not pieces:
-            return np.zeros((0,), dtype=np.int32)
-        return np.concatenate(pieces, axis=0)[:p]
-
-    selected = cat("selected")
-    feasible_count = cat("feasible_count")
-    prefilter_reject = cat("prefilter_reject")
-    if not collect:
-        return ReplayResult(
-            cw=cw, selected=selected, feasible_count=feasible_count,
-            prefilter_reject=prefilter_reject,
-        )
-
-    if wide != "i64" and any(c["raw_overflow"].any() for c in chunks):
-        return None  # caller reruns at the next width tier
-
-    compact = _CompactChunks(
-        packed=[c["packed_filter"] for c in chunks],
-        raw8=[c["raw8"] for c in chunks],
-        raw16=[c["raw16"] for c in chunks],
-        raw32=[c["raw32"] for c in chunks],
-        chunk=chunk,
-        pack_mode=pack_mode,
-        score_cols=score_cols,
-    )
-    return ReplayResult(
-        cw=cw, selected=selected, feasible_count=feasible_count,
-        prefilter_reject=prefilter_reject, compact=compact,
-    )
+            # dispatch returns immediately; a fetch thread blocks on this
+            # chunk's transfer while the device runs later chunks
+            futures.append(pool.submit(_fetch_chunk, out))
+            del out
+            while len(futures) - drained > _MAX_INFLIGHT:
+                if not ingest(futures[drained].result(), drained * chunk):
+                    return None
+                drained += 1
+        while drained < len(futures):
+            if not ingest(futures[drained].result(), drained * chunk):
+                return None
+            drained += 1
+    return rr

@@ -241,8 +241,38 @@ def decode_all(rr: ReplayResult) -> list[dict[str, str]]:
     return [decode_pod_result(rr, i) for i in range(rr.cw.n_pods)]
 
 
-def decode_all_parallel(rr: ReplayResult, n: int | None = None,
-                        workers: int = 8) -> list[dict[str, str]]:
+_DECODE_POOL = None
+
+
+def _decode_pool():
+    global _DECODE_POOL
+    if _DECODE_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _DECODE_POOL = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="decode")
+    return _DECODE_POOL
+
+
+def decode_chunk_into(rr, lo: int, hi: int, out: list) -> None:
+    """Decode pods lo..hi of one replay chunk into out[lo:hi] — the
+    replay(on_chunk=...) streaming consumer: runs on the dispatch thread
+    while the device executes later chunks.  Idempotent per index (a
+    width-tier rerun re-delivers chunks)."""
+    cc = getattr(rr, "_compact", None)
+    if cc is None or hi - lo < 64:
+        for i in range(lo, hi):
+            out[i] = decode_pod_result(rr, i)
+        return
+    rr._chunk_recon(lo // cc.chunk, scores=True)  # warm once, here
+    for i, a in zip(range(lo, hi),
+                    _decode_pool().map(lambda i: decode_pod_result(rr, i),
+                                       range(lo, hi))):
+        out[i] = a
+
+
+def decode_all_parallel(rr: ReplayResult,
+                        n: int | None = None) -> list[dict[str, str]]:
     """Decode pods 0..n across a thread pool, chunk by chunk.
 
     The native codec runs outside the GIL (ctypes releases it for the C
@@ -257,15 +287,7 @@ def decode_all_parallel(rr: ReplayResult, n: int | None = None,
     cc = getattr(rr, "_compact", None)
     if cc is None or n < 64:
         return [decode_pod_result(rr, i) for i in range(n)]
-    from concurrent.futures import ThreadPoolExecutor
-
     out: list = [None] * n
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        for lo in range(0, n, cc.chunk):
-            hi = min(lo + cc.chunk, n)
-            rr._chunk_recon(lo // cc.chunk, scores=True)  # warm once, here
-            for i, a in zip(range(lo, hi),
-                            pool.map(lambda i: decode_pod_result(rr, i),
-                                     range(lo, hi))):
-                out[i] = a
+    for lo in range(0, n, cc.chunk):
+        decode_chunk_into(rr, lo, min(lo + cc.chunk, n), out)
     return out

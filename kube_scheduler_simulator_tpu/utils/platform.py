@@ -31,3 +31,14 @@ def force_cpu(n_virtual_devices: int | None = None) -> None:
         xb._backend_factories.pop("axon", None)
     except Exception:
         pass  # jax internals moved; env var path may still suffice
+
+
+def apply_env_platform() -> None:
+    """Entry-point guard: honor JAX_PLATFORMS=cpu hermetically.
+
+    Process mains call this first so a CPU-only run (CI, laptops, a
+    wedged accelerator tunnel) never blocks trying to initialise the
+    TPU client — the sitecustomize-registered plugin ignores the plain
+    env var (see module docstring)."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        force_cpu()

@@ -39,7 +39,8 @@ def test_engine_emits_spans_and_counts():
         store.create("pods", p)
     engine.schedule_pending()
     s = TRACER.summary()
-    for span in ("compile_workload", "device_replay", "commit_and_reflect"):
+    for span in ("compile_workload", "replay_and_decode_stream",
+                 "commit_and_reflect"):
         assert s["spans"][span]["count"] >= 1, span
     assert s["counters"]["pods_scheduled_total"] == 3
     assert s["counters"]["scheduling_waves_total"] >= 1
