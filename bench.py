@@ -149,10 +149,12 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
     }
 
 
-def measure_engine(scale_pods: int, scale_nodes: int, seed: int):
+def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
+                   interpod: bool = False):
     """Serving-path benchmark: ObjectStore -> SchedulerEngine.schedule_pending
     (compile -> replay -> decode -> result store -> reflector write-back),
-    with the tracer span breakdown."""
+    with the tracer span breakdown.  interpod adds InterPodAffinity (the
+    config-5 hard plugin) to the lineup and pod specs."""
     from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
     from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
     from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
@@ -161,11 +163,12 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int):
 
     nodes = make_nodes(scale_nodes, seed=seed, taint_fraction=0.1)
     pods = make_pods(scale_pods, seed=seed + 1, with_affinity=True,
-                     with_tolerations=True, with_spread=True)
+                     with_tolerations=True, with_spread=True,
+                     with_interpod=interpod)
     cfg = PluginSetConfig(enabled=[
         "NodeResourcesFit", "NodeResourcesBalancedAllocation", "NodeAffinity",
         "TaintToleration", "PodTopologySpread",
-    ])
+    ] + (["InterPodAffinity"] if interpod else []))
     store = ObjectStore()
     for n in nodes:
         store.create("nodes", n)
@@ -183,7 +186,8 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int):
         meta = p["metadata"]
         store.delete("pods", meta["name"], meta.get("namespace"))
     for p in make_pods(scale_pods, seed=seed + 1, with_affinity=True,
-                       with_tolerations=True, with_spread=True):
+                       with_tolerations=True, with_spread=True,
+                       with_interpod=interpod):
         store.create("pods", p)
     TRACER.reset()
     t0 = time.time()
@@ -381,6 +385,9 @@ def main():
             # (~300 KiB/pod at 1k nodes; the decoded strings live in the
             # store until the next reset)
             extra["engine_2k_1k"] = measure_engine(2000, 1000, args.seed)
+            # the config-5 hard plugin on the serving path
+            extra["engine_interpod"] = measure_engine(ep, en, args.seed,
+                                                      interpod=True)
 
     # --- CPU baseline ---------------------------------------------------
     cache_path = Path(__file__).parent / ".bench_cpu_cache.json"

@@ -76,7 +76,9 @@ class ResourceWatcherService:
                 # of the listing and double-deliver every object.  Events
                 # racing in between are > list_rv and still buffered, so
                 # nothing is lost.
-                items, list_rv = self.store.list(resource)
+                # shared manifests: send() serializes, never mutates
+                items, list_rv = self.store.list(resource,
+                                                 copy_objects=False)
                 q = self.store.watch(resource, since_rv=list_rv)
                 queues[resource] = q
                 for obj in items:

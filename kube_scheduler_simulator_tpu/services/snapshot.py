@@ -57,9 +57,14 @@ class SnapshotService:
         self.scheduler = scheduler_service
 
     def snap(self, options: SnapshotOptions | None = None) -> dict:
+        """One JSON-able dict of the whole cluster.  The manifests are
+        SHARED with the store (callers serialize or re-apply via load(),
+        which copies) — do not mutate them."""
+        from ..cluster.store import list_shared
+
         out: dict = {}
         for field, resource in _FIELDS:
-            items, _ = self.store.list(resource)
+            items = list_shared(self.store, resource)
             if resource == "namespaces":
                 items = [i for i in items if not _ignored_namespace(i["metadata"]["name"])]
             if resource == "priorityclasses":
