@@ -1,0 +1,172 @@
+"""Per-extension-point recording semantics through the engine — the
+behaviors the reference pins in wrappedplugin_test.go (2k LoC) plus the
+upstream scheduleOne fast paths that shape what gets recorded:
+
+* Filter stops at the first failing plugin per node: the failure message
+  is recorded, earlier plugins record "passed", later plugins record
+  NOTHING for that node (upstream RunFilterPlugins early-return).
+* Scoring is skipped entirely when <=1 node is feasible (upstream
+  schedulePod early-returns before PreScore/Score); selected-node is
+  still set and the pod still binds.
+* A PreFilter Skip records "" (the Skip status has an empty message,
+  wrappedplugin.go:507-516) and suppresses that plugin's Filter on every
+  node.
+"""
+
+import json
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+
+def _run(nodes, pods, enabled):
+    store = ObjectStore()
+    for n in nodes:
+        store.create("nodes", n)
+    engine = SchedulerEngine(store)
+    engine.set_plugin_config(PluginSetConfig(enabled=enabled))
+    for p in pods:
+        store.create("pods", p)
+    engine.schedule_pending()
+    return {p["metadata"]["name"]: p["metadata"].get("annotations", {})
+            for p in store.list("pods")[0]}
+
+
+def _node(name, cpu="4", taints=None, labels=None):
+    n = {"metadata": {"name": name},
+         "status": {"allocatable": {"cpu": cpu, "memory": "8Gi",
+                                    "pods": "110"}}}
+    if taints:
+        n["spec"] = {"taints": taints}
+    if labels:
+        n["metadata"]["labels"] = labels
+    return n
+
+
+def test_filter_stops_at_first_failing_plugin_per_node():
+    """A node failing TaintToleration (earlier in the filter order) must
+    not carry a NodeResourcesFit entry at all — the framework never ran
+    it there — while a node failing only NodeResourcesFit records
+    TaintToleration "passed" first."""
+    anns = _run(
+        nodes=[
+            _node("n-tainted", taints=[{"key": "k", "value": "v",
+                                        "effect": "NoSchedule"}]),
+            _node("n-small", cpu="1"),
+            _node("n-good"),
+        ],
+        pods=[{"metadata": {"name": "p"},
+               "spec": {"containers": [{"name": "c", "resources": {
+                   "requests": {"cpu": "2"}}}]}}],
+        enabled=["TaintToleration", "NodeResourcesFit"],
+    )
+    fr = json.loads(anns["p"][ann.FILTER_RESULT])
+    assert fr["n-tainted"] == {
+        "TaintToleration": "node(s) had untolerated taint {k: v}"}
+    assert fr["n-small"] == {"TaintToleration": "passed",
+                             "NodeResourcesFit": "Insufficient cpu"}
+    assert fr["n-good"] == {"TaintToleration": "passed",
+                            "NodeResourcesFit": "passed"}
+    assert anns["p"][ann.SELECTED_NODE] == "n-good"
+
+
+def test_single_feasible_node_skips_scoring_entirely():
+    """feasibleNodes == 1 -> upstream returns before PreScore/Score: no
+    score/prescore/finalscore records, but the pod binds and
+    selected-node is set."""
+    anns = _run(
+        nodes=[_node("only")],
+        pods=[{"metadata": {"name": "p"},
+               "spec": {"containers": [{"name": "c"}]}}],
+        enabled=["NodeResourcesFit", "NodeResourcesBalancedAllocation"],
+    )
+    a = anns["p"]
+    assert a[ann.SCORE_RESULT] == "{}"
+    assert a[ann.FINAL_SCORE_RESULT] == "{}"
+    assert a[ann.PRE_SCORE_RESULT] == "{}"
+    assert a[ann.SELECTED_NODE] == "only"
+    assert json.loads(a[ann.BIND_RESULT]) == {"DefaultBinder": "success"}
+
+
+def test_prefilter_skip_records_empty_and_suppresses_filter():
+    """NodeAffinity with no required affinity returns Skip from PreFilter:
+    prefilter-result-status records "" and no node carries a NodeAffinity
+    filter entry (the framework skips the plugin's Filter)."""
+    anns = _run(
+        nodes=[_node("a"), _node("b")],
+        pods=[{"metadata": {"name": "p"},
+               "spec": {"containers": [{"name": "c"}]}}],
+        enabled=["NodeAffinity", "NodeResourcesFit"],
+    )
+    a = anns["p"]
+    pf = json.loads(a[ann.PRE_FILTER_STATUS_RESULT])
+    assert pf["NodeAffinity"] == ""
+    assert pf["NodeResourcesFit"] == "success"
+    fr = json.loads(a[ann.FILTER_RESULT])
+    for node_entry in fr.values():
+        assert "NodeAffinity" not in node_entry
+        assert node_entry["NodeResourcesFit"] == "passed"
+
+
+def test_prescore_skip_records_empty_and_suppresses_score():
+    """TaintToleration PreScore with nothing to score (no preferred
+    taints anywhere): upstream still scores (count 0); but NodeAffinity
+    with no preferred terms SKIPS PreScore -> "" recorded, no score rows."""
+    anns = _run(
+        nodes=[_node("a"), _node("b")],
+        pods=[{"metadata": {"name": "p"},
+               "spec": {"containers": [{"name": "c"}]}}],
+        enabled=["NodeAffinity", "NodeResourcesFit"],
+    )
+    a = anns["p"]
+    ps = json.loads(a[ann.PRE_SCORE_RESULT])
+    assert ps.get("NodeAffinity") == ""
+    sr = json.loads(a[ann.SCORE_RESULT])
+    for node_entry in sr.values():
+        assert "NodeAffinity" not in node_entry
+        assert "NodeResourcesFit" in node_entry
+
+
+def test_all_nodes_infeasible_records_empty_selected_and_no_scores():
+    anns = _run(
+        nodes=[_node("small", cpu="1")],
+        pods=[{"metadata": {"name": "p"},
+               "spec": {"containers": [{"name": "c", "resources": {
+                   "requests": {"cpu": "8"}}}]}}],
+        enabled=["NodeResourcesFit"],
+    )
+    a = anns["p"]
+    assert a[ann.SELECTED_NODE] == ""
+    assert a[ann.SCORE_RESULT] == "{}"
+    assert json.loads(a[ann.FILTER_RESULT])["small"] == {
+        "NodeResourcesFit": "Insufficient cpu"}
+    assert a[ann.BIND_RESULT] == "{}"
+
+
+def test_records_merge_into_result_history_per_cycle():
+    """Each completed cycle appends one record to result-history; an
+    unschedulable attempt records too (the reflector runs on every
+    cycle, storereflector.go:87-161)."""
+    store = ObjectStore()
+    store.create("nodes", _node("n", cpu="2"))
+    engine = SchedulerEngine(store)
+    engine.set_plugin_config(PluginSetConfig(enabled=["NodeResourcesFit"]))
+    store.create("pods", {"metadata": {"name": "p"},
+                          "spec": {"containers": [{"name": "c", "resources": {
+                              "requests": {"cpu": "4"}}}]}})
+    engine.schedule_pending()  # infeasible
+    a1 = store.get("pods", "p", "default")["metadata"]["annotations"]
+    h1 = json.loads(a1[ann.RESULT_HISTORY])
+    assert len(h1) == 1 and h1[0][ann.SELECTED_NODE] == ""
+    # grow the node so a second cycle succeeds
+    n = store.get("nodes", "n")
+    n["status"]["allocatable"]["cpu"] = "8"
+    store.update("nodes", n)
+    engine.schedule_pending()
+    a2 = store.get("pods", "p", "default")["metadata"]["annotations"]
+    h2 = json.loads(a2[ann.RESULT_HISTORY])
+    assert len(h2) == 2
+    assert h2[0][ann.SELECTED_NODE] == "" and h2[1][ann.SELECTED_NODE] == "n"
+    assert a2[ann.SELECTED_NODE] == "n"
