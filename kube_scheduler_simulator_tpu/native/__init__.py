@@ -60,6 +60,11 @@ def _build_and_load():
     ]
     lib.codec_free.restype = None
     lib.codec_free.argtypes = [ctypes.c_void_p]
+    lib.encode_string_map.restype = ctypes.c_void_p
+    lib.encode_string_map.argtypes = [
+        P(ctypes.c_char_p), P(ctypes.c_char_p),
+        P(ctypes.c_longlong), ctypes.c_longlong,
+    ]
     return lib
 
 

@@ -30,13 +30,18 @@
 
 namespace {
 
-void append_escaped(std::string& out, const char* s) {
+// one string VALUE, quotes included — Python json.dumps(ensure_ascii=
+// False) escapes (incl. the \b/\f shortcuts) plus Go's HTML escaping of
+// < > & , matching store/annotations.py marshal() byte-for-byte
+void append_escaped_n(std::string& out, const char* s, size_t len) {
     out.push_back('"');
-    for (const unsigned char* p = (const unsigned char*)s; *p; ++p) {
-        unsigned char c = *p;
+    for (size_t i = 0; i < len; ++i) {
+        unsigned char c = (unsigned char)s[i];
         switch (c) {
             case '"': out += "\\\""; break;
             case '\\': out += "\\\\"; break;
+            case '\b': out += "\\b"; break;
+            case '\f': out += "\\f"; break;
             case '\n': out += "\\n"; break;
             case '\r': out += "\\r"; break;
             case '\t': out += "\\t"; break;
@@ -54,6 +59,10 @@ void append_escaped(std::string& out, const char* s) {
         }
     }
     out.push_back('"');
+}
+
+void append_escaped(std::string& out, const char* s) {
+    append_escaped_n(out, s, std::strlen(s));
 }
 
 char* dup_string(const std::string& s) {
@@ -77,6 +86,28 @@ void append_quoted_int(std::string& out, long long v) {
 extern "C" {
 
 void codec_free(char* p) { std::free(p); }
+
+// {"key":"value",...} from pre-sorted keys — the result-history record
+// encoder (values are whole annotation blobs, so the escape pass over
+// hundreds of KiB is the hot part; byte-identical to marshal(dict))
+char* encode_string_map(const char* const* keys,
+                        const char* const* vals,
+                        const long long* val_lens,
+                        long long n) {
+    size_t cap = 2;
+    for (long long i = 0; i < n; ++i) cap += (size_t)val_lens[i] + 48;
+    std::string out;
+    out.reserve(cap);
+    out.push_back('{');
+    for (long long i = 0; i < n; ++i) {
+        if (i) out.push_back(',');
+        append_escaped(out, keys[i]);
+        out.push_back(':');
+        append_escaped_n(out, vals[i], (size_t)val_lens[i]);
+    }
+    out.push_back('}');
+    return dup_string(out);
+}
 
 // filter-result: {"node":{"Plugin":"passed"|msg,...},...}
 //

@@ -175,3 +175,19 @@ def encode_scores(ctx, values: np.ndarray, sskip: np.ndarray, feasible: np.ndarr
         _i32p(ctx["sorted_nodes"]), _i32p(ctx["sorted_scores"]),
     )
     return take_string(lib, ptr)
+
+
+def encode_string_map(d: dict[str, str]) -> str | None:
+    """marshal(d) for a flat str->str dict via the native escape pass —
+    the result-history record encoder.  None when the codec is
+    unavailable (caller falls back to the Python marshal)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    items = sorted(d.items())
+    keys = _c_str_array([k.encode() for k, _ in items])
+    vals_b = [v.encode() for _, v in items]
+    vals = _c_str_array(vals_b)
+    lens = (ctypes.c_longlong * len(items))(*[len(b) for b in vals_b])
+    ptr = lib.encode_string_map(keys, vals, lens, len(items))
+    return take_string(lib, ptr)

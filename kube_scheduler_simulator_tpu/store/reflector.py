@@ -29,13 +29,37 @@ from ..utils.retry import retry_with_exponential_backoff
 RESULT_HISTORY_LIMIT = ann.TOTAL_ANNOTATION_SIZE_LIMIT
 
 
+def _encode_record(result_set: dict[str, str]) -> str:
+    """marshal(result_set) — native escape pass when available (the
+    values are whole annotation blobs; escaping them dominates the
+    reflector's cost at cluster scale)."""
+    from .native_decode import encode_string_map
+
+    rec = encode_string_map(result_set)
+    return rec if rec is not None else ann.marshal(result_set)
+
+
 def update_result_history(pod: dict, result_set: dict[str, str]) -> None:
     """Append result_set to the result-history annotation, trimming oldest
-    entries until the encoded JSON fits the 256KiB limit."""
+    entries until the encoded JSON fits the 256KiB limit.
+
+    Fast path: the existing history is this function's own output (a JSON
+    array), so the new record is spliced in textually — no re-parse and
+    no re-escape of the accumulated records.  The trim branch (only once
+    the limit is hit) falls back to parse + drop-oldest."""
     annotations = pod.setdefault("metadata", {}).setdefault("annotations", {})
     raw = annotations.get(ann.RESULT_HISTORY, "[]")
+    rec = _encode_record(result_set)
+    if raw.startswith("[") and raw.endswith("]"):
+        encoded = ("[" + rec + "]" if raw == "[]"
+                   else raw[:-1] + "," + rec + "]")
+        if len(encoded) <= RESULT_HISTORY_LIMIT:
+            annotations[ann.RESULT_HISTORY] = encoded
+            return
     try:
         results = json.loads(raw)
+        if not isinstance(results, list):
+            results = []
     except json.JSONDecodeError:
         results = []
     results.append(result_set)

@@ -68,3 +68,48 @@ def test_codec_rebuilds_from_source(tmp_path):
     assert lib.encode_filter_result is not None
     assert lib.encode_score_result is not None
     assert lib.codec_free is not None
+
+
+def test_encode_string_map_matches_marshal():
+    """The native history-record encoder is byte-identical to marshal()
+    on quotes, backslashes, control chars, HTML-escaped chars, unicode."""
+    import json
+
+    from kube_scheduler_simulator_tpu.store.annotations import marshal
+    from kube_scheduler_simulator_tpu.store.native_decode import encode_string_map
+
+    cases = [
+        {},
+        {"k": "v"},
+        {"b-key": "1", "a-key": "2"},  # sorted output
+        {"blob": '{"n1":{"P":"passed"}}'},
+        {"nasty": 'q"uo\\te <&> \t\n\r\b\f \x01\x1f'},
+        {"uni": "üñíçødé ✓ 漢"},
+    ]
+    for d in cases:
+        fast = encode_string_map(d)
+        if fast is None:  # codec unavailable on this platform
+            return
+        assert fast == marshal(d)
+        assert json.loads(fast) == d
+
+
+def test_history_splice_matches_full_marshal():
+    """Textual history append produces the same bytes as re-marshalling
+    the whole parsed array."""
+    import json
+
+    from kube_scheduler_simulator_tpu.store import annotations as ann
+    from kube_scheduler_simulator_tpu.store.reflector import update_result_history
+
+    pod = {"metadata": {"name": "p"}}
+    records = [
+        {ann.SELECTED_NODE: "n1", ann.FILTER_RESULT: '{"n1":{"P":"passed"}}'},
+        {ann.SELECTED_NODE: "", ann.FILTER_RESULT: '{"n1":{"P":"Insufficient cpu"}}'},
+        {ann.SELECTED_NODE: "n2"},
+    ]
+    for r in records:
+        update_result_history(pod, r)
+    got = pod["metadata"]["annotations"][ann.RESULT_HISTORY]
+    assert got == ann.marshal(records)
+    assert json.loads(got) == records
