@@ -388,6 +388,17 @@ class SchedulerEngine:
         postfilter_on = bool(self.plugin_config.postfilters())
         n_bound = 0
         retry: str | None = None
+        # write-backs are independent per pod (upstream's reflector runs
+        # on informer callbacks, async from scheduleOne): fan them over a
+        # small pool — the native escape pass releases the GIL — and
+        # settle before the wave returns
+        reflect_futs: list = []
+        pool = self._reflector_pool()
+
+        def drain_reflects():
+            for f in reflect_futs:
+                f.result()
+
         with TRACER.span("commit_and_reflect", pods=len(pending)):
             for i, pod in enumerate(pending):
                 meta = pod.get("metadata") or {}
@@ -423,6 +434,7 @@ class SchedulerEngine:
                         # without this pod so later pods see true (unbound)
                         # state
                         self._mark_unschedulable(ns, name)
+                        drain_reflects()
                         self.reflector.reflect(ns, name)
                         if exclude is not None:
                             exclude.add((ns, name))
@@ -443,8 +455,21 @@ class SchedulerEngine:
                                 cw, rr.codes_of(i), i, pod, ns, name):
                             retry = "preempted"
                     self._mark_unschedulable(ns, name)
-                self.reflector.reflect(ns, name)
+                reflect_futs.append(
+                    pool.submit(self.reflector.reflect, ns, name))
+            drain_reflects()
         return n_bound, retry
+
+    def _reflector_pool(self):
+        """Lazily created pool for the per-pod write-backs."""
+        pool = getattr(self, "_reflect_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(max_workers=4,
+                                      thread_name_prefix="reflect")
+            self._reflect_pool = pool
+        return pool
 
     def _custom_lifecycle_plugins(self) -> list:
         return [
