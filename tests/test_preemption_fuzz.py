@@ -1,0 +1,128 @@
+"""Preemption chain fuzz: random tight clusters with mixed priorities,
+checked against upstream invariants on the END STATE rather than an
+oracle (the scalar oracle does not model PostFilter):
+
+  1. capacity: every node's bound pods fit its allocatable (cpu, memory,
+     pod count) — binds and victim evictions never oversubscribe;
+  2. priority: every evicted victim had strictly lower priority than
+     some pod that still wanted a node at eviction time (upstream
+     DefaultPreemption only preempts lower-priority pods,
+     pkg/scheduler/framework/preemption);
+  3. records: a preemptor that got a nomination carries the
+     postfilter-result "preemption victim" message on its nominated
+     node and eventually binds there or stays nominated.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+MILLI = {"cpu": 1000}
+
+
+def _cpu_m(v: str) -> int:
+    return int(float(v[:-1])) if v.endswith("m") else int(float(v) * 1000)
+
+
+def _mem_b(v: str) -> int:
+    units = {"Ki": 1 << 10, "Mi": 1 << 20, "Gi": 1 << 30}
+    for u, m in units.items():
+        if v.endswith(u):
+            return int(float(v[: -len(u)]) * m)
+    return int(float(v))
+
+
+def _requests(pod):
+    cpu = mem = 0
+    for c in pod["spec"].get("containers", []):
+        r = (c.get("resources") or {}).get("requests") or {}
+        cpu += _cpu_m(r.get("cpu", "0"))
+        mem += _mem_b(r.get("memory", "0"))
+    return cpu, mem
+
+
+@pytest.mark.parametrize("seed", [5, 17, 29])
+def test_preemption_chain_invariants(seed):
+    rng = np.random.default_rng(seed)
+    store = ObjectStore()
+    n_nodes = int(rng.integers(3, 6))
+    for j in range(n_nodes):
+        store.create("nodes", {
+            "metadata": {"name": f"n{j}"},
+            "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                       "pods": "6"}}})
+    engine = SchedulerEngine(store, plugin_config=PluginSetConfig(
+        enabled=["NodeResourcesFit", "NodeResourcesBalancedAllocation",
+                 "DefaultPreemption"]))
+
+    deleted: list[str] = []
+    q = store.watch("pods")
+
+    def drain_deletes():
+        while not q.empty():
+            _, et, obj = q.get()
+            if et == "DELETED":
+                deleted.append(obj["metadata"]["name"])
+
+    # low-priority filler that mostly fills the cluster
+    pods_by_name = {}
+    for i in range(n_nodes * 3):
+        p = {"metadata": {"name": f"low-{i}"},
+             "spec": {"priority": 0, "containers": [{"name": "c", "resources": {
+                 "requests": {"cpu": "1", "memory": "1Gi"}}}]}}
+        pods_by_name[p["metadata"]["name"]] = p
+        store.create("pods", p)
+    engine.schedule_pending()
+    drain_deletes()
+    assert not deleted  # same priority: nothing to preempt
+
+    # high-priority arrivals that cannot fit without evictions
+    for i in range(n_nodes):
+        p = {"metadata": {"name": f"high-{i}"},
+             "spec": {"priority": 100, "containers": [{"name": "c", "resources": {
+                 "requests": {"cpu": "3", "memory": "2Gi"}}}]}}
+        pods_by_name[p["metadata"]["name"]] = p
+        store.create("pods", p)
+    engine.schedule_pending()
+    drain_deletes()
+
+    pods, _ = store.list("pods")
+    by_node: dict[str, list] = {}
+    for p in pods:
+        nn = p["spec"].get("nodeName")
+        if nn:
+            by_node.setdefault(nn, []).append(p)
+
+    # 1. capacity invariant on the end state
+    for nn, bound in by_node.items():
+        node = store.get("nodes", nn)
+        alloc = node["status"]["allocatable"]
+        cpu = sum(_requests(p)[0] for p in bound)
+        mem = sum(_requests(p)[1] for p in bound)
+        assert cpu <= _cpu_m(alloc["cpu"]), f"{nn} cpu oversubscribed"
+        assert mem <= _mem_b(alloc["memory"]), f"{nn} memory oversubscribed"
+        assert len(bound) <= int(alloc["pods"])
+
+    # 2. only the low-priority filler may have been evicted
+    assert deleted, "tight cluster with priority gap must preempt"
+    for name in deleted:
+        assert name.startswith("low-"), f"evicted {name} (priority 100?)"
+
+    # 3. every high pod either bound or carries a nomination + postfilter
+    #    record from its preemption attempt
+    for i in range(n_nodes):
+        p = store.get("pods", f"high-{i}", "default")
+        a = p["metadata"].get("annotations", {})
+        if p["spec"].get("nodeName"):
+            continue
+        nominated = (p.get("status") or {}).get("nominatedNodeName")
+        if nominated:
+            pf = json.loads(a[ann.POST_FILTER_RESULT])
+            assert pf.get(nominated, {}).get("DefaultPreemption") == \
+                "preemption victim"
