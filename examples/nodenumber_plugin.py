@@ -1,0 +1,40 @@
+"""NodeNumber — the classic out-of-tree sample plugin, TPU-simulator style.
+
+Favors nodes whose name ends in the same single digit as the pod's name
+(reverse=True inverts the preference); non-digit suffixes score 0 and
+never fail the cycle.  The reference ships this sample as a Go plugin
+compiled into a debuggable scheduler (reference:
+simulator/docs/sample/nodenumber/plugin.go, wired via WithPlugin in
+docs/integrate-your-scheduler.md); here it is a CustomPlugin registered
+through new_scheduler_command(with_plugins=[...]) — its Score results
+are recorded into score-result/finalscore-result like any in-tree
+plugin's.
+
+Run:  python examples/nodenumber_plugin.py
+"""
+
+from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+
+
+class NodeNumber(CustomPlugin):
+    name = "NodeNumber"
+    default_weight = 1
+
+    def __init__(self, reverse: bool = False):
+        self.reverse = reverse
+
+    def score(self, pod: dict, node: dict) -> int:
+        pod_suffix = (pod.get("metadata", {}).get("name") or "")[-1:]
+        node_suffix = (node.get("metadata", {}).get("name") or "")[-1:]
+        if not (pod_suffix.isdigit() and node_suffix.isdigit()):
+            return 0
+        match = pod_suffix == node_suffix
+        return 10 if match != self.reverse else 0
+
+
+if __name__ == "__main__":
+    from kube_scheduler_simulator_tpu.scheduler.debuggable import new_scheduler_command
+
+    di, server = new_scheduler_command(with_plugins=[NodeNumber()])
+    print(f"simulator with NodeNumber on :{server.port}")
+    server.start(block=True)

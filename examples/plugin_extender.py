@@ -1,0 +1,39 @@
+"""Plugin-extender sample: record extra data onto the pod per cycle.
+
+The reference's sample extender wraps NodeResourcesFit's PreFilter and
+stores what the plugin wrote into the cycle state as a custom annotation
+via the SimulatorHandle (reference:
+simulator/docs/sample/plugin-extender/extender.go AfterPreFilter +
+handle.AddCustomResult).  The analogue here observes the finished cycle
+and records the pod's total requested cpu next to the standard result
+annotations — it lands on the pod as
+`sample.simulator.example.com/requested-cpu`.
+
+Run:  python examples/plugin_extender.py
+"""
+
+from kube_scheduler_simulator_tpu.scheduler.debuggable import (
+    PluginExtender,
+    new_scheduler_command,
+)
+
+
+class RequestedCpuRecorder(PluginExtender):
+    KEY = "sample.simulator.example.com/requested-cpu"
+
+    def after_cycle(self, pod, annotations, result_store):
+        meta = pod.get("metadata") or {}
+        total_m = 0
+        for c in (pod.get("spec") or {}).get("containers", []):
+            v = ((c.get("resources") or {}).get("requests") or {}).get("cpu", "0")
+            total_m += int(float(v[:-1])) if v.endswith("m") else int(float(v) * 1000)
+        result_store.add_custom_result(
+            meta.get("namespace") or "default", meta.get("name", ""),
+            self.KEY, f"{total_m}m")
+
+
+if __name__ == "__main__":
+    di, server = new_scheduler_command(
+        with_plugin_extenders={"NodeResourcesFit": RequestedCpuRecorder()})
+    print(f"simulator with RequestedCpuRecorder on :{server.port}")
+    server.start(block=True)

@@ -270,3 +270,38 @@ def test_two_queue_sort_plugins_rejected():
         custom={"SortA": SortA(), "SortB": SortB()}))
     with pytest.raises(ValueError, match="one QueueSort"):
         eng.pending_pods()
+
+
+def test_example_plugins_work_end_to_end():
+    """The shipped examples (NodeNumber, RequestedCpuRecorder) schedule
+    and record through the engine like the reference's samples do."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent.parent / "examples"))
+    from nodenumber_plugin import NodeNumber
+    from plugin_extender import RequestedCpuRecorder
+
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    store = ObjectStore()
+    for j in (1, 2):
+        store.create("nodes", {"metadata": {"name": f"node{j}"},
+                               "status": {"allocatable": {"cpu": "8",
+                                                          "memory": "16Gi",
+                                                          "pods": "10"}}})
+    eng = SchedulerEngine(store, plugin_config=PluginSetConfig(
+        enabled=["NodeResourcesFit", "NodeNumber"],
+        custom={"NodeNumber": NodeNumber()}))
+    eng.plugin_extenders = {"NodeResourcesFit": RequestedCpuRecorder()}
+    store.create("pods", {"metadata": {"name": "pod2"},
+                          "spec": {"containers": [{"name": "c", "resources": {
+                              "requests": {"cpu": "500m"}}}]}})
+    assert eng.schedule_pending() == 1
+    pod = store.get("pods", "pod2", "default")
+    # NodeNumber: pod2 prefers node2
+    assert pod["spec"]["nodeName"] == "node2"
+    anns = pod["metadata"]["annotations"]
+    assert anns["sample.simulator.example.com/requested-cpu"] == "500m"
