@@ -1,1 +1,7 @@
-from .mesh import make_mesh, shard_workload, sharded_step, speculative_scores  # noqa: F401
+from .mesh import (  # noqa: F401
+    initialize_distributed,
+    make_mesh,
+    shard_workload,
+    sharded_step,
+    speculative_scores,
+)

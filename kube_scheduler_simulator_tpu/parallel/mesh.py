@@ -139,3 +139,26 @@ def speculative_scores(cw: CompiledWorkload, mesh: Mesh | None = None):
         return batched(carry, jax.tree.map(place_batch, xs_batch))
 
     return run
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host entry: start the JAX distributed runtime so
+    jax.devices() returns the GLOBAL device set, after which make_mesh
+    lays the "nodes" axis across hosts unchanged — XLA's collectives
+    ride ICI within a slice and DCN across slices (the scaling-book
+    recipe; the reference has no distributed backend to mirror,
+    SURVEY.md §2.6/§5).
+
+    All arguments default from the standard JAX environment
+    (JAX_COORDINATOR_ADDRESS / processes / id set by the launcher);
+    call once per process before any jax computation."""
+    kwargs: dict = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
