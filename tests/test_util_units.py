@@ -1,0 +1,113 @@
+"""Unit tables for the utility layer and the deprecated entry alias:
+SemaphoredErrGroup (reference: util/semaphored_errgroup.go:17-41),
+RetryWithExponentialBackOff (util/retry.go:10-27), and the deprecated
+pkg/externalscheduler analogue."""
+
+import threading
+import time
+
+import pytest
+
+from kube_scheduler_simulator_tpu.utils.errgroup import SemaphoredErrGroup
+from kube_scheduler_simulator_tpu.utils.retry import (
+    RetryTimeout,
+    retry_with_exponential_backoff,
+)
+
+
+# ---------------------------------------------------------------- errgroup
+
+def test_errgroup_runs_all_and_waits():
+    done = []
+    g = SemaphoredErrGroup(limit=4)
+    for i in range(10):
+        g.go(done.append, i)
+    g.wait()
+    assert sorted(done) == list(range(10))
+
+
+def test_errgroup_bounds_concurrency():
+    active = 0
+    peak = 0
+    lock = threading.Lock()
+
+    def task():
+        nonlocal active, peak
+        with lock:
+            active += 1
+            peak = max(peak, active)
+        time.sleep(0.01)
+        with lock:
+            active -= 1
+
+    g = SemaphoredErrGroup(limit=3)
+    for _ in range(12):
+        g.go(task)
+    g.wait()
+    assert peak <= 3
+
+
+def test_errgroup_reraises_first_error_in_submission_order():
+    g = SemaphoredErrGroup(limit=1)
+    g.go(lambda: None)
+    g.go(lambda: (_ for _ in ()).throw(ValueError("first")))
+    g.go(lambda: (_ for _ in ()).throw(KeyError("second")))
+    with pytest.raises(ValueError, match="first"):
+        g.wait()
+
+
+# ------------------------------------------------------------------ retry
+
+def test_retry_returns_after_transient_failures():
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        return (len(calls) >= 3, None)
+
+    retry_with_exponential_backoff(attempt, sleep=lambda _t: None)
+    assert len(calls) == 3
+
+
+def test_retry_exhaustion_raises_timeout():
+    slept = []
+
+    def attempt():
+        return (False, None)
+
+    with pytest.raises(RetryTimeout):
+        retry_with_exponential_backoff(attempt, sleep=slept.append)
+    # 100ms * 3^n, 6 attempts -> 5 inter-attempt sleeps (util/retry.go:10-27)
+    assert len(slept) == 5
+    assert slept[0] == pytest.approx(0.1)
+    assert slept[1] == pytest.approx(0.3)
+    assert slept[-1] == pytest.approx(8.1)
+
+
+def test_retry_propagates_fatal_error():
+    def attempt():
+        return (False, RuntimeError("fatal"))
+
+    with pytest.raises(RuntimeError, match="fatal"):
+        retry_with_exponential_backoff(attempt, sleep=lambda _t: None)
+
+
+# -------------------------------------------------- deprecated entry alias
+
+def test_externalscheduler_alias_warns_and_validates():
+    from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+    from kube_scheduler_simulator_tpu.scheduler.external import (
+        create_option_for_out_of_tree_plugin,
+    )
+
+    class P(CustomPlugin):
+        name = "P"
+
+        def score(self, pod, node):
+            return 1
+
+    with pytest.warns(DeprecationWarning):
+        assert create_option_for_out_of_tree_plugin(P()) is not None
+    with pytest.warns(DeprecationWarning):
+        with pytest.raises(TypeError):
+            create_option_for_out_of_tree_plugin(object())
