@@ -399,26 +399,24 @@ class SchedulerEngine:
             for f in reflect_futs:
                 f.result()
 
+        emap = self._extenders_map()
+        has_lc = bool(self._custom_lifecycle_plugins())
         with TRACER.span("commit_and_reflect", pods=len(pending)):
             for i, pod in enumerate(pending):
                 meta = pod.get("metadata") or {}
                 ns, name = meta.get("namespace") or "default", meta.get("name", "")
                 annotations = all_annotations[i]
                 self.result_store.put_decoded(ns, name, annotations)
-                emap = self._extenders_map()
                 # one private copy serves every third-party surface this
                 # cycle (hooks and plugins must not reach shared manifests)
-                pod_priv = (copy.deepcopy(pod)
-                            if emap or self._custom_lifecycle_plugins()
-                            else None)
+                priv = copy.deepcopy(pod) if emap or has_lc else pod
                 if emap:
                     for hook in emap.values():
-                        hook.after_cycle(pod_priv, annotations, self.result_store)
+                        hook.after_cycle(priv, annotations, self.result_store)
                 sel = int(rr.selected[i])
                 if sel >= 0:
                     lc = self._run_custom_lifecycle(
-                        pod_priv if pod_priv is not None else pod,
-                        ns, name, cw.node_table.names[sel],
+                        priv, ns, name, cw.node_table.names[sel],
                         allow_async=True, private=True)
                     if lc == "deferred":
                         # Permit "wait" parked the pod; its waiter thread
@@ -440,10 +438,8 @@ class SchedulerEngine:
                             exclude.add((ns, name))
                         return n_bound, "rejected"
                     self._bind(ns, name, cw.node_table.names[sel])
-                    self._run_custom_postbind(
-                        pod_priv if pod_priv is not None else pod,
-                        cw.node_table.names[sel],
-                        private=pod_priv is not None)
+                    self._run_custom_postbind(priv, cw.node_table.names[sel],
+                                              private=True)
                     n_bound += 1
                 else:
                     # PreFilter-rejected pods skip preemption: the static
@@ -511,11 +507,7 @@ class SchedulerEngine:
         from ..utils.duration import parse_duration_seconds
 
         emap = self._extenders_map()
-        node = None
-        try:
-            node = self.store.get("nodes", node_name)
-        except NotFound:
-            pass
+        node = self._get_node(node_name)
         rs = self.result_store
 
         def unreserve_all() -> None:
@@ -668,6 +660,14 @@ class SchedulerEngine:
             with self._waiter_lock:
                 self._waiter_results.append((outcome, ns, name))
 
+    def _get_node(self, node_name: str) -> dict | None:
+        """Private node manifest for third-party plugin calls, None when
+        it vanished mid-cycle."""
+        try:
+            return self.store.get("nodes", node_name)
+        except NotFound:
+            return None
+
     def _unreserve_custom(self, pod, node_name: str,
                           private: bool = False) -> None:
         """Unreserve ALL custom reserve plugins in reverse order — upstream
@@ -679,10 +679,7 @@ class SchedulerEngine:
             return
         if not private:
             pod = copy.deepcopy(pod)
-        try:
-            node = self.store.get("nodes", node_name)
-        except NotFound:
-            node = None
+        node = self._get_node(node_name)
         for p in reversed(plugins):
             p.unreserve(pod, node)
 
@@ -695,10 +692,7 @@ class SchedulerEngine:
         if not private:
             pod = copy.deepcopy(pod)  # plugins must not reach shared manifests
         emap = self._extenders_map()
-        try:
-            node = self.store.get("nodes", node_name)
-        except NotFound:
-            node = None
+        node = self._get_node(node_name)
         for p in plugins:
             ext = emap.get(p.name)
             if ext is not None:
@@ -1020,12 +1014,11 @@ class SchedulerEngine:
             lifecycle_rejected = False
             lifecycle_ok = False
             # one private copy serves every third-party surface this cycle
-            pod_priv = (copy.deepcopy(pod)
-                        if bind_ok and self._custom_lifecycle_plugins() else None)
+            priv = (copy.deepcopy(pod)
+                    if bind_ok and self._custom_lifecycle_plugins() else pod)
             if bind_ok:
-                if self._run_custom_lifecycle(
-                        pod_priv if pod_priv is not None else pod,
-                        ns, name, names[sel], private=True):
+                if self._run_custom_lifecycle(priv, ns, name, names[sel],
+                                              private=True):
                     lifecycle_ok = True
                 else:
                     # here the carry only folds on a successful bind, so a
@@ -1054,14 +1047,11 @@ class SchedulerEngine:
                         bind_ok = False
                     if not bind_ok and lifecycle_ok:
                         # upstream RunReservePluginsUnreserve on bind failure
-                        self._unreserve_custom(pod_priv, bound_node,
-                                               private=True)
+                        self._unreserve_custom(priv, bound_node, private=True)
             if bind_ok:
                 carry = bind_fn(carry, sl, sel)
                 self._bind(ns, name, names[sel])
-                self._run_custom_postbind(
-                    pod_priv if pod_priv is not None else pod, names[sel],
-                    private=pod_priv is not None)
+                self._run_custom_postbind(priv, names[sel], private=True)
                 n_bound += 1
             else:
                 # FitError (no feasible node) runs PostFilter, like the
