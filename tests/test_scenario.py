@@ -171,3 +171,50 @@ def test_scenario_http_api():
             assert len(json.load(r)["items"]) == 1
     finally:
         srv.shutdown()
+
+
+def test_done_operation_skips_later_steps():
+    """doneOperation ends the scenario at its step's boundary — later
+    majors never run (KEP-140 done semantics)."""
+    store = ObjectStore()
+    engine = SchedulerEngine(store)
+    svc = ScenarioService(store, engine)
+    node = make_nodes(1, seed=44)[0]
+    ops = [
+        {"step": 0, "createOperation": {"object": node}},
+        {"step": 0, "doneOperation": {}},
+        {"step": 3, "createOperation": {"object": _pod("never")}},
+    ]
+    svc.create(_scenario(ops, name="sdone"), run=False)
+    sc = svc.run("sdone")
+    assert sc["status"]["phase"] == "Succeeded"
+    import pytest as _pytest
+
+    from kube_scheduler_simulator_tpu.cluster.store import NotFound
+    with _pytest.raises(NotFound):
+        store.get("pods", "never", "default")
+    assert "3" not in sc["status"]["scenarioResult"]["timeline"]
+
+
+def test_sparse_major_steps_execute_in_sorted_order():
+    """Step majors need not be contiguous; execution is ordered by major
+    and the step clock reflects each boundary."""
+    store = ObjectStore()
+    engine = SchedulerEngine(store)
+    svc = ScenarioService(store, engine)
+    node = make_nodes(1, seed=45)[0]
+    ops = [
+        {"step": 7, "createOperation": {"object": _pod("late")}},
+        {"step": 0, "createOperation": {"object": node}},
+        {"step": 2, "createOperation": {"object": _pod("mid")}},
+    ]
+    svc.create(_scenario(ops, name="ssparse"), run=False)
+    sc = svc.run("ssparse")
+    # no doneOperation: the scenario PAUSES after its last step (KEP-140)
+    assert sc["status"]["phase"] == "Paused"
+    tl = sc["status"]["scenarioResult"]["timeline"]
+    assert sorted(tl, key=int) == ["0", "2", "7"]
+    # the controller ran to quiescence after each step: both pods bound
+    assert store.get("pods", "mid", "default")["spec"].get("nodeName")
+    assert store.get("pods", "late", "default")["spec"].get("nodeName")
+    assert sc["status"]["step"]["major"] == 7
