@@ -217,4 +217,4 @@ def test_sparse_major_steps_execute_in_sorted_order():
     # the controller ran to quiescence after each step: both pods bound
     assert store.get("pods", "mid", "default")["spec"].get("nodeName")
     assert store.get("pods", "late", "default")["spec"].get("nodeName")
-    assert sc["status"]["step"]["major"] == 7
+    assert sc["status"]["stepStatus"]["step"]["major"] == 7
