@@ -1,0 +1,137 @@
+"""Concurrency soak: the scheduling loop, API-style writers, watchers and
+snapshot readers hammer one store at once.
+
+The store's shared-listing / copy-on-write write path (informer-cache
+contract) must hold under real thread interleavings: no exceptions on
+any thread, resourceVersions strictly increasing per object update,
+watch streams parse and stay causally consistent, and every surviving
+pod ends bound or cleanly pending.  (SURVEY.md §5 concurrency safety —
+the reference relies on mutexes + apiserver optimistic concurrency; we
+additionally share read snapshots, so this is OUR race surface.)
+"""
+
+import json
+import queue
+import threading
+import time
+
+from kube_scheduler_simulator_tpu.cluster.store import Conflict, NotFound, ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.services.snapshot import SnapshotService
+
+
+class _Sched:
+    def get_config(self):
+        return {"profiles": []}
+
+    def restart_scheduler(self, cfg):
+        pass
+
+
+def test_soak_writers_watchers_scheduler(duration=4.0):
+    store = ObjectStore()
+    for n in make_nodes(8, seed=3):
+        store.create("nodes", n)
+    engine = SchedulerEngine(store, plugin_config=PluginSetConfig(
+        enabled=["NodeResourcesFit", "NodeResourcesBalancedAllocation"]))
+    snap = SnapshotService(store, _Sched())
+
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def guarded(fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — the assertion surface
+                errors.append(e)
+        return run
+
+    counter = {"i": 0}
+    counter_lock = threading.Lock()
+
+    def writer():
+        while not stop.is_set():
+            with counter_lock:
+                i = counter["i"]
+                counter["i"] += 1
+            name = f"soak-{i}"
+            store.create("pods", {"metadata": {"name": name},
+                                  "spec": {"containers": [{"name": "c",
+                                           "resources": {"requests": {
+                                               "cpu": "100m"}}}]}})
+            if i % 3 == 0:
+                # label churn through the conflict-checked update path
+                for _ in range(20):
+                    try:
+                        cur = store.get("pods", name, "default")
+                        cur["metadata"].setdefault("labels", {})["touch"] = str(i)
+                        store.update("pods", cur)
+                        break
+                    except Conflict:
+                        continue
+                    except NotFound:
+                        break
+            if i % 5 == 0 and i > 10:
+                try:
+                    store.delete("pods", f"soak-{i - 10}", "default")
+                except NotFound:
+                    pass
+            time.sleep(0.002)
+
+    def scheduler():
+        while not stop.is_set():
+            engine.schedule_pending()
+            time.sleep(0.01)
+
+    def snapshotter():
+        while not stop.is_set():
+            s = snap.snap()
+            json.dumps(s)  # the export handler's serialization
+            time.sleep(0.02)
+
+    watch_events: list = []
+
+    def watcher():
+        q = store.watch("pods")
+        try:
+            while not stop.is_set():
+                try:
+                    rv, et, obj = q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                # events must be JSON-serializable, carry identity, and
+                # arrive in rv order
+                json.dumps(obj)
+                assert obj["metadata"]["name"]
+                if watch_events:
+                    assert rv > watch_events[-1]
+                watch_events.append(rv)
+        finally:
+            store.unwatch("pods", q)
+
+    threads = [threading.Thread(target=guarded(f), daemon=True)
+               for f in (writer, writer, scheduler, snapshotter, watcher)]
+    for t in threads:
+        t.start()
+    time.sleep(duration)
+    stop.set()
+    for t in threads:
+        t.join(10)
+        assert not t.is_alive(), "thread failed to stop (deadlock?)"
+    assert not errors, errors[:3]
+
+    # settle and check end-state consistency
+    engine.schedule_pending()
+    pods, _ = store.list("pods")
+    assert counter["i"] > 20, "soak produced too little traffic"
+    assert watch_events, "watcher saw no events"
+    for p in pods:
+        nn = (p.get("spec") or {}).get("nodeName")
+        if nn:
+            store.get("nodes", nn)  # bound to a real node
+    # resourceVersions unique across live objects
+    rvs = [p["metadata"]["resourceVersion"] for p in pods]
+    assert len(rvs) == len(set(rvs))
