@@ -119,3 +119,47 @@ def test_record_then_replay_roundtrip(tmp_path):
                     proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+
+
+def test_external_scheduler_mode(tmp_path):
+    """KWOK disableKubeScheduler analogue: the simulator boots with its
+    in-process scheduling loop OFF (EXTERNAL_SCHEDULER_ENABLED), and a
+    standalone cmd/scheduler process drives scheduling over the HTTP API
+    (--once), writing the result annotations back through the remote
+    store."""
+    port = 18233
+    sim = subprocess.Popen(
+        [sys.executable, "-m", "kube_scheduler_simulator_tpu.cmd.simulator"],
+        env=_env(PORT=port, EXTERNAL_SCHEDULER_ENABLED="1"),
+        cwd=str(tmp_path),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _wait_up(port)
+        _api(port, "POST", "/api/v1/nodes", {
+            "metadata": {"name": "ext-node"},
+            "status": {"allocatable": {"cpu": "8", "memory": "32Gi",
+                                       "pods": "110"}}})
+        _api(port, "POST", "/api/v1/pods", {
+            "metadata": {"name": "ext-pod"},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": {"cpu": "1", "memory": "1Gi"}}}]}})
+        time.sleep(2)
+        pod = _api(port, "GET", "/api/v1/pods/default/ext-pod")
+        assert not (pod.get("spec") or {}).get("nodeName"), \
+            "loop must be off in external-scheduler mode"
+
+        r = subprocess.run(
+            [sys.executable, "-m", "kube_scheduler_simulator_tpu.cmd.scheduler",
+             "--master", f"http://127.0.0.1:{port}", "--once"],
+            env=_env(), cwd=str(tmp_path), timeout=240,
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        pod = _api(port, "GET", "/api/v1/pods/default/ext-pod")
+        assert pod["spec"].get("nodeName") == "ext-node"
+        anns = pod["metadata"].get("annotations") or {}
+        key = "kube-scheduler-simulator.sigs.k8s.io/selected-node"
+        assert anns.get(key) == "ext-node"
+    finally:
+        sim.terminate()
+        sim.wait(timeout=15)
