@@ -1,0 +1,188 @@
+"""Table-driven ExtenderResultStore semantics, mirroring the reference's
+extender result-store test tables (simulator/scheduler/extender/resultstore/
+resultstore_test.go:16-1195): GetStoredResult with full/partial/absent data,
+per-verb overwrite keyed by (pod key, extender host), and DeleteData.
+"""
+
+import json
+
+import pytest
+
+from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderResultStore
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+
+def pod(ns="default", name="pod1"):
+    return {"metadata": {"namespace": ns, "name": name}}
+
+
+def args_for(ns="default", name="pod1"):
+    return {"Pod": {"metadata": {"namespace": ns, "name": name}}}
+
+
+FILTER_RES = {"Nodes": None, "NodeNames": ["node1"], "FailedNodes": {}, "Error": ""}
+PRIO_RES = [{"Host": "node1", "Score": 1}]
+PREEMPT_RES = {"NodeNameToMetaVictims": {"node1": {"Pods": []}}}
+BIND_RES = {"Error": ""}
+
+
+class TestGetStoredResult:
+    # resultstore_test.go:27 "success"
+    def test_success_all_verbs(self):
+        s = ExtenderResultStore()
+        s.add_filter_result(args_for(), FILTER_RES, "extenderserver")
+        s.add_prioritize_result(args_for(), PRIO_RES, "extenderserver")
+        s.add_preempt_result(args_for(), PREEMPT_RES, "extenderserver")
+        s.add_bind_result(
+            {"PodNamespace": "default", "PodName": "pod1"}, BIND_RES, "extenderserver")
+        got = s.get_stored_result(pod())
+        assert set(got) == {
+            ann.EXTENDER_FILTER_RESULT, ann.EXTENDER_PRIORITIZE_RESULT,
+            ann.EXTENDER_PREEMPT_RESULT, ann.EXTENDER_BIND_RESULT,
+        }
+        assert json.loads(got[ann.EXTENDER_FILTER_RESULT]) == {
+            "extenderserver": FILTER_RES}
+        assert json.loads(got[ann.EXTENDER_PRIORITIZE_RESULT]) == {
+            "extenderserver": PRIO_RES}
+
+    # resultstore_test.go:112 "do nothing if store doesn't have data"
+    def test_absent_pod_returns_none(self):
+        s = ExtenderResultStore()
+        assert s.get_stored_result(pod()) is None
+        # a result for a DIFFERENT pod must not leak
+        s.add_filter_result(args_for(name="other"), FILTER_RES, "e1")
+        assert s.get_stored_result(pod()) is None
+
+    # resultstore_test.go:122 "success without some data on store":
+    # verbs never recorded still serialize, as empty maps
+    def test_partial_data_serializes_empty_maps(self):
+        s = ExtenderResultStore()
+        s.add_filter_result(args_for(), FILTER_RES, "extenderserver")
+        got = s.get_stored_result(pod())
+        assert json.loads(got[ann.EXTENDER_FILTER_RESULT]) == {
+            "extenderserver": FILTER_RES}
+        for key in (ann.EXTENDER_PRIORITIZE_RESULT, ann.EXTENDER_PREEMPT_RESULT,
+                    ann.EXTENDER_BIND_RESULT):
+            assert got[key] == "{}"
+
+
+ADD_CASES = [
+    ("filter", lambda s, a, r, h: s.add_filter_result(a, r, h),
+     FILTER_RES, {"Nodes": None, "NodeNames": ["node2"], "FailedNodes": {}, "Error": ""},
+     ann.EXTENDER_FILTER_RESULT),
+    ("prioritize", lambda s, a, r, h: s.add_prioritize_result(a, r, h),
+     PRIO_RES, [{"Host": "node2", "Score": 7}], ann.EXTENDER_PRIORITIZE_RESULT),
+    ("preempt", lambda s, a, r, h: s.add_preempt_result(a, r, h),
+     PREEMPT_RES, {"NodeNameToMetaVictims": {}}, ann.EXTENDER_PREEMPT_RESULT),
+]
+
+
+@pytest.mark.parametrize("verb,add,res1,res2,anno_key",
+                         ADD_CASES, ids=[c[0] for c in ADD_CASES])
+class TestAddResultTables:
+    # "overwrite to the already stored data which has the same key and hostname"
+    def test_same_key_same_host_overwrites(self, verb, add, res1, res2, anno_key):
+        s = ExtenderResultStore()
+        add(s, args_for(), res1, "extenderserver")
+        add(s, args_for(), res2, "extenderserver")
+        got = json.loads(s.get_stored_result(pod())[anno_key])
+        assert got == {"extenderserver": res2}
+
+    # "shouldn't overwrite ... same key and different hostname"
+    def test_same_key_different_host_keeps_both(self, verb, add, res1, res2, anno_key):
+        s = ExtenderResultStore()
+        add(s, args_for(), res1, "extender-a")
+        add(s, args_for(), res2, "extender-b")
+        got = json.loads(s.get_stored_result(pod())[anno_key])
+        assert got == {"extender-a": res1, "extender-b": res2}
+
+    # "overwrite to the already stored data which has the different key and
+    # same hostname" — results are per-pod; another pod's entry is untouched
+    def test_different_key_same_host_independent(self, verb, add, res1, res2, anno_key):
+        s = ExtenderResultStore()
+        add(s, args_for(name="pod1"), res1, "extenderserver")
+        add(s, args_for(name="pod2"), res2, "extenderserver")
+        assert json.loads(s.get_stored_result(pod(name="pod1"))[anno_key]) == {
+            "extenderserver": res1}
+        assert json.loads(s.get_stored_result(pod(name="pod2"))[anno_key]) == {
+            "extenderserver": res2}
+
+
+class TestAddBindResult:
+    # bind args carry PodNamespace/PodName directly (ExtenderBindingArgs)
+    def test_bind_key_from_binding_args(self):
+        s = ExtenderResultStore()
+        s.add_bind_result(
+            {"PodNamespace": "ns1", "PodName": "p"}, BIND_RES, "extenderserver")
+        got = s.get_stored_result(pod(ns="ns1", name="p"))
+        assert json.loads(got[ann.EXTENDER_BIND_RESULT]) == {
+            "extenderserver": BIND_RES}
+
+    def test_bind_overwrite_same_host(self):
+        s = ExtenderResultStore()
+        s.add_bind_result({"PodNamespace": "ns1", "PodName": "p"},
+                          {"Error": "first"}, "e")
+        s.add_bind_result({"PodNamespace": "ns1", "PodName": "p"},
+                          {"Error": "second"}, "e")
+        got = json.loads(s.get_stored_result(pod(ns="ns1", name="p"))[
+            ann.EXTENDER_BIND_RESULT])
+        assert got == {"e": {"Error": "second"}}
+
+    def test_bind_two_hosts(self):
+        s = ExtenderResultStore()
+        s.add_bind_result({"PodNamespace": "ns1", "PodName": "p"}, {"Error": ""}, "e1")
+        s.add_bind_result({"PodNamespace": "ns1", "PodName": "p"}, {"Error": "x"}, "e2")
+        got = json.loads(s.get_stored_result(pod(ns="ns1", name="p"))[
+            ann.EXTENDER_BIND_RESULT])
+        assert got == {"e1": {"Error": ""}, "e2": {"Error": "x"}}
+
+
+class TestDeleteData:
+    # resultstore_test.go:1011 "success to delete the stored data which has
+    # the specified key" — only that pod's entry goes away
+    def test_delete_specified_key_only(self):
+        s = ExtenderResultStore()
+        s.add_filter_result(args_for(name="pod1"), FILTER_RES, "e")
+        s.add_filter_result(args_for(name="pod2"), FILTER_RES, "e")
+        s.delete_data(pod(name="pod1"))
+        assert s.get_stored_result(pod(name="pod1")) is None
+        assert s.get_stored_result(pod(name="pod2")) is not None
+
+    # resultstore_test.go:1111 "do nothing if store doesn't have the data"
+    def test_delete_absent_is_noop(self):
+        s = ExtenderResultStore()
+        s.add_filter_result(args_for(name="pod2"), FILTER_RES, "e")
+        s.delete_data(pod(name="absent"))
+        assert s.get_stored_result(pod(name="pod2")) is not None
+
+    def test_readd_after_delete(self):
+        s = ExtenderResultStore()
+        s.add_filter_result(args_for(), FILTER_RES, "e")
+        s.delete_data(pod())
+        s.add_prioritize_result(args_for(), PRIO_RES, "e")
+        got = s.get_stored_result(pod())
+        # filter blob is empty again: delete dropped the whole entry
+        assert got[ann.EXTENDER_FILTER_RESULT] == "{}"
+        assert json.loads(got[ann.EXTENDER_PRIORITIZE_RESULT]) == {"e": PRIO_RES}
+
+
+class TestWireFormat:
+    def test_annotation_keys_exact(self):
+        prefix = "kube-scheduler-simulator.sigs.k8s.io/"
+        assert ann.EXTENDER_FILTER_RESULT == prefix + "extender-filter-result"
+        assert ann.EXTENDER_PRIORITIZE_RESULT == prefix + "extender-prioritize-result"
+        assert ann.EXTENDER_PREEMPT_RESULT == prefix + "extender-preempt-result"
+        assert ann.EXTENDER_BIND_RESULT == prefix + "extender-bind-result"
+
+    def test_go_compact_json(self):
+        s = ExtenderResultStore()
+        s.add_filter_result(args_for(), FILTER_RES, "e")
+        blob = s.get_stored_result(pod())[ann.EXTENDER_FILTER_RESULT]
+        # Go json.Marshal: compact (no spaces), deterministic key order
+        assert ": " not in blob and ", " not in blob
+        assert blob == ann.marshal({"e": FILTER_RES})
+
+    def test_default_namespace_fallback(self):
+        s = ExtenderResultStore()
+        s.add_filter_result({"Pod": {"metadata": {"name": "p"}}}, FILTER_RES, "e")
+        assert s.get_stored_result({"metadata": {"name": "p"}}) is not None

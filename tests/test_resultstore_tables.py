@@ -181,41 +181,5 @@ def test_selected_node_granular_overrides_decoded():
     assert out[ann.SELECTED_NODE] == "n2"
 
 
-# ------------------------------------------------- extender result store
-
-def test_extender_store_four_keys_and_unknown_pod():
-    """Same pattern as the plugin store: per-verb map[host]->result, all
-    four keys emitted, None for unknown pods (extender/resultstore/
-    resultstore.go:70-102)."""
-    from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderResultStore
-
-    es = ExtenderResultStore()
-    args = {"Pod": _pod()}
-    es.add_filter_result(args, {"NodeNames": ["n1"]}, "ext-a:8080")
-    es.add_prioritize_result(args, [{"Host": "n1", "Score": 7}], "ext-a:8080")
-    es.add_preempt_result(args, {"NodeNameToMetaVictims": {}}, "ext-b:9090")
-    es.add_bind_result({"PodNamespace": "default", "PodName": "p1"},
-                       {"Error": ""}, "ext-a:8080")
-    out = es.get_stored_result(_pod())
-    assert json.loads(out[ann.EXTENDER_FILTER_RESULT]) == {
-        "ext-a:8080": {"NodeNames": ["n1"]}}
-    assert json.loads(out[ann.EXTENDER_PRIORITIZE_RESULT]) == {
-        "ext-a:8080": [{"Host": "n1", "Score": 7}]}
-    assert json.loads(out[ann.EXTENDER_PREEMPT_RESULT]) == {
-        "ext-b:9090": {"NodeNameToMetaVictims": {}}}
-    assert json.loads(out[ann.EXTENDER_BIND_RESULT]) == {
-        "ext-a:8080": {"Error": ""}}
-    assert es.get_stored_result(_pod(name="ghost")) is None
-    es.delete_data(_pod())
-    assert es.get_stored_result(_pod()) is None
-
-
-def test_extender_store_last_result_per_host_wins():
-    from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderResultStore
-
-    es = ExtenderResultStore()
-    args = {"Pod": _pod()}
-    es.add_filter_result(args, {"NodeNames": ["n1"]}, "h")
-    es.add_filter_result(args, {"NodeNames": ["n2"]}, "h")
-    out = es.get_stored_result(_pod())
-    assert json.loads(out[ann.EXTENDER_FILTER_RESULT]) == {"h": {"NodeNames": ["n2"]}}
+# Extender result-store semantics live in tests/test_extender_store_tables.py
+# (table-driven mirror of extender/resultstore/resultstore_test.go).
