@@ -9,6 +9,7 @@ plugins_test.go) and ConvertConfigurationForSimulator
 import pytest
 
 from kube_scheduler_simulator_tpu.scheduler.convert import (
+    parse_profile,
     _merge_plugin_set,
     convert_configuration_for_simulator,
     default_scheduler_config,
@@ -128,6 +129,141 @@ def test_convert_keeps_scheduler_names_and_extenders():
     })
     assert cfg["profiles"][0]["schedulerName"] == "custom-sched"
     assert cfg["extenders"][0]["urlPrefix"] == "http://e1"
+
+
+# --------------------------------------------- score plugin weight tables
+#
+# Mirrors getScorePluginWeight (reference plugins.go:289-304, tables at
+# plugins_test.go:1096-1200): union of score.enabled + multiPoint.enabled,
+# explicit weight wins, weight 0 means 1, "Wrapped" suffix trimmed.
+
+WEIGHT_TABLE = [
+    # plugins_test.go:1104 "score and multipoint plugins"
+    ("score and multipoint plugins",
+     {"plugins": {
+         "multiPoint": {"disabled": [{"name": "*"}],
+                        "enabled": [{"name": "TaintToleration", "weight": 4}]},
+         "score": {"enabled": [{"name": "ImageLocality", "weight": 2}]},
+     }},
+     {"TaintToleration": 4, "ImageLocality": 2}),
+    # plugins_test.go:1145 "only score plugins"
+    ("only score plugins",
+     {"plugins": {
+         "multiPoint": {"disabled": [{"name": "*"}]},
+         "score": {"enabled": [{"name": "NodeResourcesBalancedAllocation",
+                                "weight": 7}]},
+     }},
+     {"NodeResourcesBalancedAllocation": 7}),
+    # plugins_test.go:1172 "only multipoint plugins"
+    ("only multipoint plugins",
+     {"plugins": {
+         "multiPoint": {"disabled": [{"name": "*"}],
+                        "enabled": [{"name": "NodeAffinity", "weight": 5}]},
+     }},
+     {"NodeAffinity": 5}),
+    # "a weight of zero is not permitted" -> 1 (plugins.go:297-301)
+    ("explicit zero weight becomes one",
+     {"plugins": {
+         "multiPoint": {"disabled": [{"name": "*"}]},
+         "score": {"enabled": [{"name": "ImageLocality", "weight": 0}]},
+     }},
+     {"ImageLocality": 1}),
+    # suffix trimmed: config written against the converted (Wrapped) names
+    ("wrapped suffix trimmed",
+     {"plugins": {
+         "multiPoint": {"disabled": [{"name": "*"}],
+                        "enabled": [{"name": "TaintTolerationWrapped",
+                                     "weight": 6}]},
+     }},
+     {"TaintToleration": 6}),
+]
+
+
+@pytest.mark.parametrize("name,profile,want", WEIGHT_TABLE,
+                         ids=[t[0] for t in WEIGHT_TABLE])
+def test_score_plugin_weight_tables(name, profile, want):
+    ps = parse_profile(profile)
+    for plugin, w in want.items():
+        assert ps.weight(plugin) == w, plugin
+    # nothing beyond the expected score plugins carries a custom weight
+    assert set(ps.weights) == set(want)
+
+
+def test_default_lineup_weights_match_registry_defaults():
+    """With no user config, every score plugin's weight is its upstream
+    default (the defaulted MultiPoint entries carry those weights)."""
+    from kube_scheduler_simulator_tpu.plugins.registry import PLUGIN_REGISTRY
+
+    ps = parse_profile({})
+    for name, desc in PLUGIN_REGISTRY.items():
+        if desc.has_score:
+            assert ps.weight(name) == desc.default_weight, name
+
+
+def test_specific_score_point_weight_wins_over_multipoint():
+    """DOCUMENTED DELTA (docs/SEMANTICS.md): when a plugin is listed at
+    BOTH score.enabled and multiPoint.enabled with different weights, we
+    use the score-point weight for selection AND annotations (upstream
+    framework semantics: the specific extension point wins). The
+    reference's getScorePluginWeight quirkily lets the multiPoint entry
+    clobber the score entry (plugins.go:292-293 appends MultiPoint last)
+    for its ANNOTATION math only, diverging from its own selection."""
+    ps = parse_profile({"plugins": {
+        "multiPoint": {"disabled": [{"name": "*"}],
+                       "enabled": [{"name": "ImageLocality", "weight": 3}]},
+        "score": {"enabled": [{"name": "ImageLocality", "weight": 9}]},
+    }})
+    assert ps.weight("ImageLocality") == 9
+
+
+# --------------------------------------------- pluginConfig tables
+#
+# NewPluginConfig (reference plugins.go:96-171): per-plugin args keyed by
+# name, later entries for the same plugin override earlier ones (the map
+# write at plugins.go:138), unknown plugins' args carried through.
+
+def test_plugin_config_last_entry_wins():
+    ps = parse_profile({"pluginConfig": [
+        {"name": "NodeResourcesFit", "args": {"scoringStrategy": {"type": "LeastAllocated"}}},
+        {"name": "NodeResourcesFit", "args": {"scoringStrategy": {"type": "MostAllocated"}}},
+    ]})
+    assert ps.args["NodeResourcesFit"]["scoringStrategy"]["type"] == "MostAllocated"
+
+
+def test_plugin_config_wrapped_name_normalized():
+    ps = parse_profile({"pluginConfig": [
+        {"name": "PodTopologySpreadWrapped",
+         "args": {"defaultingType": "List"}},
+    ]})
+    assert ps.args["PodTopologySpread"] == {"defaultingType": "List"}
+
+
+def test_plugin_config_unknown_plugin_args_kept():
+    """Out-of-tree plugin args must survive parsing (plugins.go:109-112
+    keeps non-in-tree configs verbatim) so custom plugins can read them."""
+    ps = parse_profile({"pluginConfig": [
+        {"name": "MyCustomPlugin", "args": {"favor": "node-a"}},
+    ]})
+    assert ps.args["MyCustomPlugin"] == {"favor": "node-a"}
+
+
+def test_plugin_config_empty_args_ignored():
+    ps = parse_profile({"pluginConfig": [{"name": "NodeResourcesFit"}]})
+    assert "NodeResourcesFit" not in ps.args
+
+
+# --------------------------------------------- out-of-tree conversion
+
+def test_convert_wraps_out_of_tree_plugins_too():
+    """plugins_test.go:377 'success with non in-tree plugins': custom
+    plugin names get the Wrapped suffix and ride the same merge."""
+    cfg = convert_configuration_for_simulator({"profiles": [{
+        "plugins": {"multiPoint": {
+            "disabled": [{"name": "*"}],
+            "enabled": [{"name": "CustomPlugin", "weight": 2}]}},
+    }]})
+    assert _mp(cfg)["enabled"] == [{"name": "CustomPluginWrapped", "weight": 2}]
+    assert _mp(cfg)["disabled"] == [{"name": "*"}]
 
 
 def test_parse_profiles_routes_by_scheduler_name():

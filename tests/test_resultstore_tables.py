@@ -183,3 +183,112 @@ def test_selected_node_granular_overrides_decoded():
 
 # Extender result-store semantics live in tests/test_extender_store_tables.py
 # (table-driven mirror of extender/resultstore/resultstore_test.go).
+
+
+# ------------------------------------------------- per-add merge tables
+#
+# store_test.go pins three shapes for every node-keyed add: into an empty
+# store, into an existing map for the SAME node, and alongside a map for a
+# DIFFERENT node (store_test.go:34-152 filter, :284-447 score,
+# :448-583 normalized).
+
+def _filter_blob(rs):
+    return json.loads(rs.get_stored_result(_pod())[ann.FILTER_RESULT])
+
+
+def test_filter_add_into_empty_store():
+    rs = ResultStore()
+    rs.add_filter_result("default", "p1", "node1", "fakeFilterPlugin", "passed")
+    assert _filter_blob(rs) == {"node1": {"fakeFilterPlugin": "passed"}}
+
+
+def test_filter_add_merges_into_existing_node_map():
+    rs = ResultStore()
+    rs.add_filter_result("default", "p1", "node1", "pluginA", "passed")
+    rs.add_filter_result("default", "p1", "node1", "pluginB", "node(s) had taints")
+    assert _filter_blob(rs) == {
+        "node1": {"pluginA": "passed", "pluginB": "node(s) had taints"}}
+
+
+def test_filter_add_creates_second_node_map():
+    rs = ResultStore()
+    rs.add_filter_result("default", "p1", "node1", "pluginA", "passed")
+    rs.add_filter_result("default", "p1", "node2", "pluginA", "passed")
+    assert _filter_blob(rs) == {
+        "node1": {"pluginA": "passed"}, "node2": {"pluginA": "passed"}}
+
+
+def test_filter_add_same_plugin_same_node_overwrites():
+    rs = ResultStore()
+    rs.add_filter_result("default", "p1", "node1", "pluginA", "passed")
+    rs.add_filter_result("default", "p1", "node1", "pluginA", "too many pods")
+    assert _filter_blob(rs) == {"node1": {"pluginA": "too many pods"}}
+
+
+def test_score_add_shapes_mirror_filter():
+    rs = ResultStore({"A": 1, "B": 1})
+    rs.add_score_result("default", "p1", "node1", "A", 10)
+    rs.add_score_result("default", "p1", "node1", "B", 20)
+    rs.add_score_result("default", "p1", "node2", "A", 30)
+    out = rs.get_stored_result(_pod())
+    assert json.loads(out[ann.SCORE_RESULT]) == {
+        "node1": {"A": "10", "B": "20"}, "node2": {"A": "30"}}
+
+
+def test_normalized_add_without_prior_score_creates_final_only():
+    """AddNormalizedScoreResult with no preceding AddScoreResult still
+    writes finalscore (store_test.go:533 'no map for the node'); the raw
+    score blob stays empty for that node."""
+    rs = ResultStore({"P": 3})
+    rs.add_normalized_score_result("default", "p1", "node9", "P", 11)
+    out = rs.get_stored_result(_pod())
+    assert json.loads(out[ann.FINAL_SCORE_RESULT]) == {"node9": {"P": "33"}}
+    assert json.loads(out[ann.SCORE_RESULT]) == {}
+
+
+def test_prefilter_status_and_result_pair():
+    """AddPreFilterResult (store_test.go:835-884): the status blob and the
+    (optional) node-list blob are separate annotations."""
+    rs = ResultStore()
+    rs.add_pre_filter_result("default", "p1", "NodeAffinity", "success",
+                             pre_filter_result=["node1", "node2"])
+    rs.add_pre_filter_result("default", "p1", "NodePorts", "success")
+    out = rs.get_stored_result(_pod())
+    assert json.loads(out[ann.PRE_FILTER_STATUS_RESULT]) == {
+        "NodeAffinity": "success", "NodePorts": "success"}
+    assert json.loads(out[ann.PRE_FILTER_RESULT]) == {
+        "NodeAffinity": ["node1", "node2"]}
+
+
+STATUS_ADDS = [
+    ("prescore", lambda rs: rs.add_pre_score_result("default", "p1", "P", "success"),
+     ann.PRE_SCORE_RESULT),
+    ("reserve", lambda rs: rs.add_reserve_result("default", "p1", "P", "success"),
+     ann.RESERVE_RESULT),
+    ("prebind", lambda rs: rs.add_pre_bind_result("default", "p1", "P", "success"),
+     ann.PRE_BIND_RESULT),
+    ("bind", lambda rs: rs.add_bind_result("default", "p1", "P", "success"),
+     ann.BIND_RESULT),
+]
+
+
+@pytest.mark.parametrize("point,add,key", STATUS_ADDS, ids=[s[0] for s in STATUS_ADDS])
+def test_plugin_status_adds(point, add, key):
+    """AddPreScore/Reserve/PreBind/BindResult success tables
+    (store_test.go:885-927, :1015-1143): plugin -> status string."""
+    rs = ResultStore()
+    add(rs)
+    assert json.loads(rs.get_stored_result(_pod())[key]) == {"P": "success"}
+
+
+def test_get_stored_result_partial_data():
+    """store_test.go:770 'success without some data on store': phases
+    never recorded serialize as empty maps, not missing keys."""
+    rs = ResultStore({"P": 1})
+    rs.add_score_result("default", "p1", "node1", "P", 5)
+    out = rs.get_stored_result(_pod())
+    assert json.loads(out[ann.SCORE_RESULT]) == {"node1": {"P": "5"}}
+    for key in (ann.FILTER_RESULT, ann.POST_FILTER_RESULT, ann.RESERVE_RESULT,
+                ann.PERMIT_STATUS_RESULT, ann.BIND_RESULT):
+        assert out[key] == "{}"
+    assert out[ann.SELECTED_NODE] == ""

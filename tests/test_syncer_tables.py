@@ -1,0 +1,204 @@
+"""Syncer src->dst state tables, mirroring the reference's scenario
+structure (syncer/syncer_test.go:27-496): initial / created / updated /
+deleted objects in the source cluster -> expected final state in the
+destination, including the scheduled-pod-update mandatory filter and
+NotFound-tolerant deletes.
+"""
+
+import time
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import NotFound, ObjectStore
+from kube_scheduler_simulator_tpu.services.resourceapplier import ResourceApplier
+from kube_scheduler_simulator_tpu.services.syncer import SyncerService
+
+
+def pod(name, ns="default", node_name=None, labels=None):
+    p = {"metadata": {"name": name, "namespace": ns}, "spec": {}}
+    if node_name:
+        p["spec"]["nodeName"] = node_name
+    if labels:
+        p["metadata"]["labels"] = dict(labels)
+    return p
+
+
+def node(name, labels=None):
+    n = {"metadata": {"name": name}, "spec": {}}
+    if labels:
+        n["metadata"]["labels"] = dict(labels)
+    return n
+
+
+def wait_for(fn, timeout=2.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            out = fn()
+            if out:
+                return out
+        except NotFound as e:
+            last = e
+        time.sleep(0.01)
+    if last:
+        raise last
+    return fn()
+
+
+def settle():
+    time.sleep(0.25)
+
+
+# Each case: (name, resource, initial objs, scenario(src) steps,
+#             expected final names in dst, extra assertion)
+SYNC_TABLE = [
+    # syncer_test.go:39 "unscheduled pod is created in src cluster"
+    ("initial unscheduled pod lands in dst", "pods",
+     [pod("pod-1")], lambda src: None, {"pod-1"}, None),
+    # syncer_test.go:150 "pod is created and deleted in src cluster"
+    ("created then deleted pod ends absent", "pods",
+     [], lambda src: (src.create("pods", pod("pod-1")),
+                      settle(),
+                      src.delete("pods", "pod-1")),
+     set(), None),
+    # syncer_test.go:227 "unscheduled pod is updated in src cluster"
+    ("unscheduled pod update propagates", "pods",
+     [pod("pod-1")],
+     lambda src: src.update("pods", dict(
+         src.get("pods", "pod-1"), metadata={
+             "name": "pod-1", "namespace": "default",
+             "labels": {"stage": "v2"}})),
+     {"pod-1"},
+     lambda dst: dst.get("pods", "pod-1")["metadata"]["labels"] == {"stage": "v2"}),
+    # nodes sync like pods but with no scheduling filter
+    ("node create update delete", "nodes",
+     [node("n1"), node("n2")],
+     lambda src: (src.update("nodes", dict(
+         src.get("nodes", "n1"), metadata={"name": "n1", "labels": {"zone": "z1"}})),
+         settle(),
+         src.delete("nodes", "n2")),
+     {"n1"},
+     lambda dst: dst.get("nodes", "n1")["metadata"]["labels"] == {"zone": "z1"}),
+]
+
+
+@pytest.mark.parametrize("name,resource,initial,scenario,want,extra", SYNC_TABLE,
+                         ids=[c[0] for c in SYNC_TABLE])
+def test_sync_scenarios(name, resource, initial, scenario, want, extra):
+    src, dst = ObjectStore(), ObjectStore()
+    for obj in initial:
+        src.create(resource, obj)
+    syncer = SyncerService(src, ResourceApplier(dst))
+    syncer.run()
+    try:
+        scenario(src)
+        settle()
+        if want:
+            for n in want:
+                wait_for(lambda n=n: dst.get(resource, n))
+        else:
+            settle()
+        got = {o["metadata"]["name"] for o in dst.list(resource)[0]}
+        assert got == want
+        if extra:
+            assert wait_for(lambda: extra(dst))
+    finally:
+        syncer.stop()
+
+
+def test_scheduled_pod_update_not_synced():
+    """syncer_test.go:293 'scheduled pod is NOT updated in src cluster':
+    an update whose INCOMING pod carries spec.nodeName (a source-side
+    bind) is dropped by the applier's mandatory filterPodsForUpdating
+    hook (resourceapplier/resource.go:85-100) — placement in the
+    simulator belongs to the simulator's own scheduler."""
+    src, dst = ObjectStore(), ObjectStore()
+    src.create("pods", pod("pod-1"))
+    syncer = SyncerService(src, ResourceApplier(dst))
+    syncer.run()
+    try:
+        wait_for(lambda: dst.get("pods", "pod-1"))
+        # the SOURCE cluster's scheduler binds the pod and labels it; the
+        # update reaching the syncer carries nodeName -> filtered out
+        sp = src.get("pods", "pod-1")
+        sp["spec"]["nodeName"] = "src-node"
+        sp["metadata"]["labels"] = {"overwrite": "attempt"}
+        src.update("pods", sp)
+        settle()
+        after = dst.get("pods", "pod-1")
+        assert after["spec"].get("nodeName") is None
+        assert after["metadata"].get("labels", {}) != {"overwrite": "attempt"}
+    finally:
+        syncer.stop()
+
+
+def test_unscheduled_update_racing_simulator_bind_loses():
+    """Defense in depth behind the filter hook: even an update WITHOUT a
+    source-side nodeName cannot clobber a binding the simulator already
+    wrote — the store's write-once nodeName validation rejects it
+    (cluster/store.py) and the syncer tolerates the error."""
+    src, dst = ObjectStore(), ObjectStore()
+    src.create("pods", pod("pod-1"))
+    syncer = SyncerService(src, ResourceApplier(dst))
+    syncer.run()
+    try:
+        wait_for(lambda: dst.get("pods", "pod-1"))
+        bound = dst.get("pods", "pod-1")
+        bound["spec"]["nodeName"] = "node-a"   # simulator scheduled it
+        dst.update("pods", bound)
+        sp = src.get("pods", "pod-1")
+        sp["metadata"]["labels"] = {"overwrite": "attempt"}
+        src.update("pods", sp)                 # unscheduled in src
+        settle()
+        after = dst.get("pods", "pod-1")
+        assert after["spec"].get("nodeName") == "node-a"
+        assert after["metadata"].get("labels", {}) != {"overwrite": "attempt"}
+    finally:
+        syncer.stop()
+
+
+def test_scheduled_pod_delete_still_synced():
+    """Deletion is not filtered: a pod removed from the source disappears
+    from the simulator even after binding (only *updates* of scheduled
+    pods are skipped)."""
+    src, dst = ObjectStore(), ObjectStore()
+    src.create("pods", pod("pod-1"))
+    syncer = SyncerService(src, ResourceApplier(dst))
+    syncer.run()
+    try:
+        wait_for(lambda: dst.get("pods", "pod-1"))
+        bound = dst.get("pods", "pod-1")
+        bound["spec"]["nodeName"] = "node-a"
+        dst.update("pods", bound)
+        src.delete("pods", "pod-1")
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            try:
+                dst.get("pods", "pod-1")
+                time.sleep(0.01)
+            except NotFound:
+                break
+        with pytest.raises(NotFound):
+            dst.get("pods", "pod-1")
+    finally:
+        syncer.stop()
+
+
+def test_delete_of_never_synced_object_tolerated():
+    """Delete events for objects the destination never saw must not kill
+    the sync loop (NotFound tolerated, syncer.go Add/Update/Delete)."""
+    src, dst = ObjectStore(), ObjectStore()
+    src.create("pods", pod("ghost"))
+    syncer = SyncerService(src, ResourceApplier(dst))
+    syncer.run()
+    try:
+        wait_for(lambda: dst.get("pods", "ghost"))
+        dst.delete("pods", "ghost")       # dst-side deletion out of band
+        src.delete("pods", "ghost")       # syncer's delete now hits NotFound
+        settle()
+        # loop still alive: a fresh create must still sync
+        src.create("pods", pod("after"))
+        assert wait_for(lambda: dst.get("pods", "after"))
+    finally:
+        syncer.stop()

@@ -1,0 +1,198 @@
+"""Resource-watcher + StreamWriter error tables, mirroring the reference's
+eventproxy/streamwriter suites (resourcewatcher/eventproxy_test.go:71-600,
+streamwriter/streamwriter_test.go): initial-list delivery, event-sequence
+ordering, write-failure teardown, and watcher-registration cleanup.
+"""
+
+import json
+import threading
+import time
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.services.resourcewatcher import (
+    ResourceWatcherService,
+    StreamWriter,
+)
+
+
+def pod(name, ns="default"):
+    return {"metadata": {"name": name, "namespace": ns}, "spec": {}}
+
+
+def node(name):
+    return {"metadata": {"name": name}, "spec": {}}
+
+
+class SinkStream:
+    """Collects decoded events; can be armed to fail after N writes
+    (eventproxy_test.go:219 'should return an error when the Write method
+    returns an error')."""
+
+    def __init__(self, fail_after=None):
+        self.events = []
+        self.fail_after = fail_after
+        self._lock = threading.Lock()
+
+    def write(self, data: bytes):
+        with self._lock:
+            if self.fail_after is not None and len(self.events) >= self.fail_after:
+                raise BrokenPipeError("client went away")
+            self.events.append(json.loads(data))
+
+
+def run_list_watch(svc, stream, lrv=None, settle=0.3):
+    stop = threading.Event()
+    t = threading.Thread(
+        target=svc.list_watch, args=(StreamWriter(stream.write), lrv, stop),
+        daemon=True)
+    t.start()
+    time.sleep(settle)
+    return stop, t
+
+
+def finish(stop, t):
+    stop.set()
+    t.join(timeout=2)
+    assert not t.is_alive()
+
+
+class TestStreamWriter:
+    # streamwriter_test.go "should call Write method" / "twice"
+    def test_send_writes_one_json_line_per_event(self):
+        sink = SinkStream()
+        w = StreamWriter(sink.write)
+        assert w.send("Pod", "ADDED", pod("a"))
+        assert w.send("Pod", "MODIFIED", pod("a"))
+        assert [e["eventType"] for e in sink.events] == ["ADDED", "MODIFIED"]
+        assert sink.events[0] == {
+            "kind": "Pod", "eventType": "ADDED", "obj": pod("a")}
+
+    # "should return an error when the Write method returns an error"
+    def test_send_reports_write_failure(self):
+        sink = SinkStream(fail_after=1)
+        w = StreamWriter(sink.write)
+        assert w.send("Pod", "ADDED", pod("a"))
+        assert not w.send("Pod", "ADDED", pod("b"))
+
+    def test_concurrent_sends_serialized(self):
+        chunks = []
+        in_flight = threading.Semaphore(1)
+        overlapped = []
+
+        def write(data):
+            # a second writer entering while one is mid-write proves the
+            # StreamWriter lock failed to serialize the send
+            if not in_flight.acquire(blocking=False):
+                overlapped.append(True)
+            time.sleep(0.001)
+            chunks.append(data)
+            in_flight.release()
+
+        w = StreamWriter(write)
+        threads = [threading.Thread(
+            target=lambda i=i: [w.send("Pod", "ADDED", pod(f"p{i}-{j}"))
+                                for j in range(20)])
+            for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(chunks) == 80
+        assert not overlapped
+        for c in chunks:
+            json.loads(c)  # every chunk is one complete JSON document
+
+
+class TestListWatch:
+    # eventproxy_test.go:71 "should list the resource and update the
+    # lastResourceVersion": initial listing arrives as ADDED events
+    def test_initial_list_as_added_events(self):
+        s = ObjectStore()
+        s.create("nodes", node("n1"))
+        s.create("pods", pod("p1"))
+        svc = ResourceWatcherService(s, resources=["nodes", "pods"])
+        sink = SinkStream()
+        stop, t = run_list_watch(svc, sink)
+        finish(stop, t)
+        got = {(e["kind"], e["obj"]["metadata"]["name"]) for e in sink.events}
+        assert got == {("Node", "n1"), ("Pod", "p1")}
+        assert all(e["eventType"] == "ADDED" for e in sink.events)
+
+    # eventproxy_test.go:266-527 event sequences: ADDED / MODIFIED /
+    # DELETED arrive in order on the live stream
+    def test_live_event_sequence_in_order(self):
+        s = ObjectStore()
+        svc = ResourceWatcherService(s, resources=["pods"])
+        sink = SinkStream()
+        stop, t = run_list_watch(svc, sink)
+        s.create("pods", pod("a"))
+        time.sleep(0.1)
+        s.update("pods", s.get("pods", "a"))
+        time.sleep(0.1)
+        s.delete("pods", "a")
+        time.sleep(0.3)
+        finish(stop, t)
+        assert [e["eventType"] for e in sink.events] == [
+            "ADDED", "MODIFIED", "DELETED"]
+
+    # handler/watcher.go:23-45 lastResourceVersion: nonzero rv skips the
+    # initial listing and replays only newer events
+    def test_resume_from_rv_skips_initial_list(self):
+        s = ObjectStore()
+        s.create("pods", pod("old"))
+        _, rv = s.list("pods")
+        svc = ResourceWatcherService(s, resources=["pods"])
+        sink = SinkStream()
+        stop, t = run_list_watch(svc, sink, lrv={"pods": rv})
+        s.create("pods", pod("new"))
+        time.sleep(0.3)
+        finish(stop, t)
+        names = [e["obj"]["metadata"]["name"] for e in sink.events]
+        assert names == ["new"]
+
+    # eventproxy_test.go:219: a dead client mid-initial-list aborts the
+    # stream AND unregisters every watch queue (no leak)
+    def test_write_failure_mid_list_cleans_up_watchers(self):
+        s = ObjectStore()
+        for i in range(5):
+            s.create("pods", pod(f"p{i}"))
+        svc = ResourceWatcherService(s, resources=["pods"])
+        sink = SinkStream(fail_after=2)
+        stop = threading.Event()
+        svc.list_watch(StreamWriter(sink.write), None, stop)  # returns, no hang
+        assert len(sink.events) == 2
+        assert s._watchers["pods"] == []
+
+    def test_write_failure_on_live_stream_stops_pumps(self):
+        s = ObjectStore()
+        svc = ResourceWatcherService(s, resources=["pods"])
+        sink = SinkStream(fail_after=1)
+        stop, t = run_list_watch(svc, sink)
+        s.create("pods", pod("a"))   # delivered
+        time.sleep(0.1)
+        s.create("pods", pod("b"))   # write raises -> dead
+        t.join(timeout=2)
+        assert not t.is_alive()
+        assert len(sink.events) == 1
+
+    def test_stop_unregisters_all_watch_queues(self):
+        s = ObjectStore()
+        svc = ResourceWatcherService(s)  # all 7 default kinds
+        sink = SinkStream()
+        stop, t = run_list_watch(svc, sink, settle=0.2)
+        assert sum(len(qs) for qs in s._watchers.values()) >= 7
+        finish(stop, t)
+        assert sum(len(qs) for qs in s._watchers.values()) == 0
+
+    def test_two_clients_independent_streams(self):
+        s = ObjectStore()
+        svc = ResourceWatcherService(s, resources=["pods"])
+        a, b = SinkStream(), SinkStream()
+        stop_a, ta = run_list_watch(svc, a, settle=0.1)
+        stop_b, tb = run_list_watch(svc, b, settle=0.1)
+        s.create("pods", pod("x"))
+        time.sleep(0.3)
+        finish(stop_a, ta)
+        finish(stop_b, tb)
+        for sink in (a, b):
+            assert [e["obj"]["metadata"]["name"] for e in sink.events] == ["x"]
