@@ -391,11 +391,39 @@ class SchedulerEngine:
         # write-backs are independent per pod (upstream's reflector runs
         # on informer callbacks, async from scheduleOne): fan them over a
         # small pool — the native escape pass releases the GIL — and
-        # settle before the wave returns
+        # settle before the wave returns.  Submissions are chunked so a
+        # 10k-pod wave costs ~150 futures, not 10k.
         reflect_futs: list = []
+        reflect_batch: list[tuple[str, str]] = []
         pool = self._reflector_pool()
+        reflect_one = self.reflector.reflect
+        # small waves still fan across the pool; 10k-pod waves cost ~150
+        # futures instead of 10k
+        batch_n = max(1, min(64, len(pending) // 8))
+
+        def run_batch(batch):
+            # every pod's write-back is attempted even if an earlier one
+            # fails (matching the one-future-per-pod behavior); the first
+            # error still surfaces from drain_reflects()
+            first_err = None
+            for bns, bname in batch:
+                try:
+                    reflect_one(bns, bname)
+                except Exception as e:  # noqa: BLE001
+                    first_err = first_err or e
+            if first_err is not None:
+                raise first_err
+
+        def submit_reflect(bns, bname):
+            reflect_batch.append((bns, bname))
+            if len(reflect_batch) >= batch_n:
+                reflect_futs.append(pool.submit(run_batch, reflect_batch[:]))
+                reflect_batch.clear()
 
         def drain_reflects():
+            if reflect_batch:
+                reflect_futs.append(pool.submit(run_batch, reflect_batch[:]))
+                reflect_batch.clear()
             for f in reflect_futs:
                 f.result()
 
@@ -451,8 +479,7 @@ class SchedulerEngine:
                                 cw, rr.codes_of(i), i, pod, ns, name):
                             retry = "preempted"
                     self._mark_unschedulable(ns, name)
-                reflect_futs.append(
-                    pool.submit(self.reflector.reflect, ns, name))
+                submit_reflect(ns, name)
             drain_reflects()
         return n_bound, retry
 
