@@ -846,7 +846,12 @@ class SchedulerEngine:
             for entry in plist or []:
                 j = name_to_idx.get(entry.get("Host") or entry.get("host", ""))
                 if j is not None:
-                    total[j] += int(entry.get("Score") or entry.get("score") or 0) * ext.weight
+                    # reference extender.go:145: score x weight x
+                    # (MaxNodeScore/MaxExtenderPriority) rescales the
+                    # extender's 0-10 priority onto the 0-100 node-score
+                    # range before weighting
+                    total[j] += (int(entry.get("Score") or entry.get("score") or 0)
+                                 * ext.weight * 10)
 
     def _hooked_filter_phase(self, cw, pod, pod_idx, codes, names, hooks):
         """Run Before/After filter hooks per node with the reference's

@@ -121,6 +121,34 @@ def test_engine_phased_path_with_extender(fake_extender):
     assert "node-00000" not in fs
 
 
+def test_prioritize_scores_scaled_weight_times_ten():
+    """reference extender.go:145: Score x weight x (MaxNodeScore /
+    MaxExtenderPriority) — an extender priority of 1 at weight 1 adds 10
+    node-score points, enough to beat a 9-point plugin-score edge."""
+    import numpy as np
+
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+
+    class FakeExt:
+        prioritize_verb = "prioritize"
+        weight = 1
+
+    class FakeSvc:
+        extenders = [FakeExt()]
+
+        def handle(self, verb, idx, args):
+            assert verb == "prioritize"
+            return [{"Host": "n0", "Score": 1}]   # max extender pref: small raw
+
+    eng = SchedulerEngine(ObjectStore())
+    eng.extender_service = FakeSvc()
+    names = ["n0", "n1"]
+    total = np.array([0, 9], dtype=np.int64)      # n1 ahead by 9 plugin points
+    eng._webhook_prioritize({}, names, {"n0": 0, "n1": 1},
+                            np.array([True, True]), total)
+    assert total.tolist() == [10, 9]              # x10 rescale flips the winner
+
+
 def _capacity_node(name):
     return {"metadata": {"name": name},
             "status": {"allocatable": {"cpu": "2", "memory": "4Gi", "pods": "10"}}}
