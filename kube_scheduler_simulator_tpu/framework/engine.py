@@ -798,11 +798,19 @@ class SchedulerEngine:
         for idx, ext in enumerate(extenders):
             if not ext.filter_verb or not feasible.any():
                 continue
+            if not ext.is_interested(pod):
+                continue
             node_names = [names[j] for j in np.flatnonzero(feasible)]
             args = {"Pod": pod, "NodeNames": node_names}
             try:
                 result = self.extender_service.handle("filter", idx, args)
             except Exception:
+                if ext.ignorable:
+                    continue
+                return True
+            # an Error string in the response body is a failed extender
+            # call even over HTTP 200 (upstream HTTPExtender.Filter)
+            if result.get("Error") or result.get("error"):
                 if ext.ignorable:
                     continue
                 return True
@@ -835,6 +843,8 @@ class SchedulerEngine:
         extenders = self.extender_service.extenders if self.extender_service else []
         for idx, ext in enumerate(extenders):
             if not ext.prioritize_verb or feasible.sum() <= 1:
+                continue
+            if not ext.is_interested(pod):
                 continue
             node_names = [names[j] for j in np.flatnonzero(feasible)]
             try:
@@ -1061,8 +1071,11 @@ class SchedulerEngine:
             if bind_ok:
                 bound_node = names[sel]
                 extenders = self.extender_service.extenders if self.extender_service else []
+                # upstream extendersBinding: the binder must also be
+                # interested in the pod (IsBinder AND IsInterested)
                 bind_ext = next(
-                    (k for k, e in enumerate(extenders) if e.bind_verb),
+                    (k for k, e in enumerate(extenders)
+                     if e.bind_verb and e.is_interested(pod)),
                     None,
                 )
                 if bind_ext is not None:

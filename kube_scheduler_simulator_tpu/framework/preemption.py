@@ -301,6 +301,8 @@ class Preemptor:
         for idx, ext in enumerate(self.extender_service.extenders):
             if not ext.preempt_verb or not node_to_victims:
                 continue
+            if not ext.is_interested(pod):
+                continue
             args = {"Pod": pod, "NodeNameToVictims": node_to_victims}
             try:
                 result = self.extender_service.handle("preempt", idx, args)

@@ -51,10 +51,32 @@ class ExtenderClient:
         self.preempt_verb = config.get("preemptVerb") or ""
         self.bind_verb = config.get("bindVerb") or ""
         self.ignorable = bool(config.get("ignorable", False))
+        # name set only: ignoredByScheduler (excluding the resource from
+        # node fit math) is not modeled
+        self.managed_resources = {
+            r["name"] for r in (config.get("managedResources") or [])
+            if r.get("name")
+        }
 
     @property
     def host(self) -> str:
         return urlparse(self.url_prefix).netloc or self.url_prefix
+
+    def is_interested(self, pod: dict) -> bool:
+        """Upstream HTTPExtender.IsInterested: an extender with
+        managedResources only sees pods requesting at least one of them
+        (containers or initContainers); no managedResources = all pods."""
+        if not self.managed_resources:
+            return True
+        spec = pod.get("spec") or {}
+        for field in ("containers", "initContainers"):
+            for c in spec.get(field) or []:
+                resources = c.get("resources") or {}
+                for section in ("requests", "limits"):
+                    for name in (resources.get(section) or {}):
+                        if name in self.managed_resources:
+                            return True
+        return False
 
     def _send(self, verb: str, args: dict) -> dict:
         url = f"{self.url_prefix}/{verb}"

@@ -121,6 +121,41 @@ def test_engine_phased_path_with_extender(fake_extender):
     assert "node-00000" not in fs
 
 
+def test_filter_response_error_field_fails_unless_ignorable():
+    """An ExtenderFilterResult carrying Error is a failed call even over
+    HTTP 200 (upstream HTTPExtender.Filter): unignorable -> the pod's
+    cycle aborts; ignorable -> the extender is skipped."""
+    import numpy as np
+
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+
+    class FakeExt:
+        filter_verb = "filter"
+        weight = 1
+
+        def __init__(self, ignorable):
+            self.ignorable = ignorable
+
+        def is_interested(self, pod):
+            return True
+
+    class FakeSvc:
+        def __init__(self, ignorable):
+            self.extenders = [FakeExt(ignorable)]
+
+        def handle(self, verb, idx, args):
+            return {"NodeNames": None, "Error": "extender exploded"}
+
+    for ignorable, want_abort in ((False, True), (True, False)):
+        eng = SchedulerEngine(ObjectStore())
+        eng.extender_service = FakeSvc(ignorable)
+        feasible = np.array([True, True])
+        aborted = eng._webhook_filter({}, ["n0", "n1"], {"n0": 0, "n1": 1},
+                                      feasible)
+        assert aborted is want_abort, f"ignorable={ignorable}"
+        assert feasible.all()  # an errored extender never narrows nodes
+
+
 def test_prioritize_scores_scaled_weight_times_ten():
     """reference extender.go:145: Score x weight x (MaxNodeScore /
     MaxExtenderPriority) — an extender priority of 1 at weight 1 adds 10
@@ -132,6 +167,9 @@ def test_prioritize_scores_scaled_weight_times_ten():
     class FakeExt:
         prioritize_verb = "prioritize"
         weight = 1
+
+        def is_interested(self, pod):
+            return True
 
     class FakeSvc:
         extenders = [FakeExt()]
@@ -147,6 +185,29 @@ def test_prioritize_scores_scaled_weight_times_ten():
     eng._webhook_prioritize({}, names, {"n0": 0, "n1": 1},
                             np.array([True, True]), total)
     assert total.tolist() == [10, 9]              # x10 rescale flips the winner
+
+
+def test_managed_resources_interest_gate():
+    """Upstream HTTPExtender.IsInterested: an extender declaring
+    managedResources is only called for pods requesting one of them
+    (containers or initContainers, requests or limits)."""
+    from kube_scheduler_simulator_tpu.scheduler.extender import ExtenderClient
+
+    ext = ExtenderClient({"urlPrefix": "http://x", "filterVerb": "filter",
+                          "managedResources": [{"name": "example.com/gpu"}]})
+    plain = {"spec": {"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "1"}}}]}}
+    gpu = {"spec": {"containers": [
+        {"name": "c", "resources": {"limits": {"example.com/gpu": "2"}}}]}}
+    init_gpu = {"spec": {"containers": [{"name": "c"}],
+                         "initContainers": [{"name": "i", "resources": {
+                             "requests": {"example.com/gpu": "1"}}}]}}
+    assert not ext.is_interested(plain)
+    assert ext.is_interested(gpu)
+    assert ext.is_interested(init_gpu)
+    # no managedResources -> interested in every pod
+    ext_all = ExtenderClient({"urlPrefix": "http://x", "filterVerb": "filter"})
+    assert ext_all.is_interested(plain)
 
 
 def _capacity_node(name):
