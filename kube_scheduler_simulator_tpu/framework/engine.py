@@ -819,9 +819,14 @@ class SchedulerEngine:
             # FailedNodes reasons travel in the recorded
             # extender-filter-result annotation (handle() stored the
             # whole response).
-            kept = result.get("NodeNames") or result.get("nodeNames")
+            # canonical extender/v1 JSON tags are all-lowercase
+            # ("nodenames"/"nodes"); Go-struct casing accepted for
+            # hand-rolled extenders
+            from ..scheduler.extender import pick_field
+
+            kept = pick_field(result, "nodenames", "NodeNames", "nodeNames")
             if kept is None:
-                nodes_obj = result.get("Nodes") or result.get("nodes")
+                nodes_obj = pick_field(result, "nodes", "Nodes")
                 if nodes_obj is not None:
                     kept = [
                         ((item.get("metadata") or {}).get("name", ""))
@@ -1086,7 +1091,7 @@ class SchedulerEngine:
                             "PodName": name, "PodNamespace": ns,
                             "PodUID": meta.get("uid", ""), "Node": bound_node,
                         })
-                        if (result or {}).get("Error"):
+                        if (result or {}).get("Error") or (result or {}).get("error"):
                             bind_ok = False
                     except Exception:
                         bind_ok = False

@@ -76,7 +76,10 @@ def _priority(pod: dict) -> int:
 
 
 def _creation(pod: dict) -> str:
-    return (pod.get("metadata") or {}).get("creationTimestamp") or ""
+    """Victim age for the tie-break ladder: upstream GetPodStartTime uses
+    status.startTime when the kubelet set one, else creationTimestamp."""
+    start = (pod.get("status") or {}).get("startTime")
+    return start or (pod.get("metadata") or {}).get("creationTimestamp") or ""
 
 
 def _pod_key(pod: dict) -> str:
@@ -310,13 +313,9 @@ class Preemptor:
                 if ext.ignorable:
                     continue
                 return []  # non-ignorable extender error aborts preemption
-            def _field(obj, *keys):
-                # key-presence lookup: an explicit {} answer ("no candidate
-                # may be preempted") must not read as "no opinion"
-                for k in keys:
-                    if k in obj:
-                        return obj[k]
-                return None
+            # key-presence lookup: an explicit {} answer ("no candidate
+            # may be preempted") must not read as "no opinion"
+            from ..scheduler.extender import pick_field as _field
 
             ret = _field(result, "NodeNameToVictims", "nodeNameToVictims")
             if ret is None:

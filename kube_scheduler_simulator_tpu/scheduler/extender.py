@@ -33,6 +33,155 @@ from ..store import annotations as ann
 DEFAULT_TIMEOUT_SECONDS = 5  # reference: extender.go:22-24
 
 
+# ---------------------------------------------------------------- wire form
+#
+# The reference stores extender results as Go structs and re-marshals them
+# for the annotation, so the recorded JSON always carries the canonical
+# k8s.io/kube-scheduler/extender/v1 tags in struct DECLARATION order, with
+# omitempty semantics, and drops fields the struct doesn't declare.
+# Canonicalizing at record time reproduces those bytes regardless of how
+# the user's extender cased its response.  Field specs: (canonical tag,
+# accepted aliases, omitempty).
+
+# omitempty values: "ptr" fields (pointer-typed in Go) drop only nil —
+# a non-nil empty slice like nodenames [] IS emitted; plain slices/maps/
+# strings/ints drop their Go zero value.
+_FILTER_RESULT_FIELDS = [
+    ("nodes", ("nodes", "Nodes"), "ptr"),
+    ("nodenames", ("nodenames", "NodeNames", "nodeNames"), "ptr"),
+    ("failedNodes", ("failedNodes", "FailedNodes"), True),
+    ("failedAndUnresolvable",
+     ("failedAndUnresolvable", "FailedAndUnresolvableNodes",
+      "failedAndUnresolvableNodes"), True),
+    ("error", ("error", "Error"), True),
+]
+_HOST_PRIORITY_FIELDS = [  # HostPriority has NO omitempty
+    ("host", ("host", "Host"), False, ""),
+    ("score", ("score", "Score"), False, 0),
+]
+_META_POD_FIELDS = [("uid", ("uid", "UID"), True)]
+_META_VICTIMS_FIELDS = [
+    ("pods", ("pods", "Pods"), True),
+    ("numPDBViolations", ("numPDBViolations", "NumPDBViolations"), True),
+]
+_BINDING_RESULT_FIELDS = [("error", ("error", "Error"), True)]
+
+
+def pick_field(obj: dict, *aliases) -> object:
+    """First PRESENT key among casing aliases (an explicit empty value
+    must not read as 'absent'). Shared by the canonicalizer, the engine's
+    webhook paths, and preemption's extender call."""
+    for a in aliases:
+        if a in obj:
+            return obj[a]
+    return None
+
+
+def _pick(obj: dict, aliases) -> object:
+    return pick_field(obj, *aliases)
+
+
+def _canon_struct(obj, fields, nested=()) -> dict:
+    """Rebuild a struct-shaped dict in declaration order with canonical
+    tags; omitempty fields drop None/""/empty containers/0 (Go zero
+    values).  `nested` maps a tag to a canonicalizer for its value."""
+    if not isinstance(obj, dict):
+        return {}
+    out = {}
+    nested = dict(nested)
+    for spec in fields:
+        tag, aliases, omitempty = spec[0], spec[1], spec[2]
+        v = _pick(obj, aliases)
+        if tag in nested and v is not None:
+            v = nested[tag](v)
+        if omitempty == "ptr":
+            if v is None:
+                continue  # nil pointer; non-nil empty values ARE emitted
+        elif omitempty:
+            if v is None or v == "" or v == [] or v == {} or v == 0:
+                continue
+        elif v is None:
+            v = spec[3]  # Go zero value for a missing non-omitempty field
+        out[tag] = v
+    return out
+
+
+def _canon_sorted_map(m, value_fn) -> dict:
+    """Go sorts map keys when marshalling."""
+    if not isinstance(m, dict):
+        return {}
+    return {k: value_fn(m[k]) for k in sorted(m)}
+
+
+def _canon_meta_victims(v) -> dict:
+    return _canon_struct(
+        v, _META_VICTIMS_FIELDS,
+        nested={"pods": lambda pods: [
+            _canon_struct(p, _META_POD_FIELDS) for p in (pods or [])]})
+
+
+def canonicalize_result(verb: str, result):
+    """Extender response -> the exact object the reference would have
+    stored (typed struct round-trip)."""
+    if verb == "filter":
+        return _canon_struct(
+            result, _FILTER_RESULT_FIELDS,
+            nested={
+                "failedNodes": lambda m: _canon_sorted_map(m, lambda v: v),
+                "failedAndUnresolvable":
+                    lambda m: _canon_sorted_map(m, lambda v: v),
+            })
+    if verb == "prioritize":
+        if not isinstance(result, list):
+            return []
+        return [_canon_struct(e, _HOST_PRIORITY_FIELDS) for e in result]
+    if verb == "preempt":
+        canon = _canon_struct(
+            result,
+            [("nodeNameToMetaVictims",
+              ("nodeNameToMetaVictims", "NodeNameToMetaVictims"), True)],
+            nested={"nodeNameToMetaVictims":
+                    lambda m: _canon_sorted_map(m, _canon_meta_victims)})
+        if not canon and isinstance(result, dict):
+            # lenient NodeNameToVictims answers (full pod objects) are
+            # honored for narrowing, so the record must show them too —
+            # converted to the canonical meta form (uids), as the
+            # reference's typed round-trip would have required
+            victims = pick_field(result, "nodeNameToVictims",
+                                 "NodeNameToVictims")
+            if isinstance(victims, dict):
+                meta = {}
+                for node in sorted(victims):
+                    v = victims[node] or {}
+                    pods = pick_field(v, "pods", "Pods") or []
+                    mv = {"pods": [
+                        {"uid": ((p.get("metadata") or {}).get("uid")
+                                 or (p.get("metadata") or {}).get("name", ""))}
+                        for p in pods]}
+                    if not mv["pods"]:
+                        del mv["pods"]
+                    npdb = pick_field(v, "numPDBViolations", "NumPDBViolations")
+                    if npdb:
+                        mv["numPDBViolations"] = npdb
+                    meta[node] = mv
+                if meta:
+                    canon = {"nodeNameToMetaVictims": meta}
+        return canon
+    if verb == "bind":
+        return _canon_struct(result, _BINDING_RESULT_FIELDS)
+    return result
+
+
+def marshal_wire(hostmap: dict) -> str:
+    """map[extenderHost]result -> Go-marshal-identical JSON: hosts (map
+    keys) sorted, struct fields kept in canonical declaration order, Go
+    HTML escaping."""
+    ordered = {h: hostmap[h] for h in sorted(hostmap)}
+    s = json.dumps(ordered, sort_keys=False, separators=(",", ":"),
+                   ensure_ascii=False)
+    return s.replace("<", "\\u003c").replace(">", "\\u003e").replace("&", "\\u0026")
+
+
 class ExtenderClient:
     """HTTP client for one configured extender."""
 
@@ -118,7 +267,7 @@ class ExtenderResultStore:
         meta = pod.get("metadata") or {}
         with self._mu:
             e = self._entry(meta.get("namespace") or "default", meta.get("name", ""))
-            e[verb][host] = result
+            e[verb][host] = canonicalize_result(verb, result)
 
     def add_filter_result(self, args, result, host):
         self._add("filter", args, result, host)
@@ -134,7 +283,7 @@ class ExtenderResultStore:
         ns = args.get("PodNamespace") or args.get("podNamespace") or "default"
         name = args.get("PodName") or args.get("podName") or ""
         with self._mu:
-            self._entry(ns, name)["bind"][host] = result
+            self._entry(ns, name)["bind"][host] = canonicalize_result("bind", result)
 
     def get_stored_result(self, pod: dict) -> dict[str, str] | None:
         meta = pod.get("metadata") or {}
@@ -144,10 +293,10 @@ class ExtenderResultStore:
             if e is None:
                 return None
             return {
-                ann.EXTENDER_FILTER_RESULT: ann.marshal(e["filter"]),
-                ann.EXTENDER_PRIORITIZE_RESULT: ann.marshal(e["prioritize"]),
-                ann.EXTENDER_PREEMPT_RESULT: ann.marshal(e["preempt"]),
-                ann.EXTENDER_BIND_RESULT: ann.marshal(e["bind"]),
+                ann.EXTENDER_FILTER_RESULT: marshal_wire(e["filter"]),
+                ann.EXTENDER_PRIORITIZE_RESULT: marshal_wire(e["prioritize"]),
+                ann.EXTENDER_PREEMPT_RESULT: marshal_wire(e["preempt"]),
+                ann.EXTENDER_BIND_RESULT: marshal_wire(e["bind"]),
             }
 
     def delete_data(self, pod: dict) -> None:

@@ -44,12 +44,14 @@ class FakeExtender(BaseHTTPRequestHandler):
             resp = {}
         elif self.path.endswith("/preempt"):
             # keep only the lexicographically LAST candidate node; answer
-            # in the canonical k8s extender/v1 wire casing (lowercase json
-            # tags: nodeNameToVictims / pods), like a Go extender would
+            # with the canonical ExtenderPreemptionResult contract:
+            # nodeNameToMetaVictims carrying MetaPod uids
             victims = body.get("NodeNameToVictims") or {}
             keep = max(victims) if victims else None
-            resp = {"nodeNameToVictims": {
-                keep: {"pods": victims[keep].get("Pods") or []}} if keep else {}}
+            resp = {"nodeNameToMetaVictims": {
+                keep: {"pods": [
+                    {"uid": (v.get("metadata") or {}).get("uid", "")}
+                    for v in victims[keep].get("Pods") or []]}} if keep else {}}
         else:
             resp = {}
         data = json.dumps(resp).encode()
@@ -90,7 +92,7 @@ def test_extender_proxy_records(fake_extender):
     stored = svc.result_store.get_stored_result(pod)
     blob = json.loads(stored[ann.EXTENDER_FILTER_RESULT])
     host = list(blob)[0]
-    assert blob[host]["FailedNodes"]["node-00000"] == "vetoed by extender"
+    assert blob[host]["failedNodes"]["node-00000"] == "vetoed by extender"
 
 
 def test_engine_phased_path_with_extender(fake_extender):
@@ -250,8 +252,9 @@ def test_extender_preempt_round_trip(fake_extender):
     annos = urgent["metadata"]["annotations"]
     preempt_blob = json.loads(annos[ann.EXTENDER_PREEMPT_RESULT])
     host = list(preempt_blob)[0]
-    # the recorded result is the extender's verbatim response
-    assert preempt_blob[host]["nodeNameToVictims"].keys() == {"node-b"}
+    # the recorded result is the canonical wire form of the response
+    assert preempt_blob[host]["nodeNameToMetaVictims"].keys() == {"node-b"}
+    assert preempt_blob[host]["nodeNameToMetaVictims"]["node-b"]["pods"][0]["uid"]
     # the nomination cycle's postfilter-result lives in the first
     # result-history entry (the retry cycle overwrote the live keys)
     history = json.loads(annos[ann.RESULT_HISTORY])

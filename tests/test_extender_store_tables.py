@@ -20,10 +20,17 @@ def args_for(ns="default", name="pod1"):
     return {"Pod": {"metadata": {"namespace": ns, "name": name}}}
 
 
+# inputs in Go-struct casing (as a hand-rolled extender might answer);
+# the store canonicalizes to the extender/v1 JSON-tag wire form exactly as
+# the reference's struct round-trip does
 FILTER_RES = {"Nodes": None, "NodeNames": ["node1"], "FailedNodes": {}, "Error": ""}
+FILTER_WIRE = {"nodenames": ["node1"]}
 PRIO_RES = [{"Host": "node1", "Score": 1}]
+PRIO_WIRE = [{"host": "node1", "score": 1}]
 PREEMPT_RES = {"NodeNameToMetaVictims": {"node1": {"Pods": []}}}
+PREEMPT_WIRE = {"nodeNameToMetaVictims": {"node1": {}}}
 BIND_RES = {"Error": ""}
+BIND_WIRE = {}
 
 
 class TestGetStoredResult:
@@ -41,9 +48,9 @@ class TestGetStoredResult:
             ann.EXTENDER_PREEMPT_RESULT, ann.EXTENDER_BIND_RESULT,
         }
         assert json.loads(got[ann.EXTENDER_FILTER_RESULT]) == {
-            "extenderserver": FILTER_RES}
+            "extenderserver": FILTER_WIRE}
         assert json.loads(got[ann.EXTENDER_PRIORITIZE_RESULT]) == {
-            "extenderserver": PRIO_RES}
+            "extenderserver": PRIO_WIRE}
 
     # resultstore_test.go:112 "do nothing if store doesn't have data"
     def test_absent_pod_returns_none(self):
@@ -60,7 +67,7 @@ class TestGetStoredResult:
         s.add_filter_result(args_for(), FILTER_RES, "extenderserver")
         got = s.get_stored_result(pod())
         assert json.loads(got[ann.EXTENDER_FILTER_RESULT]) == {
-            "extenderserver": FILTER_RES}
+            "extenderserver": FILTER_WIRE}
         for key in (ann.EXTENDER_PRIORITIZE_RESULT, ann.EXTENDER_PREEMPT_RESULT,
                     ann.EXTENDER_BIND_RESULT):
             assert got[key] == "{}"
@@ -68,44 +75,54 @@ class TestGetStoredResult:
 
 ADD_CASES = [
     ("filter", lambda s, a, r, h: s.add_filter_result(a, r, h),
-     FILTER_RES, {"Nodes": None, "NodeNames": ["node2"], "FailedNodes": {}, "Error": ""},
+     FILTER_RES, FILTER_WIRE,
+     {"Nodes": None, "NodeNames": ["node2"], "FailedNodes": {}, "Error": ""},
+     {"nodenames": ["node2"]},
      ann.EXTENDER_FILTER_RESULT),
     ("prioritize", lambda s, a, r, h: s.add_prioritize_result(a, r, h),
-     PRIO_RES, [{"Host": "node2", "Score": 7}], ann.EXTENDER_PRIORITIZE_RESULT),
+     PRIO_RES, PRIO_WIRE,
+     [{"Host": "node2", "Score": 7}], [{"host": "node2", "score": 7}],
+     ann.EXTENDER_PRIORITIZE_RESULT),
     ("preempt", lambda s, a, r, h: s.add_preempt_result(a, r, h),
-     PREEMPT_RES, {"NodeNameToMetaVictims": {}}, ann.EXTENDER_PREEMPT_RESULT),
+     PREEMPT_RES, PREEMPT_WIRE,
+     {"NodeNameToMetaVictims": {"n2": {"NumPDBViolations": 2}}},
+     {"nodeNameToMetaVictims": {"n2": {"numPDBViolations": 2}}},
+     ann.EXTENDER_PREEMPT_RESULT),
 ]
 
 
-@pytest.mark.parametrize("verb,add,res1,res2,anno_key",
+@pytest.mark.parametrize("verb,add,res1,wire1,res2,wire2,anno_key",
                          ADD_CASES, ids=[c[0] for c in ADD_CASES])
 class TestAddResultTables:
     # "overwrite to the already stored data which has the same key and hostname"
-    def test_same_key_same_host_overwrites(self, verb, add, res1, res2, anno_key):
+    def test_same_key_same_host_overwrites(self, verb, add, res1, wire1, res2,
+                                           wire2, anno_key):
         s = ExtenderResultStore()
         add(s, args_for(), res1, "extenderserver")
         add(s, args_for(), res2, "extenderserver")
         got = json.loads(s.get_stored_result(pod())[anno_key])
-        assert got == {"extenderserver": res2}
+        assert got == {"extenderserver": wire2}
 
     # "shouldn't overwrite ... same key and different hostname"
-    def test_same_key_different_host_keeps_both(self, verb, add, res1, res2, anno_key):
+    def test_same_key_different_host_keeps_both(self, verb, add, res1, wire1,
+                                                res2, wire2, anno_key):
         s = ExtenderResultStore()
         add(s, args_for(), res1, "extender-a")
         add(s, args_for(), res2, "extender-b")
         got = json.loads(s.get_stored_result(pod())[anno_key])
-        assert got == {"extender-a": res1, "extender-b": res2}
+        assert got == {"extender-a": wire1, "extender-b": wire2}
 
     # "overwrite to the already stored data which has the different key and
     # same hostname" — results are per-pod; another pod's entry is untouched
-    def test_different_key_same_host_independent(self, verb, add, res1, res2, anno_key):
+    def test_different_key_same_host_independent(self, verb, add, res1, wire1,
+                                                 res2, wire2, anno_key):
         s = ExtenderResultStore()
         add(s, args_for(name="pod1"), res1, "extenderserver")
         add(s, args_for(name="pod2"), res2, "extenderserver")
         assert json.loads(s.get_stored_result(pod(name="pod1"))[anno_key]) == {
-            "extenderserver": res1}
+            "extenderserver": wire1}
         assert json.loads(s.get_stored_result(pod(name="pod2"))[anno_key]) == {
-            "extenderserver": res2}
+            "extenderserver": wire2}
 
 
 class TestAddBindResult:
@@ -116,7 +133,7 @@ class TestAddBindResult:
             {"PodNamespace": "ns1", "PodName": "p"}, BIND_RES, "extenderserver")
         got = s.get_stored_result(pod(ns="ns1", name="p"))
         assert json.loads(got[ann.EXTENDER_BIND_RESULT]) == {
-            "extenderserver": BIND_RES}
+            "extenderserver": BIND_WIRE}
 
     def test_bind_overwrite_same_host(self):
         s = ExtenderResultStore()
@@ -126,7 +143,7 @@ class TestAddBindResult:
                           {"Error": "second"}, "e")
         got = json.loads(s.get_stored_result(pod(ns="ns1", name="p"))[
             ann.EXTENDER_BIND_RESULT])
-        assert got == {"e": {"Error": "second"}}
+        assert got == {"e": {"error": "second"}}
 
     def test_bind_two_hosts(self):
         s = ExtenderResultStore()
@@ -134,7 +151,7 @@ class TestAddBindResult:
         s.add_bind_result({"PodNamespace": "ns1", "PodName": "p"}, {"Error": "x"}, "e2")
         got = json.loads(s.get_stored_result(pod(ns="ns1", name="p"))[
             ann.EXTENDER_BIND_RESULT])
-        assert got == {"e1": {"Error": ""}, "e2": {"Error": "x"}}
+        assert got == {"e1": {}, "e2": {"error": "x"}}
 
 
 class TestDeleteData:
@@ -163,7 +180,94 @@ class TestDeleteData:
         got = s.get_stored_result(pod())
         # filter blob is empty again: delete dropped the whole entry
         assert got[ann.EXTENDER_FILTER_RESULT] == "{}"
-        assert json.loads(got[ann.EXTENDER_PRIORITIZE_RESULT]) == {"e": PRIO_RES}
+        assert json.loads(got[ann.EXTENDER_PRIORITIZE_RESULT]) == {"e": PRIO_WIRE}
+
+
+class TestCanonicalization:
+    """The wire bytes a Go struct round-trip would produce: declaration
+    order (NOT alphabetical), omitempty, unknown fields dropped, map keys
+    sorted (hand-derived from k8s.io/kube-scheduler/extender/v1 types)."""
+
+    def test_filter_declaration_order_beats_alphabetical(self):
+        from kube_scheduler_simulator_tpu.scheduler.extender import (
+            canonicalize_result, marshal_wire)
+
+        res = {"NodeNames": ["n1"], "Nodes": None,
+               "Error": "boom", "FailedNodes": {"zz": "no", "aa": "no"}}
+        wire = marshal_wire({"h": canonicalize_result("filter", res)})
+        # struct order: nodes, nodenames, failedNodes, ..., error —
+        # "nodenames" would sort BEFORE "nodes" alphabetically; failedNodes
+        # map keys sorted; nil *NodeList dropped by omitempty
+        assert wire == ('{"h":{"nodenames":["n1"],'
+                        '"failedNodes":{"aa":"no","zz":"no"},'
+                        '"error":"boom"}}')
+
+    def test_non_nil_nodes_object_passes_through(self):
+        from kube_scheduler_simulator_tpu.scheduler.extender import (
+            canonicalize_result)
+
+        # a non-nil *NodeList is emitted (omitempty only skips nil
+        # pointers); its inner v1.Node objects travel verbatim
+        got = canonicalize_result("filter", {"Nodes": {"items": []}})
+        assert got == {"nodes": {"items": []}}
+
+    def test_meta_victims_declaration_order(self):
+        from kube_scheduler_simulator_tpu.scheduler.extender import (
+            canonicalize_result, marshal_wire)
+
+        res = {"NodeNameToMetaVictims": {
+            "n1": {"NumPDBViolations": 1, "Pods": [{"UID": "u1"}]}}}
+        wire = marshal_wire({"h": canonicalize_result("preempt", res)})
+        # MetaVictims declares pods BEFORE numPDBViolations
+        assert wire == ('{"h":{"nodeNameToMetaVictims":'
+                        '{"n1":{"pods":[{"uid":"u1"}],"numPDBViolations":1}}}}')
+
+    def test_host_priority_no_omitempty(self):
+        from kube_scheduler_simulator_tpu.scheduler.extender import (
+            canonicalize_result, marshal_wire)
+
+        # zero score and empty host are still emitted (no omitempty tags)
+        wire = marshal_wire({"h": canonicalize_result("prioritize",
+                                                      [{"Score": 0}])})
+        assert wire == '{"h":[{"host":"","score":0}]}'
+
+    def test_unknown_fields_dropped(self):
+        from kube_scheduler_simulator_tpu.scheduler.extender import (
+            canonicalize_result)
+
+        got = canonicalize_result("filter", {"nodenames": ["n1"],
+                                             "x-debug": "internal"})
+        assert got == {"nodenames": ["n1"]}
+
+    def test_empty_nodenames_slice_is_emitted(self):
+        """*[]string omitempty drops only nil: {\"nodenames\": []} is a
+        nodeCacheCapable 'reject every node' and must survive into the
+        record, distinct from 'no restriction'."""
+        from kube_scheduler_simulator_tpu.scheduler.extender import (
+            canonicalize_result, marshal_wire)
+
+        wire = marshal_wire({"h": canonicalize_result(
+            "filter", {"nodenames": [], "Error": ""})})
+        assert wire == '{"h":{"nodenames":[]}}'
+
+    def test_lenient_preempt_victims_recorded_as_meta(self):
+        """A NodeNameToVictims answer (full pod objects) narrows
+        preemption, so the record must show it — converted to the
+        canonical nodeNameToMetaVictims (uids) form."""
+        from kube_scheduler_simulator_tpu.scheduler.extender import (
+            canonicalize_result)
+
+        got = canonicalize_result("preempt", {"nodeNameToVictims": {
+            "n1": {"Pods": [{"metadata": {"name": "v", "uid": "u-1"}}],
+                   "NumPDBViolations": 2}}})
+        assert got == {"nodeNameToMetaVictims": {
+            "n1": {"pods": [{"uid": "u-1"}], "numPDBViolations": 2}}}
+
+    def test_hosts_sorted_in_blob(self):
+        from kube_scheduler_simulator_tpu.scheduler.extender import marshal_wire
+
+        wire = marshal_wire({"zz": {}, "aa": {}})
+        assert wire == '{"aa":{},"zz":{}}'
 
 
 class TestWireFormat:
@@ -178,9 +282,9 @@ class TestWireFormat:
         s = ExtenderResultStore()
         s.add_filter_result(args_for(), FILTER_RES, "e")
         blob = s.get_stored_result(pod())[ann.EXTENDER_FILTER_RESULT]
-        # Go json.Marshal: compact (no spaces), deterministic key order
+        # Go json.Marshal: compact (no spaces), canonical tags, omitempty
         assert ": " not in blob and ", " not in blob
-        assert blob == ann.marshal({"e": FILTER_RES})
+        assert blob == '{"e":{"nodenames":["node1"]}}'
 
     def test_default_namespace_fallback(self):
         s = ExtenderResultStore()
