@@ -87,8 +87,10 @@ def _pod_key(pod: dict) -> str:
     return f"{meta.get('namespace') or 'default'}/{meta.get('name', '')}"
 
 
-def _num_candidates(n_nodes: int) -> int:
-    n = max(n_nodes * MIN_CANDIDATE_NODES_PERCENTAGE // 100, MIN_CANDIDATE_NODES_ABSOLUTE)
+def _num_candidates(n_nodes: int,
+                    pct: int = MIN_CANDIDATE_NODES_PERCENTAGE,
+                    abs_: int = MIN_CANDIDATE_NODES_ABSOLUTE) -> int:
+    n = max(n_nodes * pct // 100, abs_)
     return min(n, n_nodes)
 
 
@@ -155,6 +157,16 @@ class Preemptor:
         # selection (upstream preemption callExtenders; the reference
         # proxies + records the round-trip, extender/service.go:45-85)
         self.extender_service = extender_service
+        # DefaultPreemptionArgs from pluginConfig (upstream defaults
+        # minCandidateNodesPercentage=10, minCandidateNodesAbsolute=100)
+        args = (getattr(plugin_config, "args", None) or {}).get(
+            "DefaultPreemption") or {}
+        self.min_candidate_pct = int(
+            args.get("minCandidateNodesPercentage")
+            or MIN_CANDIDATE_NODES_PERCENTAGE)
+        self.min_candidate_abs = int(
+            args.get("minCandidateNodesAbsolute")
+            or MIN_CANDIDATE_NODES_ABSOLUTE)
         self._fit_cache: dict = {}
         self._nodes: list[dict] | None = None   # store snapshot, per preempt()
         self._pods_all: list[dict] | None = None
@@ -252,7 +264,8 @@ class Preemptor:
             if nn:
                 by_node.setdefault(nn, []).append(p)
 
-        budget = _num_candidates(len(potential))
+        budget = _num_candidates(len(potential), self.min_candidate_pct,
+                                 self.min_candidate_abs)
         candidates: list[tuple[str, list[dict], int]] = []
         for node in potential:
             if len(candidates) >= budget:

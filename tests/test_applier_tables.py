@@ -1,0 +1,138 @@
+"""Resource-applier hook-chain tables, mirroring the reference suite
+(resourceapplier/resourceapplier_test.go, resource.go): user filter/mutate
+chains run in registration order ahead of the mandatory hooks, filters
+short-circuit, immutable metadata is stripped, and the PV claimRef UID is
+re-resolved against the destination's PVC.
+"""
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import NotFound, ObjectStore
+from kube_scheduler_simulator_tpu.services.resourceapplier import (
+    ApplierOptions,
+    ResourceApplier,
+)
+
+
+def pod(name, ns="default", **spec):
+    return {"metadata": {"name": name, "namespace": ns}, "spec": dict(spec)}
+
+
+class TestHookChains:
+    def test_user_filter_rejects_create(self):
+        s = ObjectStore()
+        a = ResourceApplier(s, ApplierOptions(filter_before_creating={
+            "pods": [lambda r, o: not o["metadata"]["name"].startswith("deny-")]}))
+        assert a.create("pods", pod("deny-me")) is None
+        with pytest.raises(NotFound):
+            s.get("pods", "deny-me")
+        assert a.create("pods", pod("ok")) is not None
+
+    def test_filter_chain_short_circuits(self):
+        calls = []
+
+        def f1(r, o):
+            calls.append("f1")
+            return False
+
+        def f2(r, o):
+            calls.append("f2")
+            return True
+
+        s = ObjectStore()
+        a = ResourceApplier(s, ApplierOptions(
+            filter_before_creating={"pods": [f1, f2]}))
+        assert a.create("pods", pod("x")) is None
+        assert calls == ["f1"]  # later filters never run
+
+    def test_mutate_chain_runs_in_order(self):
+        s = ObjectStore()
+        a = ResourceApplier(s, ApplierOptions(mutate_before_creating={
+            "pods": [
+                lambda r, o: {**o, "metadata": {**o["metadata"],
+                                                "labels": {"step": "one"}}},
+                lambda r, o: {**o, "metadata": {**o["metadata"],
+                                                "labels": {"step": "two"}}},
+            ]}))
+        a.create("pods", pod("p"))
+        assert s.get("pods", "p")["metadata"]["labels"] == {"step": "two"}
+
+    def test_mandatory_pod_mutate_runs_after_user_mutates(self):
+        """User mutates cannot smuggle serviceAccount/ownerReferences past
+        the mandatory hook (registered last, resource.go:65-81)."""
+        s = ObjectStore()
+        a = ResourceApplier(s, ApplierOptions(mutate_before_creating={
+            "pods": [lambda r, o: {**o, "spec": {**o["spec"],
+                                                 "serviceAccountName": "sneak"}}]}))
+        a.create("pods", pod("p"))
+        got = s.get("pods", "p")
+        assert "serviceAccountName" not in got["spec"]
+
+    def test_hooks_are_per_resource(self):
+        s = ObjectStore()
+        a = ResourceApplier(s, ApplierOptions(filter_before_creating={
+            "pods": [lambda r, o: False]}))
+        assert a.create("pods", pod("p")) is None
+        assert a.create("nodes", {"metadata": {"name": "n"}, "spec": {}}) is not None
+
+
+class TestMandatoryHooks:
+    def test_strip_immutable_on_create(self):
+        s = ObjectStore()
+        a = ResourceApplier(s)
+        src = pod("p")
+        src["metadata"].update({"uid": "src-uid", "resourceVersion": "999",
+                                "generation": 7,
+                                "creationTimestamp": "2020-01-01T00:00:00Z"})
+        a.create("pods", src)
+        got = s.get("pods", "p")
+        assert got["metadata"]["uid"] != "src-uid"       # destination-assigned
+        assert got["metadata"].get("generation") is None
+
+    def test_pod_owner_references_dropped(self):
+        s = ObjectStore()
+        a = ResourceApplier(s)
+        src = pod("p")
+        src["metadata"]["ownerReferences"] = [{"kind": "ReplicaSet", "name": "rs"}]
+        a.create("pods", src)
+        assert "ownerReferences" not in s.get("pods", "p")["metadata"]
+
+    def test_pv_claimref_reresolved_against_destination(self):
+        s = ObjectStore()
+        a = ResourceApplier(s)
+        a.create("persistentvolumeclaims",
+                 {"metadata": {"name": "pvc1", "namespace": "default"}, "spec": {}})
+        dst_uid = s.get("persistentvolumeclaims", "pvc1")["metadata"]["uid"]
+        a.create("persistentvolumes", {
+            "metadata": {"name": "pv1"},
+            "spec": {"claimRef": {"name": "pvc1", "namespace": "default",
+                                  "uid": "stale-src-uid"}}})
+        assert s.get("persistentvolumes", "pv1")["spec"]["claimRef"]["uid"] == dst_uid
+
+    def test_pv_claimref_uid_dropped_when_pvc_missing(self):
+        s = ObjectStore()
+        a = ResourceApplier(s)
+        a.create("persistentvolumes", {
+            "metadata": {"name": "pv1"},
+            "spec": {"claimRef": {"name": "ghost", "namespace": "default",
+                                  "uid": "stale"}}})
+        assert "uid" not in s.get("persistentvolumes", "pv1")["spec"]["claimRef"]
+
+    def test_scheduled_pod_update_filtered_unscheduled_passes(self):
+        s = ObjectStore()
+        a = ResourceApplier(s)
+        a.create("pods", pod("p"))
+        scheduled = pod("p", nodeName="n1")
+        assert a.update("pods", scheduled) is None       # filtered
+        relabeled = pod("p")
+        relabeled["metadata"]["labels"] = {"v": "2"}
+        assert a.update("pods", relabeled) is not None   # passes
+        assert s.get("pods", "p")["metadata"]["labels"] == {"v": "2"}
+
+    def test_delete_by_identity(self):
+        s = ObjectStore()
+        a = ResourceApplier(s)
+        a.create("pods", pod("p", ns="ns1"))
+        a.delete("pods", {"metadata": {"name": "p", "namespace": "ns1"}})
+        with pytest.raises(NotFound):
+            s.get("pods", "p", "ns1")

@@ -298,3 +298,22 @@ def test_pdb_filter_split_budget_accounting():
         pods, [_pdb("pdb", {"app": "x"}, 1)])
     assert [p["metadata"]["name"] for p in violating] == ["b"]
     assert [p["metadata"]["name"] for p in ok] == ["a", "c"]
+
+
+def test_default_preemption_args_plumbed_from_plugin_config():
+    """DefaultPreemptionArgs (minCandidateNodesPercentage/Absolute) reach
+    the Preemptor's candidate budget (upstream DefaultPreemptionArgs
+    defaulting: 10% / 100)."""
+    from kube_scheduler_simulator_tpu.framework.preemption import Preemptor
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    cfg = PluginSetConfig(args={"DefaultPreemption": {
+        "minCandidateNodesPercentage": 50, "minCandidateNodesAbsolute": 2}})
+    p = Preemptor(ObjectStore(), cfg)
+    assert (p.min_candidate_pct, p.min_candidate_abs) == (50, 2)
+    # defaults when unconfigured
+    d = Preemptor(ObjectStore(), PluginSetConfig())
+    assert (d.min_candidate_pct, d.min_candidate_abs) == (10, 100)
+    # budget math honors the configured knobs: 10 nodes at 50%/abs2 -> 5
+    from kube_scheduler_simulator_tpu.framework.preemption import _num_candidates
+    assert _num_candidates(10, p.min_candidate_pct, p.min_candidate_abs) == 5
