@@ -161,12 +161,14 @@ class Preemptor:
         # minCandidateNodesPercentage=10, minCandidateNodesAbsolute=100)
         args = (getattr(plugin_config, "args", None) or {}).get(
             "DefaultPreemption") or {}
-        self.min_candidate_pct = int(
-            args.get("minCandidateNodesPercentage")
-            or MIN_CANDIDATE_NODES_PERCENTAGE)
-        self.min_candidate_abs = int(
-            args.get("minCandidateNodesAbsolute")
-            or MIN_CANDIDATE_NODES_ABSOLUTE)
+        pct = args.get("minCandidateNodesPercentage")
+        abs_ = args.get("minCandidateNodesAbsolute")
+        # null -> default (upstream nil-pointer defaulting); an explicit 0
+        # is valid ("use only the other knob") and must survive
+        self.min_candidate_pct = (
+            MIN_CANDIDATE_NODES_PERCENTAGE if pct is None else int(pct))
+        self.min_candidate_abs = (
+            MIN_CANDIDATE_NODES_ABSOLUTE if abs_ is None else int(abs_))
         self._fit_cache: dict = {}
         self._nodes: list[dict] | None = None   # store snapshot, per preempt()
         self._pods_all: list[dict] | None = None

@@ -236,24 +236,38 @@ function renderResults(pod) {
       }
     } catch (e) { /* not a table */ }
   }
-  for (const key of RESULT_KEYS) {
-    const raw = anns[ANN + key];
-    if (!raw || raw === "{}" || raw === "null") continue;
-    let parsed;
-    try { parsed = JSON.parse(raw); } catch (e) { parsed = null; }
-    html += `<h3 class="sect">${esc(key)}</h3>`;
-    if (parsed && typeof parsed === "object" && !Array.isArray(parsed) &&
-        Object.values(parsed).every((v) => v && typeof v === "object" && !Array.isArray(v))) {
-      html += resultTable(parsed, sel);
-    } else {
-      html += `<pre class="kv">${esc(JSON.stringify(parsed === null ? raw : parsed, null, 2))}</pre>`;
-    }
-  }
+  html += renderResultSet(anns, sel, "h3");
   const hist = anns[ANN + "result-history"];
   if (hist) {
     try {
-      html += `<h3 class="sect">result-history</h3><p class="kv">${JSON.parse(hist).length} record(s)</p>`;
+      const records = JSON.parse(hist);
+      html += `<h3 class="sect">result-history</h3><p class="kv">${records.length} record(s)</p>`;
+      records.forEach((rec, i) => {
+        const recSel = rec[ANN + "selected-node"];
+        const body = renderResultSet(rec, recSel, "h4");
+        html += `<details class="hist"><summary>cycle ${i + 1}${recSel ? ` — selected ${esc(recSel)}` : ""}</summary>${body}</details>`;
+      });
     } catch (e) { /* ignore */ }
+  }
+  return html;
+}
+
+function renderResultSet(source, selNode, headingTag) {
+  // one RESULT_KEYS pass shared by the live annotations and each
+  // result-history record
+  let html = "";
+  for (const key of RESULT_KEYS) {
+    const raw = source[ANN + key];
+    if (!raw || raw === "{}" || raw === "null") continue;
+    let parsed;
+    try { parsed = JSON.parse(raw); } catch (e) { parsed = null; }
+    html += `<${headingTag} class="sect">${esc(key)}</${headingTag}>`;
+    if (parsed && typeof parsed === "object" && !Array.isArray(parsed) &&
+        Object.values(parsed).every((v) => v && typeof v === "object" && !Array.isArray(v))) {
+      html += resultTable(parsed, selNode);
+    } else {
+      html += `<pre class="kv">${esc(JSON.stringify(parsed === null ? raw : parsed, null, 2))}</pre>`;
+    }
   }
   return html;
 }
