@@ -182,4 +182,25 @@ def parse_profile(profile: dict | None) -> PluginSetConfig:
         name = (pc.get("name") or "").removesuffix(WRAPPED_SUFFIX)
         if name and pc.get("args"):
             args[name] = pc["args"]
+    _validate_default_preemption_args(args.get("DefaultPreemption") or {})
     return PluginSetConfig(enabled=enabled, weights=weights, args=args)
+
+
+def _validate_default_preemption_args(dp: dict) -> None:
+    """Upstream ValidateDefaultPreemptionArgs: percentage in [0,100],
+    absolute >= 0, and not both zero (a both-zero budget would silently
+    disable preemption)."""
+    pct = dp.get("minCandidateNodesPercentage")
+    abs_ = dp.get("minCandidateNodesAbsolute")
+    if pct is not None and not 0 <= int(pct) <= 100:
+        raise ValueError(
+            f"minCandidateNodesPercentage must be in [0, 100], got {pct}")
+    if abs_ is not None and int(abs_) < 0:
+        raise ValueError(
+            f"minCandidateNodesAbsolute must be >= 0, got {abs_}")
+    eff_pct = 10 if pct is None else int(pct)
+    eff_abs = 100 if abs_ is None else int(abs_)
+    if eff_pct == 0 and eff_abs == 0:
+        raise ValueError(
+            "minCandidateNodesPercentage and minCandidateNodesAbsolute "
+            "may not both be zero")

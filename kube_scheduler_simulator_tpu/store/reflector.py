@@ -49,6 +49,16 @@ def update_result_history(pod: dict, result_set: dict[str, str]) -> None:
     the limit is hit) falls back to parse + drop-oldest."""
     annotations = pod.setdefault("metadata", {}).setdefault("annotations", {})
     raw = annotations.get(ann.RESULT_HISTORY, "[]")
+    # JSON encoding never shrinks a string, so sum(len(k)+len(v))+syntax
+    # is a lower bound on the encoded record: when even that exceeds the
+    # limit (every pod at >=1k-node scale), raise before encoding — the
+    # caller logs and continues exactly as on the trim path's exhaustion,
+    # without building and escaping hundreds of KB per pod first
+    lower_bound = 1 + sum(len(k) + len(v) + 6 for k, v in result_set.items())
+    if lower_bound > RESULT_HISTORY_LIMIT:
+        raise ValueError(
+            "result record alone exceeds the annotation size limit"
+        )
     rec = _encode_record(result_set)
     if raw.startswith("[") and raw.endswith("]"):
         encoded = ("[" + rec + "]" if raw == "[]"

@@ -279,3 +279,22 @@ def test_parse_profiles_routes_by_scheduler_name():
     assert set(profiles) == {"a", "b"}
     assert profiles["a"].enabled == ["NodeResourcesFit"]
     assert profiles["b"].weight("TaintToleration") == 9
+
+def test_default_preemption_args_validation():
+    """Upstream ValidateDefaultPreemptionArgs: pct in [0,100], abs >= 0,
+    not both (effectively) zero; a rejected config rolls back."""
+    ok = {"pluginConfig": [{"name": "DefaultPreemption",
+                            "args": {"minCandidateNodesPercentage": 0,
+                                     "minCandidateNodesAbsolute": 5}}]}
+    parse_profile(ok)  # zero pct alone is valid ("use only the other knob")
+    import pytest as _pytest
+
+    for bad in (
+        {"minCandidateNodesPercentage": 101},
+        {"minCandidateNodesPercentage": -1},
+        {"minCandidateNodesAbsolute": -5},
+        {"minCandidateNodesPercentage": 0, "minCandidateNodesAbsolute": 0},
+    ):
+        with _pytest.raises(ValueError):
+            parse_profile({"pluginConfig": [
+                {"name": "DefaultPreemption", "args": bad}]})
