@@ -27,6 +27,79 @@ WRAPPED_SUFFIX = "Wrapped"
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
 
+def _default_plugin_config() -> list[dict]:
+    """The defaulted per-plugin args the upstream scheme attaches to every
+    decoded KubeSchedulerConfiguration (visible in the reference's GET
+    /api/v1/schedulerconfiguration and snapshot schedulerConfig)."""
+    api = "kubescheduler.config.k8s.io/v1"
+
+    def cpu_mem():
+        return [{"name": "cpu", "weight": 1}, {"name": "memory", "weight": 1}]
+
+    return [
+        {"name": "DefaultPreemption", "args": {
+            "kind": "DefaultPreemptionArgs", "apiVersion": api,
+            "minCandidateNodesPercentage": 10,
+            "minCandidateNodesAbsolute": 100}},
+        {"name": "InterPodAffinity", "args": {
+            "kind": "InterPodAffinityArgs", "apiVersion": api,
+            "hardPodAffinityWeight": 1}},
+        {"name": "NodeAffinity", "args": {
+            "kind": "NodeAffinityArgs", "apiVersion": api}},
+        {"name": "NodeResourcesBalancedAllocation", "args": {
+            "kind": "NodeResourcesBalancedAllocationArgs", "apiVersion": api,
+            "resources": cpu_mem()}},
+        {"name": "NodeResourcesFit", "args": {
+            "kind": "NodeResourcesFitArgs", "apiVersion": api,
+            "scoringStrategy": {"type": "LeastAllocated",
+                                "resources": cpu_mem()}}},
+        {"name": "PodTopologySpread", "args": {
+            "kind": "PodTopologySpreadArgs", "apiVersion": api,
+            "defaultingType": "System"}},
+        {"name": "VolumeBinding", "args": {
+            "kind": "VolumeBindingArgs", "apiVersion": api,
+            "bindTimeoutSeconds": 600}},
+    ]
+
+
+def default_multipoint_set() -> dict:
+    """The defaulted MultiPoint plugin set (enabled lineup with default
+    weights) — the piece conversion and profile parsing actually read."""
+    return {"enabled": [
+        {"name": n, "weight": PLUGIN_REGISTRY[n].default_weight}
+        if PLUGIN_REGISTRY[n].has_score else {"name": n}
+        for n in DEFAULT_ORDER
+    ]}
+
+
+def apply_scheme_defaults(cfg: dict) -> dict:
+    """Mirror the upstream scheme's config defaulting on a user-supplied
+    config: every profile gains the default per-plugin args it did not
+    set (per-name; a user entry's fields win over the default's at the
+    top level — nested defaulting is the consumers' job, as in the
+    tensor plugin builders)."""
+    cfg = copy.deepcopy(cfg or {})
+    cfg.setdefault("apiVersion", "kubescheduler.config.k8s.io/v1")
+    cfg.setdefault("kind", "KubeSchedulerConfiguration")
+    cfg.setdefault("parallelism", 16)
+    if not cfg.get("profiles"):
+        cfg["profiles"] = [{"schedulerName": DEFAULT_SCHEDULER_NAME}]
+    for profile in cfg["profiles"]:
+        user = {(pc.get("name") or "").removesuffix(WRAPPED_SUFFIX): pc
+                for pc in profile.get("pluginConfig") or []}
+        merged = []
+        for d in _default_plugin_config():
+            u = user.pop(d["name"], None)
+            if u is None:
+                merged.append(d)
+            else:
+                merged.append({"name": u.get("name", d["name"]),
+                               "args": {**d["args"], **(u.get("args") or {})}})
+        merged.extend(user.values())  # non-defaulted plugins verbatim
+        profile["pluginConfig"] = merged
+    return cfg
+
+
 def default_scheduler_config() -> dict:
     return {
         "apiVersion": "kubescheduler.config.k8s.io/v1",
@@ -35,12 +108,8 @@ def default_scheduler_config() -> dict:
         "profiles": [
             {
                 "schedulerName": DEFAULT_SCHEDULER_NAME,
-                "plugins": {"multiPoint": {"enabled": [
-                    {"name": n, "weight": PLUGIN_REGISTRY[n].default_weight}
-                    if PLUGIN_REGISTRY[n].has_score else {"name": n}
-                    for n in DEFAULT_ORDER
-                ]}},
-                "pluginConfig": [],
+                "plugins": {"multiPoint": default_multipoint_set()},
+                "pluginConfig": _default_plugin_config(),
             }
         ],
         "extenders": [],
@@ -91,7 +160,7 @@ def convert_configuration_for_simulator(cfg: dict) -> dict:
     if not cfg.get("profiles"):
         cfg["profiles"] = [{"schedulerName": DEFAULT_SCHEDULER_NAME, "plugins": {}}]
 
-    default_multipoint = default_scheduler_config()["profiles"][0]["plugins"]["multiPoint"]
+    default_multipoint = default_multipoint_set()
 
     for profile in cfg["profiles"]:
         plugins = profile.setdefault("plugins", {}) or {}
@@ -155,7 +224,7 @@ def parse_profile(profile: dict | None) -> PluginSetConfig:
     mp = plugins.get("multiPoint") or {}
     score = plugins.get("score") or {}
 
-    default_multipoint = default_scheduler_config()["profiles"][0]["plugins"]["multiPoint"]
+    default_multipoint = default_multipoint_set()
     merged = _merge_plugin_set(default_multipoint | {"disabled": []}, mp)
 
     enabled, weights = [], {}

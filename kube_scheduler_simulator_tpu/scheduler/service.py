@@ -50,6 +50,13 @@ class SchedulerService:
         scheduler.go:102-108 rollback)."""
         if cfg is None:
             cfg = default_scheduler_config()
+        else:
+            # the upstream scheme defaults every decoded config (per-plugin
+            # default args, apiVersion/kind); GET then shows the defaulted
+            # form, exactly as the reference's handler does
+            from .convert import apply_scheme_defaults
+
+            cfg = apply_scheme_defaults(cfg)
         old = self._current
         old_guests = self._guest_plugins
         try:

@@ -298,3 +298,49 @@ def test_default_preemption_args_validation():
         with _pytest.raises(ValueError):
             parse_profile({"pluginConfig": [
                 {"name": "DefaultPreemption", "args": bad}]})
+
+
+def test_default_config_carries_scheme_defaulted_plugin_args():
+    """The defaulted KubeSchedulerConfiguration exposes per-plugin default
+    args exactly like the reference's GET /api/v1/schedulerconfiguration
+    (DefaultPreemptionArgs 10/100, LeastAllocated cpu/memory, etc.)."""
+    cfg = default_scheduler_config()
+    pcs = {p["name"]: p["args"] for p in cfg["profiles"][0]["pluginConfig"]}
+    assert set(pcs) == {
+        "DefaultPreemption", "InterPodAffinity", "NodeAffinity",
+        "NodeResourcesBalancedAllocation", "NodeResourcesFit",
+        "PodTopologySpread", "VolumeBinding"}
+    assert pcs["DefaultPreemption"]["minCandidateNodesPercentage"] == 10
+    assert pcs["DefaultPreemption"]["minCandidateNodesAbsolute"] == 100
+    assert pcs["NodeResourcesFit"]["scoringStrategy"]["type"] == "LeastAllocated"
+    assert pcs["InterPodAffinity"]["hardPodAffinityWeight"] == 1
+    assert pcs["PodTopologySpread"]["defaultingType"] == "System"
+    assert pcs["VolumeBinding"]["bindTimeoutSeconds"] == 600
+    for args in pcs.values():
+        assert args["apiVersion"] == "kubescheduler.config.k8s.io/v1"
+        assert args["kind"].endswith("Args")
+
+
+def test_apply_scheme_defaults_on_user_config():
+    """A user-applied config gains the scheme defaults the reference's
+    decode would attach: missing plugins get full default args; a user
+    entry keeps its fields and inherits the rest; unknown plugins pass
+    verbatim."""
+    from kube_scheduler_simulator_tpu.scheduler.convert import (
+        apply_scheme_defaults)
+
+    cfg = apply_scheme_defaults({"profiles": [{
+        "schedulerName": "s",
+        "pluginConfig": [
+            {"name": "DefaultPreemption",
+             "args": {"minCandidateNodesAbsolute": 7}},
+            {"name": "MyPlugin", "args": {"x": 1}},
+        ]}]})
+    pcs = {p["name"]: p["args"] for p in cfg["profiles"][0]["pluginConfig"]}
+    # user field kept, sibling default filled in
+    assert pcs["DefaultPreemption"]["minCandidateNodesAbsolute"] == 7
+    assert pcs["DefaultPreemption"]["minCandidateNodesPercentage"] == 10
+    # untouched plugins fully defaulted; unknown plugin untouched
+    assert pcs["NodeResourcesFit"]["scoringStrategy"]["type"] == "LeastAllocated"
+    assert pcs["MyPlugin"] == {"x": 1}
+    assert cfg["parallelism"] == 16

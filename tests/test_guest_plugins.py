@@ -102,4 +102,6 @@ def test_guest_end_to_end_and_rollback(tmp_path):
     with pytest.raises(Exception):
         svc.restart_scheduler(_cfg_with_guest(tmp_path / "missing.py"))
     assert "MyGuest" in engine.plugin_config.enabled
-    assert svc.get_config()["profiles"][0]["pluginConfig"][0]["args"]["guestURL"] == str(guest)
+    pcs = {p["name"]: p["args"]
+           for p in svc.get_config()["profiles"][0]["pluginConfig"]}
+    assert pcs["MyGuest"]["guestURL"] == str(guest)

@@ -55,7 +55,9 @@ def _service_with(cfg, nodes):
 def test_parse_profiles_reads_every_profile():
     profs = parse_profiles(_two_profile_config())
     assert list(profs) == ["default-scheduler", "bin-packing"]
-    assert "NodeResourcesFit" not in profs["default-scheduler"].args
+    # the default profile carries the scheme-defaulted args (LeastAllocated)
+    assert (profs["default-scheduler"].args["NodeResourcesFit"]
+            ["scoringStrategy"]["type"] == "LeastAllocated")
     assert (profs["bin-packing"].args["NodeResourcesFit"]
             ["scoringStrategy"]["type"] == "MostAllocated")
 
