@@ -72,6 +72,29 @@ def default_multipoint_set() -> dict:
     ]}
 
 
+def _default_top_level() -> dict:
+    """Scheme-defaulted top-level KubeSchedulerConfiguration fields.
+    leaderElection/clientConnection/backoff are config-surface parity only
+    (a single-process simulator neither elects leaders nor rate-limits an
+    apiserver client); they round-trip through GET/apply untouched."""
+    return {
+        "parallelism": 16,
+        "leaderElection": {
+            "leaderElect": True, "leaseDuration": "15s",
+            "renewDeadline": "10s", "retryPeriod": "2s",
+            "resourceLock": "leases", "resourceName": "kube-scheduler",
+            "resourceNamespace": "kube-system"},
+        "clientConnection": {
+            "kubeconfig": "", "acceptContentTypes": "",
+            "contentType": "application/vnd.kubernetes.protobuf",
+            "qps": 50, "burst": 100},
+        "enableProfiling": True,
+        "enableContentionProfiling": True,
+        "podInitialBackoffSeconds": 1,
+        "podMaxBackoffSeconds": 10,
+    }
+
+
 def apply_scheme_defaults(cfg: dict) -> dict:
     """Mirror the upstream scheme's config defaulting on a user-supplied
     config: every profile gains the default per-plugin args it did not
@@ -81,21 +104,27 @@ def apply_scheme_defaults(cfg: dict) -> dict:
     cfg = copy.deepcopy(cfg or {})
     cfg.setdefault("apiVersion", "kubescheduler.config.k8s.io/v1")
     cfg.setdefault("kind", "KubeSchedulerConfiguration")
-    cfg.setdefault("parallelism", 16)
+    for k, v in _default_top_level().items():
+        cfg.setdefault(k, v)
     if not cfg.get("profiles"):
         cfg["profiles"] = [{"schedulerName": DEFAULT_SCHEDULER_NAME}]
     for profile in cfg["profiles"]:
-        user = {(pc.get("name") or "").removesuffix(WRAPPED_SUFFIX): pc
-                for pc in profile.get("pluginConfig") or []}
-        merged = []
-        for d in _default_plugin_config():
-            u = user.pop(d["name"], None)
-            if u is None:
-                merged.append(d)
+        defaults = {d["name"]: d["args"] for d in _default_plugin_config()}
+        merged, seen = [], set()
+        # user entries keep their position (and casing); missing defaults
+        # append after, as the upstream scheme's setDefaults does
+        for pc in profile.get("pluginConfig") or []:
+            name = (pc.get("name") or "").removesuffix(WRAPPED_SUFFIX)
+            if name in defaults:
+                seen.add(name)
+                merged.append({"name": pc.get("name"),
+                               "args": {**defaults[name],
+                                        **(pc.get("args") or {})}})
             else:
-                merged.append({"name": u.get("name", d["name"]),
-                               "args": {**d["args"], **(u.get("args") or {})}})
-        merged.extend(user.values())  # non-defaulted plugins verbatim
+                merged.append(pc)
+        merged.extend({"name": d["name"], "args": d["args"]}
+                      for d in _default_plugin_config()
+                      if d["name"] not in seen)
         profile["pluginConfig"] = merged
     return cfg
 
@@ -104,7 +133,7 @@ def default_scheduler_config() -> dict:
     return {
         "apiVersion": "kubescheduler.config.k8s.io/v1",
         "kind": "KubeSchedulerConfiguration",
-        "parallelism": 16,
+        **_default_top_level(),
         "profiles": [
             {
                 "schedulerName": DEFAULT_SCHEDULER_NAME,

@@ -15,13 +15,20 @@ from __future__ import annotations
 
 import copy
 
-from .convert import default_scheduler_config, parse_profiles
+from .convert import (
+    apply_scheme_defaults,
+    default_scheduler_config,
+    parse_profiles,
+)
 
 
 class SchedulerService:
     def __init__(self, engine=None, initial_config: dict | None = None):
         self.engine = engine
-        self._initial = copy.deepcopy(initial_config) if initial_config else default_scheduler_config()
+        # a boot-time config file goes through the same scheme defaulting
+        # as an applied one, so GET always shows the defaulted form
+        self._initial = (apply_scheme_defaults(initial_config)
+                         if initial_config else default_scheduler_config())
         self._current = copy.deepcopy(self._initial)
         # out-of-tree plugins registered via the debuggable-scheduler API;
         # they live in the process (like the reference's compiled-in
@@ -54,8 +61,6 @@ class SchedulerService:
             # the upstream scheme defaults every decoded config (per-plugin
             # default args, apiVersion/kind); GET then shows the defaulted
             # form, exactly as the reference's handler does
-            from .convert import apply_scheme_defaults
-
             cfg = apply_scheme_defaults(cfg)
         old = self._current
         old_guests = self._guest_plugins

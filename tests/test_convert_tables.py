@@ -344,3 +344,9 @@ def test_apply_scheme_defaults_on_user_config():
     assert pcs["NodeResourcesFit"]["scoringStrategy"]["type"] == "LeastAllocated"
     assert pcs["MyPlugin"] == {"x": 1}
     assert cfg["parallelism"] == 16
+    # user entries keep their position; missing defaults append after
+    names = [p["name"] for p in cfg["profiles"][0]["pluginConfig"]]
+    assert names[:2] == ["DefaultPreemption", "MyPlugin"]
+    assert set(names[2:]) == {
+        "InterPodAffinity", "NodeAffinity", "NodeResourcesBalancedAllocation",
+        "NodeResourcesFit", "PodTopologySpread", "VolumeBinding"}
