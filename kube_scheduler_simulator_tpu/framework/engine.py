@@ -85,6 +85,8 @@ class SchedulerEngine:
         self.plugin_config = PluginSetConfig(
             enabled=list(cfg.enabled), weights=dict(cfg.weights),
             custom=dict(cfg.custom), args=copy.deepcopy(cfg.args),
+            point_enabled={k: list(v) for k, v in cfg.point_enabled.items()},
+            point_disabled={k: set(v) for k, v in cfg.point_disabled.items()},
         )
         self.profiles = None
 
@@ -97,7 +99,11 @@ class SchedulerEngine:
             self.profiles = {
                 n: PluginSetConfig(
                     enabled=list(c.enabled), weights=dict(c.weights),
-                    custom=dict(c.custom), args=copy.deepcopy(c.args))
+                    custom=dict(c.custom), args=copy.deepcopy(c.args),
+                    point_enabled={k: list(v)
+                                   for k, v in c.point_enabled.items()},
+                    point_disabled={k: set(v)
+                                    for k, v in c.point_disabled.items()})
                 for n, c in profiles.items()
             }
             # keep the legacy single-profile accessor pointing at the first

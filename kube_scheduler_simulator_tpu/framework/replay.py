@@ -294,6 +294,11 @@ def _workload_scan_key(cw: CompiledWorkload, chunk: int, mesh=None):
         tuple((n, id(p)) for n, p in sorted(cfg.custom.items())),
         json.dumps(cfg.args, sort_keys=True, default=str),
         tuple(cw.schema.columns),
+        # per-point overrides change the jitted step's plugin lineup
+        # (filters()/prescorers() are baked into the closure)
+        tuple(sorted((k, tuple(v)) for k, v in cfg.point_enabled.items())),
+        tuple(sorted((k, tuple(sorted(v)))
+                     for k, v in cfg.point_disabled.items())),
     )
     return (_statics_fingerprint(cw), mesh_sig, shapes, cfg_sig, chunk)
 

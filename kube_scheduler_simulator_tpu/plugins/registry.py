@@ -100,6 +100,13 @@ class PluginSetConfig:
     # profiles[].pluginConfig[].args), e.g. NodeResourcesFit
     # scoringStrategy or InterPodAffinity hardPodAffinityWeight
     args: dict[str, dict] = field(default_factory=dict)
+    # per-extension-point overrides (upstream lets a profile disable a
+    # plugin at ONE point while it stays active at the others, or enable
+    # one only there): point name ("filter", "score", "preFilter", ...)
+    # -> names; "*" in a disabled set drops every base plugin at that
+    # point except the point's own enabled entries
+    point_enabled: dict[str, list[str]] = field(default_factory=dict)
+    point_disabled: dict[str, set[str]] = field(default_factory=dict)
 
     def __post_init__(self):
         order = {n: i for i, n in enumerate(DEFAULT_ORDER)}
@@ -121,32 +128,77 @@ class PluginSetConfig:
         w = self.weights.get(name, self._desc(name).default_weight)
         return w if w != 0 else 1
 
+    _POINT_CAPABILITY = {
+        "preEnqueue": "has_preenqueue", "preFilter": "has_prefilter",
+        "filter": "has_filter", "postFilter": "has_postfilter",
+        "preScore": "has_prescore", "score": "has_score",
+    }
+
+    def _point_set(self, point: str, base: list[str]) -> list[str]:
+        """Apply the point's enable/disable overrides to the base (multi-
+        point-derived) plugin list, upstream per-point merge semantics:
+        disables (incl. "*") suppress only the base entries; explicit
+        point enables append after in the user's order (so an
+        enable+disable of the same name keeps the plugin, like
+        mergePluginSet); enables must implement the point."""
+        cap = self._POINT_CAPABILITY[point]
+        extra = [
+            n for n in self.point_enabled.get(point, [])
+            if (n in PLUGIN_REGISTRY or n in self.custom)
+            and getattr(self._desc(n), cap, False)
+        ]
+        dis = self.point_disabled.get(point, ())
+        if "*" in dis:
+            names: list[str] = []
+        else:
+            names = [n for n in base if n not in dis]
+        return names + [n for n in extra if n not in names]
+
+    def active_plugins(self) -> list[str]:
+        """Union of the globally enabled plugins and every point-enabled
+        extra (deduped, registry order) — the set the workload compiler
+        must build tensors for."""
+        out = list(self.enabled)
+        seen = set(out)
+        for point, names in self.point_enabled.items():
+            cap = self._POINT_CAPABILITY[point]
+            for n in names:
+                if n in seen or (n not in PLUGIN_REGISTRY and n not in self.custom):
+                    continue
+                if getattr(self._desc(n), cap, False):
+                    out.append(n)
+                    seen.add(n)
+        order = {n: i for i, n in enumerate(DEFAULT_ORDER)}
+        return sorted(out, key=lambda n: order.get(n, 99))
+
     def filters(self) -> list[str]:
-        return [n for n in self.enabled if self._desc(n).has_filter]
+        return self._point_set(
+            "filter", [n for n in self.enabled if self._desc(n).has_filter])
 
     def preenqueues(self) -> list[str]:
-        return [
+        return self._point_set("preEnqueue", [
             n for n in self.enabled
             if not self.is_custom(n) and PLUGIN_REGISTRY[n].has_preenqueue
-        ]
+        ])
 
     def postfilters(self) -> list[str]:
-        return [
+        return self._point_set("postFilter", [
             n for n in self.enabled
             if not self.is_custom(n) and PLUGIN_REGISTRY[n].has_postfilter
-        ]
+        ])
 
     def scorers(self) -> list[str]:
-        return [n for n in self.enabled if self._desc(n).has_score]
+        return self._point_set(
+            "score", [n for n in self.enabled if self._desc(n).has_score])
 
     def prefilters(self) -> list[str]:
-        return [
+        return self._point_set("preFilter", [
             n for n in self.enabled
             if not self.is_custom(n) and PLUGIN_REGISTRY[n].has_prefilter
-        ]
+        ])
 
     def prescorers(self) -> list[str]:
-        return [
+        return self._point_set("preScore", [
             n for n in self.enabled
             if not self.is_custom(n) and PLUGIN_REGISTRY[n].has_prescore
-        ]
+        ])

@@ -266,14 +266,36 @@ def parse_profile(profile: dict | None) -> PluginSetConfig:
             w = int(p.get("weight") or 0)
             weights[name] = w if w != 0 else 1
     for p in score.get("enabled") or []:
+        # the score-point enable list feeds weights (getScorePluginWeight
+        # unions score.enabled + multiPoint.enabled) and the score point
+        # set below — NOT the global enable, so a plugin enabled only at
+        # score does not also filter (upstream per-point semantics)
         name = (p.get("name") or "").removesuffix(WRAPPED_SUFFIX)
         if name in PLUGIN_REGISTRY:
-            if name not in enabled:
-                enabled.append(name)
             w = int(p.get("weight") or 0)
             weights[name] = w if w != 0 else 1
     for d in score.get("disabled") or []:
         weights.pop((d.get("name") or "").removesuffix(WRAPPED_SUFFIX), None)
+
+    # per-extension-point overrides: a plugin disabled at ONE point stays
+    # active at the others (upstream per-point plugin sets); enables add
+    # the plugin at that point only.  Score enables are folded into the
+    # weight/enabled handling above; its disables also land here so
+    # scorers() actually drops the plugin.
+    point_enabled: dict[str, list[str]] = {}
+    point_disabled: dict[str, set[str]] = {}
+    for point in ("preEnqueue", "preFilter", "filter", "postFilter",
+                  "preScore", "score"):
+        ps = plugins.get(point) or {}
+        en = [(p.get("name") or "").removesuffix(WRAPPED_SUFFIX)
+              for p in ps.get("enabled") or []]
+        dis = {(d.get("name") or "").removesuffix(WRAPPED_SUFFIX)
+               if (d.get("name") or "") != "*" else "*"
+               for d in ps.get("disabled") or []}
+        if en:
+            point_enabled[point] = [n for n in en if n]
+        if dis:
+            point_disabled[point] = dis
 
     args: dict[str, dict] = {}
     for pc in profile.get("pluginConfig") or []:
@@ -281,7 +303,9 @@ def parse_profile(profile: dict | None) -> PluginSetConfig:
         if name and pc.get("args"):
             args[name] = pc["args"]
     _validate_default_preemption_args(args.get("DefaultPreemption") or {})
-    return PluginSetConfig(enabled=enabled, weights=weights, args=args)
+    return PluginSetConfig(enabled=enabled, weights=weights, args=args,
+                           point_enabled=point_enabled,
+                           point_disabled=point_disabled)
 
 
 def _validate_default_preemption_args(dp: dict) -> None:

@@ -141,7 +141,7 @@ def compile_workload(
         nz0[j] += nz
         np0[j] += 1
 
-    enabled = set(config.enabled)
+    enabled = set(config.active_plugins())
     # Fit static/xs double as the core resource tensors even when the Fit
     # plugin itself is disabled (bind updates always need pod requests).
     fit_static, fit_xs = noderesources.build_fit(table, schema, requests, nonzero)
@@ -331,7 +331,7 @@ def _collect_host_flags(cw: CompiledWorkload):
     skips_filter: dict[str, np.ndarray] = {}
     skips_score: dict[str, np.ndarray] = {}
     p = cw.n_pods
-    for name in cw.config.enabled:
+    for name in cw.config.active_plugins():
         x = cw.xs.get(name)
         skips_filter[name] = (
             np.asarray(x.filter_skip) if x is not None and hasattr(x, "filter_skip") else np.zeros(p, bool)

@@ -1,0 +1,186 @@
+"""Per-extension-point enable/disable semantics: upstream profiles can
+disable a plugin at ONE point while it stays active at the others
+(scheduler_test.go:401 'disable a specific default multipoint plugin on a
+extension point'), or enable a plugin only at one point.
+"""
+
+import json
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.scheduler.convert import parse_profile
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+
+def test_score_point_disable_keeps_filtering():
+    ps = parse_profile({"plugins": {
+        "score": {"disabled": [{"name": "TaintToleration"}]}}})
+    assert "TaintToleration" in ps.filters()
+    assert "TaintToleration" not in ps.scorers()
+    # untouched points keep the full lineup
+    assert "TaintToleration" in ps.prescorers()
+
+
+def test_filter_point_disable_keeps_scoring():
+    ps = parse_profile({"plugins": {
+        "filter": {"disabled": [{"name": "TaintToleration"}]}}})
+    assert "TaintToleration" not in ps.filters()
+    assert "TaintToleration" in ps.scorers()
+
+
+def test_star_disable_with_point_enable():
+    ps = parse_profile({"plugins": {
+        "filter": {"disabled": [{"name": "*"}],
+                   "enabled": [{"name": "NodeResourcesFit"}]}}})
+    assert ps.filters() == ["NodeResourcesFit"]
+    # scoring untouched by the filter-point wipe
+    assert "NodeResourcesBalancedAllocation" in ps.scorers()
+
+
+def test_wrapped_names_accepted_in_point_sets():
+    ps = parse_profile({"plugins": {
+        "score": {"disabled": [{"name": "TaintTolerationWrapped"}]}}})
+    assert "TaintToleration" not in ps.scorers()
+
+
+def test_prescore_point_disable():
+    ps = parse_profile({"plugins": {
+        "preScore": {"disabled": [{"name": "PodTopologySpread"}]}}})
+    assert "PodTopologySpread" not in ps.prescorers()
+    assert "PodTopologySpread" in ps.filters()
+
+
+def test_postfilter_disable_turns_off_preemption():
+    ps = parse_profile({"plugins": {
+        "postFilter": {"disabled": [{"name": "DefaultPreemption"}]}}})
+    assert ps.postfilters() == []
+
+
+def test_point_disable_flows_to_annotations_and_matches_oracle():
+    """A score-point disable changes both the tensor path's annotations
+    and the oracle identically: the plugin appears in filter-result but
+    not in score/finalscore."""
+    from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+    from kube_scheduler_simulator_tpu.reference_impl.sequential import (
+        SequentialScheduler)
+    from kube_scheduler_simulator_tpu.state.compile import compile_workload
+    from kube_scheduler_simulator_tpu.framework.replay import replay
+    from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+    cfg = parse_profile({"plugins": {
+        "multiPoint": {"disabled": [{"name": "*"}],
+                       "enabled": [{"name": "NodeResourcesFit"},
+                                   {"name": "TaintToleration", "weight": 3},
+                                   {"name": "NodeResourcesBalancedAllocation"}]},
+        "score": {"disabled": [{"name": "TaintToleration"}]},
+    }})
+    nodes = make_nodes(6, seed=3, taint_fraction=0.3)
+    pods = make_pods(8, seed=4, with_tolerations=True)
+
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=8)
+    for i, (seq_anns, seq_sel) in enumerate(seq):
+        tensor_anns = decode_pod_result(rr, i)
+        assert int(rr.selected[i]) == seq_sel, f"pod {i} selection diverged"
+        assert tensor_anns == seq_anns, f"pod {i} diverged"
+        fs = json.loads(tensor_anns[ann.FINAL_SCORE_RESULT])
+        for per_plugin in fs.values():
+            assert "TaintToleration" not in per_plugin
+        fr = json.loads(tensor_anns[ann.FILTER_RESULT])
+        assert any("TaintToleration" in m for m in fr.values())
+
+
+def test_engine_point_disable_end_to_end():
+    store = ObjectStore()
+    store.create("nodes", {
+        "metadata": {"name": "tainted"},
+        "spec": {"taints": [{"key": "dedicated", "value": "x",
+                             "effect": "NoSchedule"}]},
+        "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "10"}}})
+    store.create("nodes", {
+        "metadata": {"name": "clean"},
+        "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "10"}}})
+    store.create("pods", {"metadata": {"name": "p", "namespace": "default"},
+                          "spec": {"containers": [{"name": "c", "resources": {
+                              "requests": {"cpu": "1", "memory": "1Gi"}}}]}})
+    engine = SchedulerEngine(store)
+    from kube_scheduler_simulator_tpu.scheduler.service import SchedulerService
+
+    svc = SchedulerService(engine)
+    cfg = svc.get_config()
+    # disable TaintToleration at the FILTER point only: the untolerated
+    # taint no longer excludes the node
+    cfg["profiles"][0]["plugins"] = {
+        "filter": {"disabled": [{"name": "TaintToleration"}]}}
+    svc.restart_scheduler(cfg)
+    assert engine.schedule_pending() == 1
+    p = store.get("pods", "p")
+    fr = json.loads(p["metadata"]["annotations"][ann.FILTER_RESULT])
+    assert all("TaintToleration" not in m for m in fr.values())
+    assert "tainted" in fr  # the node was NOT filtered out by the taint
+
+
+def test_score_only_enable_does_not_filter():
+    """A plugin enabled only at the score point must not also filter
+    (upstream per-point semantics)."""
+    ps = parse_profile({"plugins": {
+        "multiPoint": {"disabled": [{"name": "*"}],
+                       "enabled": [{"name": "NodeName"}]},
+        "score": {"enabled": [{"name": "NodeResourcesFit", "weight": 2}]},
+    }})
+    assert "NodeResourcesFit" not in ps.filters()
+    assert "NodeResourcesFit" in ps.scorers()
+    assert ps.weight("NodeResourcesFit") == 2
+    assert "NodeResourcesFit" in ps.active_plugins()
+
+
+def test_enable_and_disable_same_point_enable_wins():
+    """mergePluginSet: disables suppress the DEFAULT entry; an explicit
+    enable re-appends the plugin (it runs, last)."""
+    ps = parse_profile({"plugins": {
+        "filter": {"disabled": [{"name": "TaintToleration"}],
+                   "enabled": [{"name": "TaintToleration"}]}}})
+    assert ps.filters()[-1] == "TaintToleration"
+
+
+def test_star_disable_keeps_user_enable_order():
+    ps = parse_profile({"plugins": {
+        "filter": {"disabled": [{"name": "*"}],
+                   "enabled": [{"name": "NodeResourcesFit"},
+                               {"name": "NodeUnschedulable"}]}}})
+    assert ps.filters() == ["NodeResourcesFit", "NodeUnschedulable"]
+
+
+def test_point_enable_requires_capability():
+    """Enabling a plugin at a point it does not implement is ignored
+    (upstream rejects the profile; we drop the entry)."""
+    ps = parse_profile({"plugins": {
+        "filter": {"enabled": [{"name": "ImageLocality"}]}}})
+    assert "ImageLocality" not in ps.filters()
+
+
+def test_point_only_enable_schedules_and_matches_oracle():
+    """A filter-point-only enable of a plugin outside the global set
+    compiles (active_plugins covers it) and stays bit-parity with the
+    oracle."""
+    from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+    from kube_scheduler_simulator_tpu.reference_impl.sequential import (
+        SequentialScheduler)
+    from kube_scheduler_simulator_tpu.state.compile import compile_workload
+    from kube_scheduler_simulator_tpu.framework.replay import replay
+    from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+    cfg = parse_profile({"plugins": {
+        "multiPoint": {"disabled": [{"name": "*"}],
+                       "enabled": [{"name": "NodeResourcesFit"}]},
+        "filter": {"enabled": [{"name": "TaintToleration"}]},
+    }})
+    assert "TaintToleration" in cfg.filters()
+    assert "TaintToleration" not in cfg.scorers()
+    nodes = make_nodes(5, seed=9, taint_fraction=0.5)
+    pods = make_pods(6, seed=10, with_tolerations=True)
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=8)
+    for i, (seq_anns, seq_sel) in enumerate(seq):
+        assert int(rr.selected[i]) == seq_sel
+        assert decode_pod_result(rr, i) == seq_anns
