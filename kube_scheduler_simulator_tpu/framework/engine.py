@@ -1091,7 +1091,12 @@ class SchedulerEngine:
                 )
                 if bind_ext is not None:
                     # upstream: a bind-verb extender REPLACES the default
-                    # binder; its failure fails the cycle (pod retries)
+                    # binder (the wrapped DefaultBinder never runs, so its
+                    # bind-result stays empty; the extender round-trip is
+                    # recorded under extender-bind-result instead); its
+                    # failure fails the cycle (pod retries)
+                    self.result_store.put_decoded(
+                        ns, name, {ann.BIND_RESULT: "{}"})
                     try:
                         result = self.extender_service.handle("bind", bind_ext, {
                             "PodName": name, "PodNamespace": ns,

@@ -297,3 +297,26 @@ def test_ignorable_extender_failure():
     engine = SchedulerEngine(store)
     engine.set_extenders(svc)
     assert engine.schedule_pending() == 1  # unreachable but ignorable
+
+
+def test_bind_extender_replaces_default_binder_record(fake_extender):
+    """With a bindVerb extender, upstream's extendersBinding runs instead
+    of the Bind plugins: bind-result stays {} while extender-bind-result
+    records the round-trip."""
+    store = ObjectStore()
+    for n in make_nodes(2, seed=21):
+        store.create("nodes", n)
+    for p in make_pods(1, seed=22):
+        store.create("pods", p)
+    engine = SchedulerEngine(store)
+    svc = SchedulerService(engine)
+    cfg = svc.get_config()
+    cfg["extenders"] = [{"urlPrefix": fake_extender, "bindVerb": "bind",
+                         "filterVerb": "filter", "weight": 1}]
+    svc.restart_scheduler(cfg)
+    assert engine.schedule_pending() == 1
+    p = store.get("pods", "pod-00000")
+    annos = p["metadata"]["annotations"]
+    assert p["spec"]["nodeName"]
+    assert annos[ann.BIND_RESULT] == "{}"
+    assert json.loads(annos[ann.EXTENDER_BIND_RESULT])  # round-trip recorded
