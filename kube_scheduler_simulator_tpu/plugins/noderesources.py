@@ -43,6 +43,29 @@ NAME_BALANCED = "NodeResourcesBalancedAllocation"
 class FitStatic(NamedTuple):
     allocatable: jnp.ndarray   # [N, R] int64
     allowed_pods: jnp.ndarray  # [N] int64
+    ignored: jnp.ndarray       # [R] bool — NodeResourcesFitArgs ignored*
+
+
+def fit_ignored_mask(schema: ResourceSchema, args: dict | None) -> np.ndarray:
+    """[R] bool mask of schema columns excluded from the fit check by
+    NodeResourcesFitArgs.ignoredResources / ignoredResourceGroups.
+    Upstream fitsRequest only skips EXTENDED resources (domain-prefixed
+    names); cpu/memory/ephemeral-storage are never ignorable."""
+    a = args or {}
+    names = set(a.get("ignoredResources") or [])
+    groups = set(a.get("ignoredResourceGroups") or [])
+    out = np.zeros(len(schema.columns), dtype=bool)
+    for r, col in enumerate(schema.columns):
+        # IsExtendedResourceName: domain-prefixed and NOT kubernetes.io/
+        # (unprefixed and kubernetes.io/ names are native, never skipped)
+        if "/" not in col:
+            continue
+        prefix = col.split("/", 1)[0]
+        if prefix == "kubernetes.io" or prefix.endswith(".kubernetes.io"):
+            continue
+        if col in names or prefix in groups:
+            out[r] = True
+    return out
 
 
 class FitPodXS(NamedTuple):
@@ -50,10 +73,12 @@ class FitPodXS(NamedTuple):
     nonzero: jnp.ndarray   # [P, 2] int64 (scoring path)
 
 
-def build_fit(table, schema: ResourceSchema, requests, nonzero):
+def build_fit(table, schema: ResourceSchema, requests, nonzero,
+              fit_args: dict | None = None):
     static = FitStatic(
         allocatable=jnp.asarray(table.allocatable),
         allowed_pods=jnp.asarray(table.allowed_pods),
+        ignored=jnp.asarray(fit_ignored_mask(schema, fit_args)),
     )
     xs = FitPodXS(requests=jnp.asarray(requests), nonzero=jnp.asarray(nonzero))
     return static, xs
@@ -62,7 +87,7 @@ def build_fit(table, schema: ResourceSchema, requests, nonzero):
 def fit_filter(static: FitStatic, pod: FitPodXS, carry) -> jnp.ndarray:
     """[N] int32 bitmask; 0 == pass."""
     free = static.allocatable - carry.requested          # [N, R]
-    insufficient = pod.requests[None, :] > free           # [N, R]
+    insufficient = (pod.requests[None, :] > free) & ~static.ignored[None, :]  # [N, R]
     too_many = (carry.num_pods + 1) > static.allowed_pods  # [N]
     bits = jnp.where(insufficient, jnp.int32(2) << jnp.arange(insufficient.shape[1], dtype=jnp.int32), 0)
     res_code = jnp.sum(bits, axis=1, dtype=jnp.int32)

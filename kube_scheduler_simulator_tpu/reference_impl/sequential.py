@@ -69,6 +69,10 @@ class SequentialScheduler:
         ))
         self._added_affinity = (self.config.args.get("NodeAffinity") or {}).get(
             "addedAffinity") or {}
+        from ..plugins.noderesources import fit_ignored_mask
+
+        self._fit_ignored = fit_ignored_mask(
+            self.schema, self.config.args.get("NodeResourcesFit"))
         self.labels = self.table.labels
         self.names = self.table.names
         self.n = self.table.n
@@ -103,7 +107,7 @@ class SequentialScheduler:
                 alloc = self.table.allocatable[j]
                 free = alloc - self.requested[j]
                 for r, col in enumerate(self.schema.columns):
-                    if req[r] > free[r]:
+                    if req[r] > free[r] and not self._fit_ignored[r]:
                         reasons.append(f"Insufficient {col}")
             return ", ".join(reasons) if reasons else None
         if name == "NodeAffinity":

@@ -144,7 +144,9 @@ def compile_workload(
     enabled = set(config.active_plugins())
     # Fit static/xs double as the core resource tensors even when the Fit
     # plugin itself is disabled (bind updates always need pod requests).
-    fit_static, fit_xs = noderesources.build_fit(table, schema, requests, nonzero)
+    fit_static, fit_xs = noderesources.build_fit(
+        table, schema, requests, nonzero,
+        fit_args=config.args.get("NodeResourcesFit"))
     statics["core"] = fit_static
     xs["core"] = fit_xs
     from ..plugins.base import CoreCarry

@@ -182,3 +182,47 @@ def test_gate_removal_unblocks():
     s.update("pods", g)
     assert engine.schedule_pending() == 1
     assert s.get("pods", "gated")["spec"]["nodeName"] == "n1"
+
+
+def test_fit_ignored_resources_and_groups():
+    """NodeResourcesFitArgs.ignoredResources / ignoredResourceGroups skip
+    extended resources in the fit check (upstream fitsRequest); native
+    resources are never ignorable. Tensor path and oracle agree."""
+    from kube_scheduler_simulator_tpu.framework.replay import replay
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+    from kube_scheduler_simulator_tpu.reference_impl.sequential import (
+        SequentialScheduler)
+    from kube_scheduler_simulator_tpu.state.compile import compile_workload
+    from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+    nodes = [{"metadata": {"name": "n1"},
+              "status": {"allocatable": {
+                  "cpu": "4", "memory": "8Gi", "pods": "10",
+                  "example.com/gpu": "1", "other.io/fpga": "1"}}}]
+    pods = [{"metadata": {"name": "p", "namespace": "default"},
+             "spec": {"containers": [{"name": "c", "resources": {"requests": {
+                 "cpu": "1", "memory": "1Gi",
+                 "example.com/gpu": "2",       # over capacity but ignored
+                 "other.io/fpga": "2",         # over capacity, group-ignored
+             }}}]}}]
+    cfg = PluginSetConfig(
+        enabled=["NodeResourcesFit"],
+        args={"NodeResourcesFit": {
+            "ignoredResources": ["example.com/gpu"],
+            "ignoredResourceGroups": ["other.io"]}})
+    seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=2)
+    assert int(rr.selected[0]) == 0          # schedules despite the overask
+    assert decode_pod_result(rr, 0) == seq[0][0]
+    assert seq[0][1] == 0
+
+    # without the ignore args the same pod is rejected with both reasons
+    cfg2 = PluginSetConfig(enabled=["NodeResourcesFit"])
+    rr2 = replay(compile_workload(nodes, pods, cfg2), chunk=2)
+    assert int(rr2.selected[0]) == -1
+    import json
+
+    from kube_scheduler_simulator_tpu.store import annotations as ann
+
+    fr = json.loads(decode_pod_result(rr2, 0)[ann.FILTER_RESULT])
+    assert "Insufficient example.com/gpu" in fr["n1"]["NodeResourcesFit"]
