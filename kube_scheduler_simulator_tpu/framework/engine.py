@@ -400,7 +400,7 @@ class SchedulerEngine:
         # settle before the wave returns.  Submissions are chunked so a
         # 10k-pod wave costs ~150 futures, not 10k.
         reflect_futs: list = []
-        reflect_batch: list[tuple[str, str]] = []
+        reflect_batch: list[tuple[str, str, str | None]] = []
         pool = self._reflector_pool()
         reflect_one = self.reflector.reflect
         # small waves still fan across the pool; 10k-pod waves cost ~150
@@ -412,16 +412,16 @@ class SchedulerEngine:
             # fails (matching the one-future-per-pod behavior); the first
             # error still surfaces from drain_reflects()
             first_err = None
-            for bns, bname in batch:
+            for bns, bname, buid in batch:
                 try:
-                    reflect_one(bns, bname)
+                    reflect_one(bns, bname, uid=buid)
                 except Exception as e:  # noqa: BLE001
                     first_err = first_err or e
             if first_err is not None:
                 raise first_err
 
-        def submit_reflect(bns, bname):
-            reflect_batch.append((bns, bname))
+        def submit_reflect(bns, bname, buid):
+            reflect_batch.append((bns, bname, buid))
             if len(reflect_batch) >= batch_n:
                 reflect_futs.append(pool.submit(run_batch, reflect_batch[:]))
                 reflect_batch.clear()
@@ -467,7 +467,7 @@ class SchedulerEngine:
                         # state
                         self._mark_unschedulable(ns, name)
                         drain_reflects()
-                        self.reflector.reflect(ns, name)
+                        self.reflector.reflect(ns, name, uid=meta.get("uid"))
                         if exclude is not None:
                             exclude.add((ns, name))
                         return n_bound, "rejected"
@@ -485,7 +485,7 @@ class SchedulerEngine:
                                 cw, rr.codes_of(i), i, pod, ns, name):
                             retry = "preempted"
                     self._mark_unschedulable(ns, name)
-                submit_reflect(ns, name)
+                submit_reflect(ns, name, meta.get("uid"))
             drain_reflects()
         return n_bound, retry
 
@@ -686,7 +686,8 @@ class SchedulerEngine:
             try:
                 if outcome == "rejected":
                     self._mark_unschedulable(ns, name)
-                self.reflector.reflect(ns, name)
+                self.reflector.reflect(
+                    ns, name, uid=(pod.get("metadata") or {}).get("uid"))
             except Exception:
                 pass
             self.waiting_pods.pop((ns, name), None)
@@ -1127,7 +1128,7 @@ class SchedulerEngine:
                     if self._run_postfilter(cw, codes, i, pod, ns, name):
                         retry = "preempted"
                 self._mark_unschedulable(ns, name)
-            self.reflector.reflect(ns, name)
+            self.reflector.reflect(ns, name, uid=meta.get("uid"))
         return n_bound, retry
 
     # ------------------------------------------------------------ writes

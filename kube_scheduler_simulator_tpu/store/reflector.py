@@ -94,9 +94,14 @@ class StoreReflector:
         """reference: storereflector.go AddResultStore."""
         self.result_stores[key] = result_store
 
-    def reflect(self, namespace: str, name: str) -> None:
+    def reflect(self, namespace: str, name: str, uid: str | None = None) -> None:
         """Merge all result stores' data for the pod into its annotations
-        (with history), conflict-retrying; delete store data on success."""
+        (with history), conflict-retrying; delete store data on success.
+
+        uid (when the caller knows it) guards against the pod having been
+        deleted and recreated under the same name since scheduling — the
+        reference aborts on UID mismatch (storereflector.go:107-109) so a
+        fresh pod never inherits a stale result record."""
 
         last_pod: dict = {}
 
@@ -105,6 +110,15 @@ class StoreReflector:
                 cur = self.store.get("pods", name, namespace,
                                      copy_object=False)
             except NotFound:
+                return True, None
+            if uid and (cur.get("metadata") or {}).get("uid") not in (None, uid):
+                # recreated pod: purge the stale record so the new pod's
+                # own cycle starts clean (the reference merely errors out
+                # and leaks the store entry, storereflector.go:107-109 —
+                # deleting completes the guard's intent)
+                stale = {"metadata": {"namespace": namespace, "name": name}}
+                for rs in self.result_stores.values():
+                    rs.delete_data(stale)
                 return True, None
             result_set: dict[str, str] = {}
             for rs in self.result_stores.values():

@@ -264,3 +264,37 @@ def test_result_store_final_score_applies_weight():
     assert json.loads(out[ann.SCORE_RESULT])["node-1"]["P"] == "50"
     # finalscore = normalized x weight (resultstore/store.go:488-507)
     assert json.loads(out[ann.FINAL_SCORE_RESULT])["node-1"]["P"] == "240"
+
+
+def test_reflect_uid_mismatch_drops_stale_record():
+    """A pod deleted and recreated under the same name between scheduling
+    and reflect must NOT inherit the old record (reference
+    storereflector.go:107-109 aborts on UID mismatch)."""
+    from kube_scheduler_simulator_tpu.store.reflector import StoreReflector
+    from kube_scheduler_simulator_tpu.store.resultstore import ResultStore
+
+    store = ObjectStore()
+    store.create("pods", {"metadata": {"name": "p", "namespace": "default"},
+                          "spec": {}})
+    old_uid = store.get("pods", "p")["metadata"]["uid"]
+    rs = ResultStore()
+    rs.put_decoded("default", "p", {
+        "kube-scheduler-simulator.sigs.k8s.io/selected-node": "n1"})
+    refl = StoreReflector(store)
+    refl.add_result_store(rs, "k")
+
+    # recreate under the same name -> new uid
+    store.delete("pods", "p")
+    store.create("pods", {"metadata": {"name": "p", "namespace": "default"},
+                          "spec": {}})
+    assert store.get("pods", "p")["metadata"]["uid"] != old_uid
+
+    refl.reflect("default", "p", uid=old_uid)
+    fresh = store.get("pods", "p")
+    assert "kube-scheduler-simulator.sigs.k8s.io/selected-node" not in (
+        fresh["metadata"].get("annotations") or {})
+    # the stale record was purged: a later reflect (no uid hint) finds
+    # nothing to write, so the recreated pod stays uncontaminated
+    refl.reflect("default", "p")
+    assert "kube-scheduler-simulator.sigs.k8s.io/selected-node" not in (
+        store.get("pods", "p")["metadata"].get("annotations") or {})
