@@ -412,11 +412,13 @@ def _run(args):
     if not args.skip_engine:
         ep, en = (1000, 500) if not args.smoke else (50, 25)
         extra["engine"] = measure_engine(ep, en, args.seed)
-        if not args.smoke:
+        if not args.smoke and not args.assume_fallback:
             # largest engine scale that keeps the annotation payloads sane
             # (~300 KiB/pod at 1k nodes; the decoded strings live in the
-            # store until the next reset); the CPU fallback runs these too
-            # (~20s total on one core)
+            # store until the next reset); the wedge fallback runs these
+            # too (~20s on one core), but the post-crash minimal re-exec
+            # (--assume-fallback) must stay cheap to guarantee its one
+            # JSON line
             extra["engine_2k_1k"] = measure_engine(2000, 1000, args.seed)
             # the config-5 hard plugin on the serving path
             extra["engine_interpod"] = measure_engine(ep, en, args.seed,
