@@ -145,7 +145,9 @@ def _make_handler(di: DIContainer):
                     di.reset_service.reset()
                     return self._json(202)
                 elif path == "/api/v1/export" and method == "GET":
-                    return self._json(200, di.snapshot_service.snap())
+                    opts = SnapshotOptions(
+                        ignore_err="ignoreErr" in parse_qs(url.query))
+                    return self._json(200, di.snapshot_service.snap(opts))
                 elif path == "/api/v1/import" and method == "POST":
                     opts = SnapshotOptions(
                         ignore_err="ignoreErr" in parse_qs(url.query),

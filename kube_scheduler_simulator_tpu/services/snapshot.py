@@ -59,12 +59,22 @@ class SnapshotService:
     def snap(self, options: SnapshotOptions | None = None) -> dict:
         """One JSON-able dict of the whole cluster.  The manifests are
         SHARED with the store (callers serialize or re-apply via load(),
-        which copies) — do not mutate them."""
+        which copies) — do not mutate them.
+
+        With ignore_err, a failing kind degrades to an empty list instead
+        of failing the snapshot (reference snapshot.go:221-227 per-list
+        IgnoreErr handling)."""
         from ..cluster.store import list_shared
 
+        opts = options or SnapshotOptions()
         out: dict = {}
         for field, resource in _FIELDS:
-            items = list_shared(self.store, resource)
+            try:
+                items = list_shared(self.store, resource)
+            except Exception:
+                if not opts.ignore_err:
+                    raise
+                items = []
             if resource == "namespaces":
                 items = [i for i in items if not _ignored_namespace(i["metadata"]["name"])]
             if resource == "priorityclasses":

@@ -51,6 +51,12 @@ class FaultyStore(ObjectStore):
             raise err
         return super().update(resource, obj, **kwargs)
 
+    def list(self, resource, *args, **kwargs):
+        err = self.fail.get(("list", resource))
+        if err is not None:
+            raise err
+        return super().list(resource, *args, **kwargs)
+
 
 class FakeScheduler:
     def __init__(self, fail=False):
@@ -298,3 +304,36 @@ def test_reflect_uid_mismatch_drops_stale_record():
     refl.reflect("default", "p")
     assert "kube-scheduler-simulator.sigs.k8s.io/selected-node" not in (
         store.get("pods", "p")["metadata"].get("annotations") or {})
+
+
+def test_snap_list_error_aborts_without_ignore_err():
+    """snapshot_test.go Snap error tables: a failing kind list fails the
+    whole export unless IgnoreErr."""
+    from kube_scheduler_simulator_tpu.services.snapshot import SnapshotService
+
+    store = FaultyStore()
+    store.create("nodes", {"metadata": {"name": "n1"}, "spec": {}})
+    store.create("pods", {"metadata": {"name": "p1", "namespace": "default"},
+                          "spec": {}})
+    svc = SnapshotService(store, FakeScheduler())
+    store.fail[("list", "pods")] = ApiError("injected list failure")
+    with pytest.raises(ApiError):
+        svc.snap()
+
+
+def test_snap_list_error_degrades_with_ignore_err():
+    """With IgnoreErr the failing kind exports as an empty list and every
+    other kind still snapshots (reference snapshot.go:221-227)."""
+    from kube_scheduler_simulator_tpu.services.snapshot import (
+        SnapshotOptions, SnapshotService)
+
+    store = FaultyStore()
+    store.create("nodes", {"metadata": {"name": "n1"}, "spec": {}})
+    store.create("pods", {"metadata": {"name": "p1", "namespace": "default"},
+                          "spec": {}})
+    svc = SnapshotService(store, FakeScheduler())
+    store.fail[("list", "pods")] = ApiError("injected list failure")
+    snap = svc.snap(SnapshotOptions(ignore_err=True))
+    assert snap["pods"] == []
+    assert [n["metadata"]["name"] for n in snap["nodes"]] == ["n1"]
+    assert "schedulerConfig" in snap
