@@ -320,3 +320,15 @@ def test_bind_extender_replaces_default_binder_record(fake_extender):
     assert p["spec"]["nodeName"]
     assert annos[ann.BIND_RESULT] == "{}"
     assert json.loads(annos[ann.EXTENDER_BIND_RESULT])  # round-trip recorded
+
+
+def test_service_routing_edges(fake_extender):
+    """service_test.go routing: per-index dispatch; out-of-range index and
+    unknown verb are errors (the HTTP handler turns them into 4xx)."""
+    svc = ExtenderService([extender_cfg(fake_extender)])
+    with pytest.raises(IndexError):
+        svc.handle("filter", 1, {"Pod": {}, "NodeNames": []})
+    with pytest.raises(IndexError):
+        svc.handle("filter", -1, {"Pod": {}, "NodeNames": []})
+    with pytest.raises(ValueError):
+        svc.handle("frobnicate", 0, {})
