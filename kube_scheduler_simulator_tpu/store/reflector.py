@@ -89,10 +89,71 @@ class StoreReflector:
         self.store = store
         self.result_stores: dict[str, object] = {}
         self._sleep = sleep  # injectable for tests
+        self._watch_thread = None
+        self._watch_queue = None
 
     def add_result_store(self, result_store, key: str) -> None:
         """reference: storereflector.go AddResultStore."""
         self.result_stores[key] = result_store
+
+    def register_result_saving_to_informer(self, stop_event) -> None:
+        """The reference's informer wiring (ResisterResultSavingToInformer
+        [sic], storereflector.go:56-81): a pod-update watcher that
+        reflects stored results whenever a pod changes — the path an
+        EXTERNAL scheduler's bind (through the HTTP API) takes, where no
+        in-process engine calls reflect() after binding.  Do NOT enable it
+        alongside an engine that reflects inline (the default simulator
+        wiring): both paths appending the same record would duplicate it
+        in result-history.  Idempotent; the watcher thread stops (and
+        unsubscribes its queue) with stop_event."""
+        import threading
+
+        if self._watch_thread is not None:
+            return
+        _, rv = self.store.list("pods")
+        q = self.store.watch("pods", since_rv=rv)
+        self._watch_queue = q
+
+        def pump():
+            try:
+                while not stop_event.is_set():
+                    ev = q.get()
+                    if ev is None:
+                        return
+                    _, event_type, obj = ev
+                    if event_type != "MODIFIED":
+                        continue
+                    meta = obj.get("metadata") or {}
+                    ns = meta.get("namespace") or "default"
+                    name = meta.get("name", "")
+                    # only fire when some store holds a result for the pod
+                    # (the reference's handler re-GETs and no-ops
+                    # otherwise; checking first avoids a write cycle per
+                    # unrelated update)
+                    if any(rs.get_stored_result(obj)
+                           for rs in self.result_stores.values()):
+                        try:
+                            self.reflect(ns, name, uid=meta.get("uid"))
+                        except Exception:
+                            pass  # klog-and-continue, as the reference does
+            finally:
+                # stop_event exits must also unsubscribe, or the abandoned
+                # unbounded queue keeps accumulating every pod event
+                self.store.unwatch("pods", q)
+
+        t = threading.Thread(target=pump, daemon=True,
+                             name="reflector-informer")
+        t.start()
+        self._watch_thread = t
+
+    def stop_informer(self) -> None:
+        if self._watch_queue is not None:
+            self.store.unwatch("pods", self._watch_queue)
+            self._watch_queue.put(None)
+            self._watch_queue = None
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2)
+            self._watch_thread = None
 
     def reflect(self, namespace: str, name: str, uid: str | None = None) -> None:
         """Merge all result stores' data for the pod into its annotations

@@ -337,3 +337,47 @@ def test_snap_list_error_degrades_with_ignore_err():
     assert snap["pods"] == []
     assert [n["metadata"]["name"] for n in snap["nodes"]] == ["n1"]
     assert "schedulerConfig" in snap
+
+
+def test_informer_mode_reflects_externally_bound_pod():
+    """The reference's informer wiring (storereflector.go:56-81): an
+    EXTERNAL bind through the store (no engine reflect() call) still gets
+    its stored results written back by the pod-update watcher."""
+    import threading
+    import time as _time
+
+    from kube_scheduler_simulator_tpu.store.reflector import StoreReflector
+    from kube_scheduler_simulator_tpu.store.resultstore import ResultStore
+
+    store = ObjectStore()
+    store.create("pods", {"metadata": {"name": "p", "namespace": "default"},
+                          "spec": {}})
+    rs = ResultStore()
+    rs.put_decoded("default", "p", {
+        "kube-scheduler-simulator.sigs.k8s.io/selected-node": "n1"})
+    refl = StoreReflector(store)
+    refl.add_result_store(rs, "k")
+    stop = threading.Event()
+    refl.register_result_saving_to_informer(stop)
+    try:
+        # an external scheduler binds the pod via a plain store update
+        p = store.get("pods", "p")
+        p["spec"]["nodeName"] = "n1"
+        store.update("pods", p)
+        deadline = _time.time() + 3
+        while _time.time() < deadline:
+            anns = (store.get("pods", "p")["metadata"].get("annotations")
+                    or {})
+            if "kube-scheduler-simulator.sigs.k8s.io/selected-node" in anns:
+                break
+            _time.sleep(0.02)
+        anns = store.get("pods", "p")["metadata"].get("annotations") or {}
+        assert anns.get(
+            "kube-scheduler-simulator.sigs.k8s.io/selected-node") == "n1"
+        # store entry deleted after the successful write (reference
+        # storereflector.go:156-159): a later unrelated update no-ops
+        assert rs.get_stored_result({"metadata": {
+            "namespace": "default", "name": "p"}}) is None
+    finally:
+        stop.set()
+        refl.stop_informer()
