@@ -1,0 +1,20 @@
+#!/bin/bash
+# TPU-tunnel recovery watcher (round 4).
+#
+# The axon tunnel wedges server-side for hours after a client dies mid-run
+# (see BASELINE.md / round-3 notes).  This loop probes device init in a
+# subprocess every ~25 min and, on first success, runs bench.py once so a
+# real-TPU artifact exists even if the recovery happens unattended.
+cd /root/repo || exit 1
+LOG=docs/bench/r04-tpu-watch.log
+while true; do
+  ts=$(date -u +%FT%TZ)
+  if timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$ts probe: ALIVE -> running bench.py" >> "$LOG"
+    python bench.py > docs/bench/r04-tpu-bench.json 2> docs/bench/r04-tpu-bench.err
+    echo "$(date -u +%FT%TZ) bench rc=$? (json+err under docs/bench/)" >> "$LOG"
+    exit 0
+  fi
+  echo "$ts probe: dead" >> "$LOG"
+  sleep 1500
+done

@@ -65,7 +65,44 @@ def _build_and_load():
         P(ctypes.c_char_p), P(ctypes.c_char_p),
         P(ctypes.c_longlong), ctypes.c_longlong,
     ]
+    lib.codec_ctx_new.restype = ctypes.c_void_p
+    lib.codec_ctx_new.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        P(ctypes.c_char_p), P(ctypes.c_char_p), P(ctypes.c_char_p),
+        P(ctypes.c_int32), P(ctypes.c_int32), P(ctypes.c_int32),
+        P(ctypes.c_char_p), P(ctypes.c_int32), P(ctypes.c_uint8),
+        P(ctypes.c_int32), P(ctypes.c_int64), ctypes.c_int64,
+    ]
+    lib.ctx_decode_pod.restype = ctypes.c_int32
+    lib.ctx_decode_pod.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        P(ctypes.c_uint8), P(ctypes.c_uint8),
+        P(ctypes.c_void_p), P(ctypes.c_int32),
+        P(ctypes.c_uint8),
+        ctypes.c_int32,
+        P(ctypes.c_void_p), P(ctypes.c_int64),
+    ]
+    lib.codec_ctx_free.restype = None
+    lib.codec_ctx_free.argtypes = [ctypes.c_void_p]
+    lib.ctx_encode_filter.restype = ctypes.c_void_p
+    lib.ctx_encode_filter.argtypes = [
+        ctypes.c_void_p, P(ctypes.c_int32), P(ctypes.c_uint8),
+        P(ctypes.c_int64)]
+    lib.ctx_encode_scores.restype = ctypes.c_void_p
+    lib.ctx_encode_scores.argtypes = [
+        ctypes.c_void_p, P(ctypes.c_int64), P(ctypes.c_uint8), P(ctypes.c_uint8),
+        P(ctypes.c_int64)]
     return lib
+
+
+def take_sized_string(lib, ptr, length: int) -> str:
+    """Copy a codec-allocated buffer of known length and free it (skips
+    the strlen scan of take_string — the blobs run to ~1 MB)."""
+    try:
+        return ctypes.string_at(ptr, length).decode()
+    finally:
+        lib.codec_free(ptr)
 
 
 def get_lib():

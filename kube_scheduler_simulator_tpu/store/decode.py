@@ -138,13 +138,29 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None,
     for name in cfg.prefilters():
         prefilter_status[name] = "" if fskip[name][hi] else ann.SUCCESS_MESSAGE
 
+    native_ctx = _native_ctx(cw)
+
+    # --- fused native path (compact replay layout only) -----------------
+    if (native_ctx is not None and getattr(rr, "_compact", None) is not None
+            and feasible_override is None):
+        from . import native_decode
+
+        feasible_count = int(rr.feasible_count[i])
+        filter_json, score_json, final_json = native_decode.decode_pod_fused(
+            native_ctx, rr, i, hi, feasible_count > 1)
+        prescore = {}
+        if feasible_count > 1:
+            for name in cfg.prescorers():
+                prescore[name] = "" if sskip[name][hi] else ann.SUCCESS_MESSAGE
+        return _assemble(cw, cfg, names, rr, i, prefilter_status, prescore,
+                         filter_json, score_json, final_json)
+
     # --- filter (stop at first fail per node) ---------------------------
     active = [
         (f, name) for f, name in enumerate(filter_names) if not fskip[name][hi]
     ]
     codes = rr.codes_of(i)  # [F, N]
 
-    native_ctx = _native_ctx(cw)
     filter_json: str | None = None
     if native_ctx is not None:
         from . import native_decode
@@ -206,7 +222,17 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None,
                     score_map[node] = se
                     final_map[node] = fe
 
-    # --- bind phase -----------------------------------------------------
+    return _assemble(
+        cw, cfg, names, rr, i, prefilter_status, prescore,
+        filter_json if filter_json is not None else ann.marshal(filter_map),
+        score_json if score_json is not None else ann.marshal(score_map),
+        final_json if final_json is not None else ann.marshal(final_map))
+
+
+def _assemble(cw, cfg, names, rr, i: int, prefilter_status: dict,
+              prescore: dict, filter_json: str, score_json: str | None,
+              final_json: str | None) -> dict[str, str]:
+    """Bind-phase maps + the 13-key annotation dict (both decode paths)."""
     sel = int(rr.selected[i])
     scheduled = sel >= 0
     bind = {"DefaultBinder": ann.SUCCESS_MESSAGE} if scheduled else {}
@@ -220,17 +246,18 @@ def decode_pod_result(rr: ReplayResult, i: int, feasible_override=None,
         reserve["VolumeBinding"] = ann.SUCCESS_MESSAGE
         prebind["VolumeBinding"] = ann.SUCCESS_MESSAGE
 
+    empty = ann.marshal({})
     return {
         ann.PRE_FILTER_STATUS_RESULT: ann.marshal(prefilter_status),
-        ann.PRE_FILTER_RESULT: ann.marshal({}),
-        ann.FILTER_RESULT: filter_json if filter_json is not None else ann.marshal(filter_map),
-        ann.POST_FILTER_RESULT: ann.marshal({}),
+        ann.PRE_FILTER_RESULT: empty,
+        ann.FILTER_RESULT: filter_json,
+        ann.POST_FILTER_RESULT: empty,
         ann.PRE_SCORE_RESULT: ann.marshal(prescore),
-        ann.SCORE_RESULT: score_json if score_json is not None else ann.marshal(score_map),
-        ann.FINAL_SCORE_RESULT: final_json if final_json is not None else ann.marshal(final_map),
+        ann.SCORE_RESULT: score_json if score_json is not None else empty,
+        ann.FINAL_SCORE_RESULT: final_json if final_json is not None else empty,
         ann.RESERVE_RESULT: ann.marshal(reserve),
-        ann.PERMIT_STATUS_RESULT: ann.marshal({}),
-        ann.PERMIT_TIMEOUT_RESULT: ann.marshal({}),
+        ann.PERMIT_STATUS_RESULT: empty,
+        ann.PERMIT_TIMEOUT_RESULT: empty,
         ann.PRE_BIND_RESULT: ann.marshal(prebind),
         ann.BIND_RESULT: ann.marshal(bind),
         ann.SELECTED_NODE: names[sel] if scheduled else "",
@@ -260,11 +287,18 @@ def decode_chunk_into(rr, lo: int, hi: int, out: list) -> None:
     while the device executes later chunks.  Idempotent per index (a
     width-tier rerun re-delivers chunks)."""
     cc = getattr(rr, "_compact", None)
-    if cc is None or hi - lo < 64:
+    if cc is None or hi - lo < 64 or (os.cpu_count() or 1) < 2:
+        # single-core hosts: the pool's dispatch + recon-lock traffic
+        # costs more than the GIL-released C calls can win back
         for i in range(lo, hi):
             out[i] = decode_pod_result(rr, i)
         return
-    rr._chunk_recon(lo // cc.chunk, scores=True)  # warm once, here
+    if _native_ctx(rr.cw) is None:
+        # pure-Python path reads codes_of/raw_of/final_of: reconstruct the
+        # chunk once here so pool workers share it.  The fused native path
+        # reads the compact arrays directly — warming recon for it would
+        # re-create exactly the [C,F,N]/[C,S,N] materialization it avoids.
+        rr._chunk_recon(lo // cc.chunk, scores=True)
     for i, a in zip(range(lo, hi),
                     _decode_pool().map(lambda i: decode_pod_result(rr, i),
                                        range(lo, hi))):
@@ -285,7 +319,7 @@ def decode_all_parallel(rr: ReplayResult,
     if n is None:
         n = rr.cw.n_pods
     cc = getattr(rr, "_compact", None)
-    if cc is None or n < 64:
+    if cc is None or n < 64 or (os.cpu_count() or 1) < 2:
         return [decode_pod_result(rr, i) for i in range(n)]
     out: list = [None] * n
     for lo in range(0, n, cc.chunk):

@@ -13,7 +13,7 @@ import ctypes
 
 import numpy as np
 
-from ..native import get_lib, take_string
+from ..native import get_lib, take_sized_string, take_string
 from ..plugins import (
     affinity, interpod, nodevolumelimits, ports, taints, topologyspread,
     volumebinding, volumerestrictions, volumezone,
@@ -119,22 +119,67 @@ def build_context(cw):
         lut_off.append(len(lut_flat))
 
     names_sorted = np.argsort(np.asarray(table.names)).astype(np.int32)
-    ctx = {
-        "lib": lib,
-        "n": n,
-        "node_names": _c_str_array([nm.encode() for nm in table.names]),
-        "filter_names": _c_str_array([nm.encode() for nm in filter_names]),
-        "score_names": _c_str_array([nm.encode() for nm in score_names]),
-        "sorted_nodes": np.ascontiguousarray(names_sorted),
-        "sorted_filters": np.argsort(np.asarray(filter_names)).astype(np.int32)
-        if filter_names else np.zeros(0, np.int32),
-        "sorted_scores": np.argsort(np.asarray(score_names)).astype(np.int32)
-        if score_names else np.zeros(0, np.int32),
-        "lut_flat": _c_str_array(lut_flat or [b""]),
-        "lut_off": np.asarray(lut_off, dtype=np.int32),
-        "per_node": np.asarray(per_node, dtype=np.uint8),
-    }
+    sorted_filters = (np.argsort(np.asarray(filter_names)).astype(np.int32)
+                      if filter_names else np.zeros(0, np.int32))
+    sorted_scores = (np.argsort(np.asarray(score_names)).astype(np.int32)
+                     if score_names else np.zeros(0, np.int32))
+    lut_off_arr = np.asarray(lut_off, dtype=np.int32)
+    per_node_arr = np.asarray(per_node, dtype=np.uint8)
+    # score finalization params (the hostnorm.finalize_chunk dispatch,
+    # matched by NAME exactly as finalize_chunk does)
+    _KINDS = {"NodeAffinity": 1, "TaintToleration": 2,
+              "PodTopologySpread": 3, "InterPodAffinity": 4}
+    kinds = np.asarray([_KINDS.get(nm, 0) for nm in score_names], np.int32)
+    weights = np.asarray([cw.config.weight(nm) for nm in score_names], np.int64)
+    # the C context copies every fragment (escaped node/plugin keys, escaped
+    # LUT messages) into its own storage, so the Python arrays above only
+    # need to live for this call
+    cptr = lib.codec_ctx_new(
+        n, len(filter_names), len(score_names),
+        _c_str_array([nm.encode() for nm in table.names]),
+        _c_str_array([nm.encode() for nm in filter_names]),
+        _c_str_array([nm.encode() for nm in score_names]),
+        _i32p(np.ascontiguousarray(names_sorted)),
+        _i32p(np.ascontiguousarray(sorted_filters)),
+        _i32p(np.ascontiguousarray(sorted_scores)),
+        _c_str_array(lut_flat or [b""]),
+        _i32p(lut_off_arr), _u8p(per_node_arr),
+        _i32p(kinds), _i64p(weights), int(topologyspread._BIG),
+    )
+    ctx = _NativeCtx(lib, cptr, n)
+    # per-pod plugin-ran / score-skip rows for the fused path (row slices
+    # hand C a contiguous [F]/[S] uint8 pointer without per-pod rebuilds)
+    fskip = cw.host.get("filter_skip", {})
+    sskip = cw.host.get("score_skip", {})
+    p = cw.n_pods
+    ctx.active_rows = np.ascontiguousarray(
+        ~np.stack([np.asarray(fskip[nm], bool) for nm in filter_names], axis=1)
+        if filter_names else np.zeros((p, 0), bool), np.uint8)
+    ctx.sskip_rows = np.ascontiguousarray(
+        np.stack([np.asarray(sskip[nm], bool) for nm in score_names], axis=1)
+        if score_names else np.zeros((p, 0), bool), np.uint8)
+    ctx.has_tsp_score = "PodTopologySpread" in score_names
     return ctx
+
+
+class _NativeCtx:
+    """Owns one C-side codec context; freed with the workload."""
+
+    __slots__ = ("lib", "ptr", "n", "active_rows", "sskip_rows",
+                 "has_tsp_score", "__weakref__")
+
+    def __init__(self, lib, ptr, n):
+        self.lib = lib
+        self.ptr = ptr
+        self.n = n
+        self.active_rows = None
+        self.sskip_rows = None
+        self.has_tsp_score = False
+
+    def __del__(self):
+        if self.ptr:
+            self.lib.codec_ctx_free(self.ptr)
+            self.ptr = None
 
 
 def _i32p(a):
@@ -149,32 +194,90 @@ def _u8p(a):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
-def encode_filter(ctx, codes: np.ndarray, active: np.ndarray) -> str:
-    lib = ctx["lib"]
+def encode_filter(ctx: _NativeCtx, codes: np.ndarray, active: np.ndarray) -> str:
     codes = np.ascontiguousarray(codes, dtype=np.int32)
     active = np.ascontiguousarray(active, dtype=np.uint8)
-    ptr = lib.encode_filter_result(
-        ctx["n"], codes.shape[0],
-        _i32p(codes), _u8p(active),
-        ctx["node_names"], ctx["filter_names"],
-        _i32p(ctx["sorted_nodes"]), _i32p(ctx["sorted_filters"]),
-        ctx["lut_flat"], _i32p(ctx["lut_off"]), _u8p(ctx["per_node"]),
-    )
-    return take_string(lib, ptr)
+    out_len = ctypes.c_int64()
+    ptr = ctx.lib.ctx_encode_filter(ctx.ptr, _i32p(codes), _u8p(active),
+                                    ctypes.byref(out_len))
+    return take_sized_string(ctx.lib, ptr, out_len.value)
 
 
-def encode_scores(ctx, values: np.ndarray, sskip: np.ndarray, feasible: np.ndarray) -> str:
-    lib = ctx["lib"]
+def encode_scores(ctx: _NativeCtx, values: np.ndarray, sskip: np.ndarray,
+                  feasible: np.ndarray) -> str:
     values = np.ascontiguousarray(values, dtype=np.int64)
     sskip = np.ascontiguousarray(sskip, dtype=np.uint8)
     feasible = np.ascontiguousarray(feasible, dtype=np.uint8)
-    ptr = lib.encode_score_result(
-        ctx["n"], values.shape[0],
-        _i64p(values), _u8p(sskip), _u8p(feasible),
-        ctx["node_names"], ctx["score_names"],
-        _i32p(ctx["sorted_nodes"]), _i32p(ctx["sorted_scores"]),
+    out_len = ctypes.c_int64()
+    ptr = ctx.lib.ctx_encode_scores(ctx.ptr, _i64p(values), _u8p(sskip),
+                                    _u8p(feasible), ctypes.byref(out_len))
+    return take_sized_string(ctx.lib, ptr, out_len.value)
+
+
+def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
+                     want_scores: bool) -> tuple[str, str | None, str | None]:
+    """(filter-result, score-result, finalscore-result) for pod i straight
+    from the compact replay layout — one C call; no [F,N] code unpack, no
+    int64 raw/final materialization, normalization computed in C
+    (hostnorm mirror, asserted byte-identical by tests/test_native_codec.py).
+
+    i indexes the compact chunks; hi indexes the workload's per-pod host
+    tables (they differ only on the extender's single-row replays, which
+    never take this path)."""
+    from ..framework.pipeline import PACK_MODES
+
+    cc = rr._compact
+    ci, r = divmod(i, cc.chunk)
+    packed = cc.packed[ci]
+    code_bits = PACK_MODES[cc.pack_mode][1]
+    prow = packed[r]
+
+    s = len(cc.score_cols)
+    col_ptrs = (ctypes.c_void_p * s)()
+    col_elem = (ctypes.c_int32 * s)()
+    cols_alive = []
+    if want_scores:
+        for q, (group, row) in enumerate(cc.score_cols):
+            arr = getattr(cc, group)[ci]
+            col = arr[r, row]
+            cols_alive.append(col)
+            col_ptrs[q] = col.ctypes.data
+            col_elem[q] = arr.dtype.itemsize
+
+    ignored_ptr = None
+    if want_scores and ctx.has_tsp_score and rr.cw.host.get("tsp_ignore") is not None:
+        cache = getattr(rr, "_fused_ignored", None)
+        if cache is None or cache[0] != ci:
+            # double-checked under the recon lock: at a chunk boundary the
+            # pool's workers would otherwise all miss at once and each
+            # recompute the O(C*N) mask
+            with rr._recon_lock:
+                cache = getattr(rr, "_fused_ignored", None)
+                if cache is None or cache[0] != ci:
+                    c = packed.shape[0]
+                    ig = np.ascontiguousarray(
+                        rr._tsp_ignored_chunk(ci, c, rr.cw.n_nodes), np.uint8)
+                    cache = (ci, ig)
+                    rr._fused_ignored = cache
+        ig_row = cache[1][r]
+        ignored_ptr = _u8p(ig_row)
+
+    out_blobs = (ctypes.c_void_p * 3)()
+    out_lens = (ctypes.c_int64 * 3)()
+    ctx.lib.ctx_decode_pod(
+        ctx.ptr,
+        prow.ctypes.data_as(ctypes.c_void_p), packed.dtype.itemsize, code_bits,
+        _u8p(ctx.active_rows[hi]), _u8p(ctx.sskip_rows[hi]),
+        col_ptrs, col_elem, ignored_ptr, 1 if want_scores else 0,
+        out_blobs, out_lens,
     )
-    return take_string(lib, ptr)
+    filter_json = take_sized_string(ctx.lib, out_blobs[0], out_lens[0])
+    score_json = final_json = None
+    if out_blobs[1]:
+        score_json = take_sized_string(ctx.lib, out_blobs[1], out_lens[1])
+    if out_blobs[2]:
+        final_json = take_sized_string(ctx.lib, out_blobs[2], out_lens[2])
+    return filter_json, score_json, final_json
 
 
 def encode_string_map(d: dict[str, str]) -> str | None:
