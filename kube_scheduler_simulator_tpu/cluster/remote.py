@@ -53,20 +53,31 @@ def _obj_rv(obj: dict) -> int:
 
 
 class RemoteCluster:
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 extra_resources: list[dict] | None = None):
+        """extra_resources mirrors ObjectStore's registry: the client of a
+        server configured with extraResources declares the same table so
+        paths/watch buckets exist for those kinds."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self._lock = threading.Lock()
-        self._watchers: dict[str, list[queue.Queue]] = {r: [] for r in RESOURCES}
+        self.resources: dict[str, tuple[str, bool]] = dict(RESOURCES)
+        for spec in extra_resources or []:
+            self.resources[spec["resource"]] = (
+                spec.get("kind") or spec["resource"].capitalize(),
+                bool(spec.get("namespaced", True)))
+        self._kind_to_resource = {
+            kind: res for res, (kind, _) in self.resources.items()}
+        self._watchers: dict[str, list[queue.Queue]] = {r: [] for r in self.resources}
         # recent events per resource, replayed to late-registered watchers
         # so a subscriber added after the stream's initial listing still
         # sees the full state (mirrors ObjectStore's event ring buffer)
-        self._events: dict[str, list[tuple[int, str, dict]]] = {r: [] for r in RESOURCES}
+        self._events: dict[str, list[tuple[int, str, dict]]] = {r: [] for r in self.resources}
         # highest rv seen per resource — resent as *LastResourceVersion on
         # reconnect so a dropped stream resumes instead of re-listing
         # (the reference RetryWatcher resumes the same way,
         # resourcewatcher.go:127-134)
-        self._last_rv: dict[str, int] = {r: 0 for r in RESOURCES}
+        self._last_rv: dict[str, int] = {r: 0 for r in self.resources}
         self._stream_thread: threading.Thread | None = None
         self._stream_resp = None
         self._stream_started = False
@@ -103,9 +114,8 @@ class RemoteCluster:
             err.status = e.code
             raise err from None
 
-    @staticmethod
-    def _obj_path(resource: str, name: str, namespace: str | None) -> str:
-        _, namespaced = RESOURCES[resource]
+    def _obj_path(self, resource: str, name: str, namespace: str | None) -> str:
+        _, namespaced = self.resources[resource]
         if namespaced:
             return f"/api/v1/{resource}/{namespace or 'default'}/{name}"
         return f"/api/v1/{resource}/{name}"
@@ -256,7 +266,7 @@ class RemoteCluster:
                 return
 
     def _dispatch(self, ev: dict) -> None:
-        resource = _KIND_TO_RESOURCE.get(ev.get("kind") or "")
+        resource = self._kind_to_resource.get(ev.get("kind") or "")
         event_type = _WATCH_EVENTS.get(ev.get("eventType") or "")
         obj = ev.get("obj")
         if resource is None or event_type is None or obj is None:

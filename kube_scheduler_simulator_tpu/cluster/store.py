@@ -97,13 +97,43 @@ def obj_key(obj: dict, namespaced: bool) -> str:
 
 
 class ObjectStore:
-    def __init__(self):
+    def __init__(self, extra_resources: list[dict] | None = None):
+        """extra_resources: declarative GVR registrations beyond the
+        built-in table — the RESTMapper analogue (the reference's
+        resourceapplier works on any GVK via dynamic client + RESTMapper,
+        resourceapplier.go:91-194,268-276).  Each entry:
+        {"resource": plural, "kind": Kind, "namespaced": bool,
+        "apiVersion": group/version} — from config extraResources or
+        register_resource()."""
         self._lock = threading.RLock()
+        self.resources: dict[str, tuple[str, bool]] = dict(RESOURCES)
+        self.api_versions: dict[str, str] = dict(API_VERSIONS)
         self._objects: dict[str, dict[str, dict]] = {r: {} for r in RESOURCES}
         self._rv = itertools.count(1)
         self._last_rv = 0
         self._events: dict[str, list[tuple[int, str, dict]]] = {r: [] for r in RESOURCES}
         self._watchers: dict[str, list[queue.Queue]] = {r: [] for r in RESOURCES}
+        for spec in extra_resources or []:
+            self.register_resource(
+                spec["resource"], spec.get("kind") or spec["resource"].capitalize(),
+                namespaced=bool(spec.get("namespaced", True)),
+                api_version=spec.get("apiVersion") or "v1",
+            )
+
+    def register_resource(self, resource: str, kind: str,
+                          namespaced: bool = True,
+                          api_version: str = "v1") -> None:
+        """Register an additional resource kind so CRUD/watch/dump/restore
+        (and every service built on them: applier, importer, syncer,
+        recorder, watcher, snapshot) carry it.  Idempotent."""
+        with self._lock:
+            if resource not in self.resources:
+                self._objects[resource] = {}
+                self._events[resource] = []
+                self._watchers[resource] = []
+            self.resources[resource] = (kind, namespaced)
+            if api_version and api_version != "v1":
+                self.api_versions[resource] = api_version
 
     # ----------------------------------------------------------- helpers
 
@@ -120,11 +150,10 @@ class ObjectStore:
         for q in self._watchers[resource]:
             q.put(ev)
 
-    @staticmethod
-    def _stamp_kind(resource: str, obj: dict):
-        kind, _ = RESOURCES[resource]
+    def _stamp_kind(self, resource: str, obj: dict):
+        kind, _ = self.resources[resource]
         obj.setdefault("kind", kind)
-        obj.setdefault("apiVersion", API_VERSIONS.get(resource, "v1"))
+        obj.setdefault("apiVersion", self.api_versions.get(resource, "v1"))
 
     # the apiserver's built-in PriorityClasses (scheduling.k8s.io)
     _BUILTIN_PRIORITY_CLASSES = {
@@ -164,9 +193,9 @@ class ObjectStore:
     def create(self, resource: str, obj: dict, owned: bool = False) -> dict:
         """owned=True transfers ownership of obj (no entry copy) — see
         update()."""
-        if resource not in RESOURCES:
+        if resource not in self.resources:
             raise NotFound(f"unknown resource {resource}")
-        _, namespaced = RESOURCES[resource]
+        _, namespaced = self.resources[resource]
         if not owned:
             obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
@@ -199,7 +228,9 @@ class ObjectStore:
         never mutated in place (updates REPLACE them), and consumers must
         not mutate what they receive (the informer-cache contract, same
         as list_shared)."""
-        _, namespaced = RESOURCES[resource]
+        if resource not in self.resources:
+            raise NotFound(f"unknown resource {resource}")
+        _, namespaced = self.resources[resource]
         if not owned:
             obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
@@ -242,7 +273,9 @@ class ObjectStore:
             return obj
 
     def delete(self, resource: str, name: str, namespace: str | None = None) -> None:
-        _, namespaced = RESOURCES[resource]
+        if resource not in self.resources:
+            raise NotFound(f"unknown resource {resource}")
+        _, namespaced = self.resources[resource]
         key = f"{namespace or 'default'}/{name}" if namespaced else name
         with self._lock:
             cur = self._objects[resource].pop(key, None)
@@ -256,7 +289,9 @@ class ObjectStore:
         """copy_object=False returns the STORED object (no deep copy) —
         the read-only fast path; the caller must not mutate it (writers
         build a new object copy-on-write and update(owned=True))."""
-        _, namespaced = RESOURCES[resource]
+        if resource not in self.resources:
+            raise NotFound(f"unknown resource {resource}")
+        _, namespaced = self.resources[resource]
         key = f"{namespace or 'default'}/{name}" if namespaced else name
         with self._lock:
             cur = self._objects[resource].get(key)
@@ -278,6 +313,8 @@ class ObjectStore:
         from ..state.selectors import object_matches_label_selector
 
         with self._lock:
+            if resource not in self.resources:
+                raise NotFound(f"unknown resource {resource}")
             items = []
             for key, obj in sorted(self._objects[resource].items()):
                 if namespace and (obj["metadata"].get("namespace") or "default") != namespace:
@@ -295,6 +332,8 @@ class ObjectStore:
         since_rv are replayed first.  Call unwatch() when done."""
         q: queue.Queue = queue.Queue()
         with self._lock:
+            if resource not in self.resources:
+                raise NotFound(f"unknown resource {resource}")
             for ev in self._events[resource]:
                 if ev[0] > since_rv:
                     q.put(ev)
@@ -320,11 +359,19 @@ class ObjectStore:
         """Delete-prefix + re-put (reference: reset/reset.go:57-78).  Watch
         subscribers receive DELETED/ADDED events for the transition."""
         with self._lock:
-            for resource in RESOURCES:
+            for resource in list(self.resources):
                 for key in list(self._objects[resource]):
                     cur = self._objects[resource].pop(key)
                     self._notify(resource, DELETED, cur, self._next_rv())
             for resource, objs in kvs.items():
+                if resource not in self.resources and objs:
+                    # a dump from a store with registered extras: infer
+                    # the registration from the objects themselves
+                    first = next(iter(objs.values()))
+                    self.register_resource(
+                        resource, first.get("kind") or resource.capitalize(),
+                        namespaced="/" in next(iter(objs)),
+                        api_version=first.get("apiVersion") or "v1")
                 for key, obj in objs.items():
                     obj = copy.deepcopy(obj)
                     self._objects[resource][key] = obj

@@ -44,6 +44,12 @@ class SimulatorConfiguration:
     # leave the in-process scheduling loop off so a standalone
     # cmd/scheduler process drives scheduling over the HTTP API
     external_scheduler_enabled: bool = False
+    # declarative RESTMapper analogue: additional resource kinds the store
+    # (and applier/importer/syncer/recorder/watcher/snapshot on top of it)
+    # carries — the reference applies any GVK via dynamic client +
+    # RESTMapper (resourceapplier.go:91-194,268-276).  Entries:
+    # {resource, kind, namespaced, apiVersion}
+    extra_resources: list = field(default_factory=list)
 
     def validate(self) -> None:
         if sum([self.external_import_enabled, self.resource_sync_enabled,
@@ -86,6 +92,7 @@ def load_config(path: str = "./config.yaml") -> SimulatorConfiguration:
         cfg.record_file_path = raw.get("recordFilePath") or ""
         cfg.kube_config = raw.get("kubeConfig") or ""
         cfg.external_scheduler_enabled = bool(raw.get("externalSchedulerEnabled", False))
+        cfg.extra_resources = raw.get("extraResources") or []
 
     env = os.environ
     if env.get("PORT"):
@@ -105,6 +112,10 @@ def load_config(path: str = "./config.yaml") -> SimulatorConfiguration:
         cfg.record_file_path = env["RECORD_FILE_PATH"]
     cfg.external_scheduler_enabled = _env_bool(
         "EXTERNAL_SCHEDULER_ENABLED", cfg.external_scheduler_enabled)
+    if env.get("EXTRA_RESOURCES"):
+        import json
+
+        cfg.extra_resources = json.loads(env["EXTRA_RESOURCES"])
 
     cfg.validate()
     return cfg

@@ -77,6 +77,9 @@ class SchedulerEngine:
         self._wait_threads: list = []
         self._waiter_lock = threading.Lock()
         self._waiter_results: list[tuple[str, str, str]] = []
+        # injectable for tests (forced-conflict soak asserts the backoff
+        # schedule without waiting out real 100ms x 3^n sleeps)
+        self._retry_sleep = time.sleep
 
     def set_plugin_config(self, cfg: PluginSetConfig) -> None:
         """Legacy single-profile API: one plugin set for every pod.
@@ -1134,18 +1137,24 @@ class SchedulerEngine:
     # ------------------------------------------------------------ writes
 
     def _update_pod(self, ns: str, name: str, mutate) -> None:
-        """Re-fetch + mutate + update with conflict retry (the engine-side
-        analogue of the reflector's conflict-retry write).
+        """Re-fetch + mutate + update under the shared exponential-backoff
+        retry (100ms x3^n, 6 steps — utils/retry.py, the reference's
+        util.RetryWithExponentialBackOff schedule that the reflector's
+        write path already uses).  Exhaustion raises RetryTimeout: a bind
+        or status write that cannot land after 6 conflict rounds is a real
+        failure and must surface, not silently drop (round-3 verdict #9).
 
         Copy-on-write: the callback receives a pod whose top level and
         metadata/spec/status dicts are fresh; anything deeper is SHARED
         with the stored object and must be replaced, not mutated in place
         (all current callbacks rebuild the lists they change)."""
-        for _ in range(5):
+        from ..utils.retry import retry_with_exponential_backoff
+
+        def attempt() -> tuple[bool, Exception | None]:
             try:
                 cur = self.store.get("pods", name, ns, copy_object=False)
             except NotFound:
-                return
+                return True, None
             pod = dict(cur)
             pod["metadata"] = dict(cur.get("metadata") or {})
             pod["spec"] = dict(cur.get("spec") or {})
@@ -1153,9 +1162,11 @@ class SchedulerEngine:
             mutate(pod)
             try:
                 self.store.update("pods", pod, owned=True)
-                return
+                return True, None
             except Conflict:
-                time.sleep(0.001)
+                return False, None  # re-fetch and retry under backoff
+
+        retry_with_exponential_backoff(attempt, sleep=self._retry_sleep)
 
     def _bind(self, ns: str, name: str, node_name: str) -> None:
         def mutate(pod: dict) -> None:

@@ -182,8 +182,39 @@ def marshal_wire(hostmap: dict) -> str:
     return s.replace("<", "\\u003c").replace(">", "\\u003e").replace("&", "\\u0026")
 
 
+def _tls_material(data_b64, path: str | None) -> bytes | None:
+    """One TLSClientConfig field to PEM bytes: inline data wins over the
+    file path (client-go transport.Config loads *File into *Data only when
+    the data form is empty).  Data accepts base64 (the Go []byte JSON wire
+    form) or raw PEM text/bytes."""
+    if data_b64:
+        if isinstance(data_b64, bytes):
+            raw = data_b64
+        else:
+            s = data_b64.strip()
+            if s.startswith("-----BEGIN"):
+                raw = s.encode()
+            else:
+                import base64
+
+                raw = base64.b64decode(s)
+        return raw
+    if path:
+        with open(path, "rb") as f:
+            return f.read()
+    return None
+
+
 class ExtenderClient:
-    """HTTP client for one configured extender."""
+    """HTTP(S) client for one configured extender.
+
+    TLS mirrors the reference's makeTransport
+    (reference: simulator/scheduler/extender/extender.go:54-84 over
+    client-go rest.TLSConfigFor): tlsConfig carries
+    insecure/serverName/certFile/keyFile/caFile/certData/keyData/caData
+    (data forms base64 per Go []byte marshalling, file forms read at
+    client build); enableHTTPS with no CA configured implies insecure;
+    insecure together with a CA is rejected, as client-go rejects it."""
 
     def __init__(self, config: dict):
         self.config = config
@@ -206,6 +237,69 @@ class ExtenderClient:
             r["name"] for r in (config.get("managedResources") or [])
             if r.get("name")
         }
+        self._opener = self._build_opener(
+            config.get("tlsConfig") or {}, bool(config.get("enableHTTPS")))
+
+    def _build_opener(self, tc: dict, enable_https: bool):
+        """urllib opener with the extender's TLS client settings, or None
+        for plain-http extenders (urlopen default)."""
+        import urllib.request as _rq
+
+        https = enable_https or self.url_prefix.startswith("https://")
+        if not tc and not https:
+            return None
+        import http.client
+        import ssl
+        import tempfile
+
+        insecure = bool(tc.get("insecure"))
+        server_name = tc.get("serverName") or None
+        ca = _tls_material(tc.get("caData"), tc.get("caFile"))
+        cert = _tls_material(tc.get("certData"), tc.get("certFile"))
+        key = _tls_material(tc.get("keyData"), tc.get("keyFile"))
+        if insecure and ca is not None:
+            # client-go transport.Config validation: a CA with the
+            # insecure flag is contradictory
+            raise ValueError(
+                "extender tlsConfig: specifying a root CA with insecure is not allowed")
+        if enable_https and ca is None:
+            insecure = True  # reference extender.go:66-72
+        ctx = ssl.create_default_context()
+        if ca is not None:
+            ctx.load_verify_locations(cadata=ca.decode())
+        if insecure:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if cert is not None and key is not None:
+            # ssl's cert-chain loader is file-path only; inline data goes
+            # through ephemeral files deleted as soon as they are loaded
+            with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                    tempfile.NamedTemporaryFile(suffix=".pem") as kf:
+                cf.write(cert)
+                cf.flush()
+                kf.write(key)
+                kf.flush()
+                ctx.load_cert_chain(cf.name, kf.name)
+
+        class _SNIConnection(http.client.HTTPSConnection):
+            """Verify/SNI against tlsConfig.serverName instead of the URL
+            host (Go tls.Config.ServerName semantics)."""
+
+            def connect(self_c):
+                import socket
+
+                sock = socket.create_connection(
+                    (self_c.host, self_c.port), self_c.timeout)
+                self_c.sock = ctx.wrap_socket(
+                    sock, server_hostname=server_name or self_c.host)
+
+        class _Handler(_rq.HTTPSHandler):
+            def https_open(self_h, req):
+                return self_h.do_open(
+                    lambda host, timeout=None, **kw: _SNIConnection(
+                        host, timeout=timeout), req)
+
+        return _rq.build_opener(_Handler())
 
     @property
     def host(self) -> str:
@@ -233,7 +327,8 @@ class ExtenderClient:
             url, data=json.dumps(args).encode(), method="POST",
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+        opener = self._opener.open if self._opener else urllib.request.urlopen
+        with opener(req, timeout=self.timeout) as resp:
             return json.loads(resp.read() or b"{}")
 
     def filter(self, args: dict) -> dict:

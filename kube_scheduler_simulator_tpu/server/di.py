@@ -90,7 +90,18 @@ class DIContainer:
                  source_store: ObjectStore | None = None,
                  start_scheduler: bool = True):
         self.cfg = cfg or SimulatorConfiguration()
-        self.store = ObjectStore()
+        self.store = ObjectStore(
+            extra_resources=getattr(self.cfg, "extra_resources", None))
+        # extra GVRs ride the same watch/record/sync surface as the
+        # built-in seven (DEFAULT_GVRS + config extraResources)
+        from ..cluster.store import DEFAULT_GVRS
+
+        extra_gvrs = [
+            spec["resource"]
+            for spec in getattr(self.cfg, "extra_resources", None) or []
+            if spec.get("resource") not in DEFAULT_GVRS
+        ]
+        self._gvrs = list(DEFAULT_GVRS) + extra_gvrs
         self.applier = ResourceApplier(self.store)
         self.reflector = StoreReflector(self.store)
         self.engine = SchedulerEngine(self.store, reflector=self.reflector)
@@ -99,7 +110,8 @@ class DIContainer:
         self.snapshot_service = SnapshotService(self.store, self.scheduler_service)
         self.scenario_service = ScenarioService(self.store, self.engine)
         self.reset_service = ResetService(self.store, self.scheduler_service)
-        self.watcher_service = ResourceWatcherService(self.store)
+        self.watcher_service = ResourceWatcherService(self.store,
+                                                      resources=self._gvrs)
 
         self.importer = None
         self.syncer = None
@@ -108,11 +120,13 @@ class DIContainer:
         if self.cfg.external_import_enabled:
             if source_store is None:
                 raise ValueError("externalImportEnabled requires a source cluster")
-            self.importer = OneShotImporter(source_store, self.applier)
+            self.importer = OneShotImporter(source_store, self.applier,
+                                            resources=self._gvrs)
         if self.cfg.resource_sync_enabled:
             if source_store is None:
                 raise ValueError("resourceSyncEnabled requires a source cluster")
-            self.syncer = SyncerService(source_store, self.applier)
+            self.syncer = SyncerService(source_store, self.applier,
+                                        resources=self._gvrs)
         if self.cfg.replayer_enabled:
             self.replayer = ReplayerService(self.applier, self.cfg.record_file_path)
 
@@ -121,7 +135,8 @@ class DIContainer:
             self.scheduling_loop.start()
 
     def new_recorder(self, path: str, flush_interval: float = 5.0) -> RecorderService:
-        self.recorder = RecorderService(self.store, path, flush_interval)
+        self.recorder = RecorderService(self.store, path, flush_interval,
+                                        resources=self._gvrs)
         return self.recorder
 
     def shutdown(self):

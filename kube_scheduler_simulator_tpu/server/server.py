@@ -31,7 +31,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from ..cluster.store import ApiError, RESOURCES
+from ..cluster.store import ApiError
 from ..services.resourcewatcher import StreamWriter, WATCH_PARAMS
 from ..services.snapshot import SnapshotOptions
 from .di import DIContainer
@@ -163,8 +163,8 @@ def _make_handler(di: DIContainer):
                 elif path == "/api/v1/scenarios" or path.startswith("/api/v1/scenarios/"):
                     return self._scenarios(method, path)
                 else:
-                    m = re.fullmatch(r"/api/v1/([a-z]+)(?:/([^/]+))?(?:/([^/]+))?", path)
-                    if m and m.group(1) in RESOURCES:
+                    m = re.fullmatch(r"/api/v1/([a-z0-9-]+)(?:/([^/]+))?(?:/([^/]+))?", path)
+                    if m and m.group(1) in di.store.resources:
                         return self._resource_crud(method, m, url)
                 self._json(404, {"message": f"route not found: {method} {path}"})
             except ApiError as e:
@@ -311,7 +311,7 @@ def _make_handler(di: DIContainer):
 
         def _resource_crud(self, method: str, m, url):
             resource = m.group(1)
-            _, namespaced = RESOURCES[resource]
+            _, namespaced = di.store.resources[resource]
             g2, g3 = m.group(2), m.group(3)
             if method == "GET" and g2 is None:
                 params = parse_qs(url.query)

@@ -14,7 +14,7 @@ of compose.local.yml:19-33) or a JSON/file-backed source.
 
 from __future__ import annotations
 
-from ..cluster.store import AlreadyExists
+from ..cluster.store import AlreadyExists, NotFound
 from .resourceapplier import ResourceApplier
 
 IMPORT_ORDER = [
@@ -38,7 +38,14 @@ class OneShotImporter:
     def import_cluster_resources(self, label_selector: dict | None = None) -> int:
         n = 0
         for resource in self.resources:
-            items, _ = self.source.list(resource, label_selector=label_selector)
+            try:
+                items, _ = self.source.list(resource, label_selector=label_selector)
+            except NotFound:
+                # the source cluster doesn't serve this GVR (e.g. a CRD
+                # registered in the simulator but not installed at the
+                # source) — the reference's dynamic lister would likewise
+                # come back empty; skip, don't abort the import
+                continue
             for obj in items:
                 try:
                     if self.applier.create(resource, obj) is not None:

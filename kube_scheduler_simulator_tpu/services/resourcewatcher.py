@@ -66,9 +66,10 @@ class ResourceWatcherService:
         kind, handler/watcher.go:23-45); 0/absent means full initial list.
         """
         lrv = last_resource_versions or {}
+        registry = getattr(self.store, "resources", RESOURCES)
         queues = {}
         for resource in self.resources:
-            kind, _ = RESOURCES[resource]
+            kind, _ = registry[resource]
             since = int(lrv.get(resource, 0))
             if since == 0:
                 # initial listing, then watch from the listing's rv — NOT
@@ -93,7 +94,7 @@ class ResourceWatcherService:
         dead = threading.Event()
 
         def pump(resource, q):
-            kind, _ = RESOURCES[resource]
+            kind, _ = registry[resource]
             while not (stop.is_set() or dead.is_set()):
                 ev = q.get()
                 if ev is None:

