@@ -377,6 +377,30 @@ class SchedulerEngine:
 
         from ..store.decode import decode_chunk_into
 
+        if (self.mesh is not None and self.mesh.shape.get("dp", 1) > 1
+                and self.extender_service is None
+                and not self._custom_lifecycle_plugins()):
+            from ..parallel.speculative import replay_speculative, speculation_ok
+
+            if speculation_ok(self.plugin_config):
+                # dp-axis speculative batches: evaluate a pod batch against
+                # frozen state across the mesh's dp shards, commit the
+                # provably-non-interfering prefix — bit-identical to the
+                # scan (parallel/speculative.py; tests/test_speculative.py)
+                with TRACER.span("speculative_replay", pods=len(pending),
+                                 nodes=len(nodes)):
+                    rr, spec_stats = replay_speculative(cw, self.mesh)
+                    TRACER.count("speculative_rounds_total",
+                                 spec_stats["rounds"])
+                # rr's arrays are final host numpy here: decode through
+                # the pooled chunk decoder like the scan path, not one
+                # pod at a time on the commit thread
+                all_annotations = [None] * len(pending)
+                with TRACER.span("decode_stream", pods=len(pending)):
+                    decode_chunk_into(rr, 0, len(pending), all_annotations)
+                return self._finish_wave(cw, rr, all_annotations, pending,
+                                         exclude)
+
         if self._custom_lifecycle_plugins():
             # a custom Reserve/Permit/PreBind can reject mid-wave and abort
             # the rest — decode per pod so an aborted wave wastes nothing
@@ -394,6 +418,14 @@ class SchedulerEngine:
                             mesh=self.mesh,
                             on_chunk=lambda rr_, lo, hi: decode_chunk_into(
                                 rr_, lo, hi, all_annotations))
+        return self._finish_wave(cw, rr, all_annotations, pending, exclude)
+
+    def _finish_wave(self, cw, rr, all_annotations, pending,
+                     exclude: set[tuple[str, str]] | None
+                     ) -> tuple[int, str | None]:
+        """Commit + reflect phase of a wave, shared by the scan and
+        speculative replay paths: result-store puts, extender hooks,
+        custom lifecycle, binds, postfilter/preemption, write-backs."""
         postfilter_on = bool(self.plugin_config.postfilters())
         n_bound = 0
         retry: str | None = None

@@ -1,0 +1,169 @@
+"""Speculative pod-batch scheduling over the "dp" mesh axis.
+
+The scan replay is sequential-exact: each pod's evaluation sees every
+earlier bind.  This module adds the dp-axis execution mode the mesh
+design reserves for it (parallel/mesh.py axes doc): evaluate a BATCH of
+pending pods against one frozen carry — vmap over the batch, batch axis
+sharded over "dp", node axis over "nodes" — then commit the longest
+prefix of the batch that is provably unaffected by the binds accepted
+before it, and repeat.  Wall-clock drops because the per-pod [N] vector
+work becomes [B, N] tensor work (MXU-friendly) fanned across dp shards,
+while results stay BIT-IDENTICAL to the sequential scan.
+
+Exactness argument (why the accepted prefix is sequential-parity):
+speculation is restricted to plugin sets in SAFE_SPECULATIVE — per-node
+plugins whose filter/score for a pod depend only on (static node data,
+that node's accumulated resources).  Pod k in the batch is accepted only
+if every node bound by earlier-accepted pods was INFEASIBLE for k under
+the frozen state.  Sequentially, those nodes carry strictly more
+allocation, and NodeResourcesFit infeasibility is monotone in allocation
+(the only dynamic filter in the safe set), so they stay infeasible; all
+other nodes are untouched, so k's feasible set, raw scores on it, the
+feasible-set-wide normalization, and the argmax tie-break are identical
+to the sequential run.  The first pod of every round is unconditionally
+safe, so each round commits >= 1 pod and the loop terminates.
+
+Plugin sets outside the safe class (PodTopologySpread, InterPodAffinity,
+NodePorts, the volume family — anything whose bind mutates cross-node
+state) automatically fall back to the scan path; parity is asserted by
+tests/test_speculative.py against the sequential oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.replay import ReplayResult
+from ..state.compile import CompiledWorkload
+from .mesh import speculative_scores
+
+# per-node plugins with no cross-pod coupling: filters are static or
+# monotone in node allocation, scores depend only on the node's own
+# accumulated resources, binds touch only carry["core"]
+SAFE_SPECULATIVE = {
+    "NodeResourcesFit", "NodeResourcesBalancedAllocation", "NodeAffinity",
+    "TaintToleration", "NodeUnschedulable", "NodeName", "ImageLocality",
+}
+
+
+def speculation_ok(cfg) -> bool:
+    """True when the ACTIVE plugin set (enabled list plus every per-point
+    override — point_enabled can add a plugin cfg.enabled never lists)
+    admits exact speculative batching."""
+    return not cfg.custom and set(cfg.active_plugins()) <= SAFE_SPECULATIVE
+
+
+def _accept_prefix(feasible: np.ndarray, selected: np.ndarray) -> int:
+    """Longest non-interfering prefix: pod k is accepted iff every node
+    bound by earlier-accepted pods is infeasible for k (see module doc).
+    feasible: [B, N] bool (speculative), selected: [B] int32."""
+    b = selected.shape[0]
+    dirty: list[int] = []
+    for k in range(b):
+        if dirty and feasible[k, dirty].any():
+            return k
+        s = int(selected[k])
+        if s >= 0:
+            dirty.append(s)
+    return b
+
+
+def _batch_commit_fn(cw: CompiledWorkload):
+    """jitted (carry, core_xs_batch, selected, accept) -> carry with every
+    accepted bind applied in one scatter-add.  Safe-set workloads only
+    mutate carry["core"] on bind (pipeline._bind_phase), and accepted
+    pods bind distinct nodes, so one batched scatter == the sequential
+    fold of core_bind_update."""
+
+    def commit(carry, core_batch, selected, accept):
+        core = carry["core"]
+        bound = accept & (selected >= 0)
+        idx = jnp.maximum(selected, 0)
+        add = jnp.where(bound, 1, 0)
+        requested = core.requested.at[idx].add(
+            core_batch.requests * add[:, None].astype(core.requested.dtype))
+        nonzero = core.nonzero.at[idx].add(
+            core_batch.nonzero * add[:, None].astype(core.nonzero.dtype))
+        num_pods = core.num_pods.at[idx].add(add.astype(core.num_pods.dtype))
+        out = dict(carry)
+        out["core"] = core._replace(
+            requested=requested, nonzero=nonzero, num_pods=num_pods)
+        return out
+
+    return jax.jit(commit, donate_argnums=(0,))
+
+
+def replay_speculative(cw: CompiledWorkload, mesh, batch: int | None = None,
+                       ) -> tuple[ReplayResult, dict]:
+    """Schedule the whole queue in speculative batches (see module doc).
+
+    Returns (rr, stats): rr is a full-array ReplayResult bit-identical to
+    replay(cw) / the sequential oracle; stats records round count and
+    acceptance sizes (the speculation efficiency).
+    Caller must have checked speculation_ok(cw.config).
+    """
+    p = cw.n_pods
+    dp = mesh.shape.get("dp", 1) if mesh is not None else 1
+    if batch is None:
+        batch = max(dp, 1) * 8
+    spec = speculative_scores(cw, mesh)  # (carry, xs_batch) -> StepOut[B]
+
+    # copy: commit() donates its carry argument, and cw.init_carry must
+    # survive for later replays of the same workload (same guard as
+    # framework/replay.py's scan entry)
+    carry = jax.tree.map(jnp.array, cw.init_carry)
+    commit = _batch_commit_fn(cw)
+
+    f = len(cw.config.filters())
+    s = len(cw.config.scorers())
+    n = cw.n_nodes
+    filter_codes = np.zeros((p, f, n), np.int32)
+    score_raw = np.zeros((p, s, n), np.int64)
+    score_final = np.zeros((p, s, n), np.int64)
+    selected = np.full(p, -1, np.int32)
+    feasible_count = np.zeros(p, np.int32)
+    prefilter_reject = np.zeros(p, np.int32)
+    rounds: list[int] = []
+
+    from ..framework.replay import _slice_xs
+
+    def slice_xs(lo: int, hi: int):
+        xs = _slice_xs(cw.xs, lo, hi, batch)  # the scan path's slicer
+        xs["is_pad"] = jnp.arange(batch) >= (hi - lo)
+        return xs
+
+    lo = 0
+    while lo < p:
+        hi = min(lo + batch, p)
+        xs = slice_xs(lo, hi)
+        outs = spec(carry, xs)
+        codes = np.asarray(outs.filter_codes[: hi - lo])   # [m, F, N]
+        sel = np.asarray(outs.selected[: hi - lo])
+        rej = np.asarray(outs.prefilter_reject[: hi - lo])
+        feas = (codes == 0).all(axis=1) & (rej == 0)[:, None]
+        k = _accept_prefix(feas, sel)
+        rounds.append(k)
+        a = lo + k
+        filter_codes[lo:a] = codes[:k]
+        score_raw[lo:a] = np.asarray(outs.score_raw[:k])
+        score_final[lo:a] = np.asarray(outs.score_final[:k])
+        selected[lo:a] = sel[:k]
+        feasible_count[lo:a] = np.asarray(outs.feasible_count[:k])
+        prefilter_reject[lo:a] = rej[:k]
+        accept = jnp.arange(batch) < k
+        carry = commit(carry, xs["core"], outs.selected, accept)
+        lo = a
+
+    rr = ReplayResult(
+        cw=cw, filter_codes=filter_codes, score_raw=score_raw,
+        score_final=score_final, selected=selected,
+        feasible_count=feasible_count, prefilter_reject=prefilter_reject,
+    )
+    stats = {"rounds": len(rounds), "batch": batch,
+             "mean_accept": round(float(np.mean(rounds)), 2) if rounds else 0,
+             "accepted_first_try": int(sum(r == batch for r in rounds))}
+    return rr, stats

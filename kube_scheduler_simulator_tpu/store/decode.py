@@ -287,17 +287,18 @@ def decode_chunk_into(rr, lo: int, hi: int, out: list) -> None:
     while the device executes later chunks.  Idempotent per index (a
     width-tier rerun re-delivers chunks)."""
     cc = getattr(rr, "_compact", None)
-    if cc is None or hi - lo < 64 or (os.cpu_count() or 1) < 2:
+    if hi - lo < 64 or (os.cpu_count() or 1) < 2:
         # single-core hosts: the pool's dispatch + recon-lock traffic
         # costs more than the GIL-released C calls can win back
         for i in range(lo, hi):
             out[i] = decode_pod_result(rr, i)
         return
-    if _native_ctx(rr.cw) is None:
+    if cc is not None and _native_ctx(rr.cw) is None:
         # pure-Python path reads codes_of/raw_of/final_of: reconstruct the
         # chunk once here so pool workers share it.  The fused native path
         # reads the compact arrays directly — warming recon for it would
         # re-create exactly the [C,F,N]/[C,S,N] materialization it avoids.
+        # (full-array results — the speculative path — need no recon)
         rr._chunk_recon(lo // cc.chunk, scores=True)
     for i, a in zip(range(lo, hi),
                     _decode_pool().map(lambda i: decode_pod_result(rr, i),

@@ -1,0 +1,141 @@
+"""Speculative dp-batch scheduling: bit-parity with the sequential scan
+and the CPU oracle (parallel/speculative.py exactness argument)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+from kube_scheduler_simulator_tpu.parallel.mesh import make_mesh
+from kube_scheduler_simulator_tpu.parallel.speculative import (
+    SAFE_SPECULATIVE, replay_speculative, speculation_ok)
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
+
+SAFE_CFG = ["NodeResourcesFit", "NodeResourcesBalancedAllocation",
+            "NodeAffinity", "TaintToleration"]
+
+
+def _workload(n_nodes=24, n_pods=60, seed=9):
+    # tight capacity so pods contend for the same nodes — the acceptance
+    # walk must actually cut batches, not rubber-stamp them
+    nodes = make_nodes(n_nodes, seed=seed, taint_fraction=0.2)
+    pods = make_pods(n_pods, seed=seed + 1, with_affinity=True,
+                     with_tolerations=True)
+    return nodes, pods
+
+
+def test_speculation_ok_classifier():
+    assert speculation_ok(PluginSetConfig(enabled=SAFE_CFG))
+    assert not speculation_ok(PluginSetConfig(
+        enabled=SAFE_CFG + ["PodTopologySpread"]))
+    assert not speculation_ok(PluginSetConfig(
+        enabled=SAFE_CFG + ["InterPodAffinity"]))
+    assert not speculation_ok(PluginSetConfig(enabled=["NodePorts"]))
+
+
+@pytest.mark.parametrize("dp,batch", [(1, 4), (2, 8), (4, 16)])
+def test_speculative_matches_scan(dp, batch):
+    nodes, pods = _workload()
+    cfg = PluginSetConfig(enabled=SAFE_CFG)
+    cw = compile_workload(nodes, pods, cfg)
+    base = replay(cw, chunk=16)
+
+    cw2 = compile_workload(nodes, pods, cfg)
+    mesh = make_mesh(dp * 2, dp=dp) if dp > 1 else None
+    rr, stats = replay_speculative(cw2, mesh, batch=batch)
+
+    np.testing.assert_array_equal(rr.selected, base.selected)
+    np.testing.assert_array_equal(rr.feasible_count, base.feasible_count)
+    assert stats["rounds"] >= (len(pods) + batch - 1) // batch
+    # full annotation byte-parity, not just selections
+    for i in range(len(pods)):
+        a = decode_pod_result(rr, i)
+        b = decode_pod_result(base, i)
+        assert a == b, f"pod {i}"
+
+
+def test_speculative_under_contention_still_exact():
+    """2 nodes, many pods: almost every batch is cut at the first
+    interference; parity must survive the worst acceptance pattern."""
+    nodes = make_nodes(2, seed=3)
+    pods = make_pods(30, seed=4)
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit",
+                                   "NodeResourcesBalancedAllocation"])
+    base = replay(compile_workload(nodes, pods, cfg), chunk=8)
+    rr, stats = replay_speculative(compile_workload(nodes, pods, cfg),
+                                   None, batch=8)
+    np.testing.assert_array_equal(rr.selected, base.selected)
+    assert stats["mean_accept"] < 8  # contention actually cut batches
+
+
+def test_speculative_oracle_parity():
+    from kube_scheduler_simulator_tpu.reference_impl.sequential import (
+        SequentialScheduler)
+
+    nodes, pods = _workload(n_nodes=12, n_pods=24, seed=21)
+    cfg = PluginSetConfig(enabled=SAFE_CFG)
+    oracle = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr, _ = replay_speculative(compile_workload(nodes, pods, cfg),
+                               None, batch=6)
+    for i, (sa, _sel) in enumerate(oracle):
+        da = decode_pod_result(rr, i)
+        for key, v in sa.items():
+            assert da[key] == v, f"pod {i} {key}"
+
+
+def test_engine_uses_speculative_path_with_dp_mesh():
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.utils.tracing import TRACER
+
+    nodes, pods = _workload(n_nodes=16, n_pods=24, seed=31)
+    mesh = make_mesh(4, dp=2)
+
+    def run(mesh_arg):
+        store = ObjectStore()
+        for n in nodes:
+            store.create("nodes", n)
+        for p in pods:
+            store.create("pods", p)
+        eng = SchedulerEngine(store, plugin_config=PluginSetConfig(
+            enabled=SAFE_CFG), mesh=mesh_arg, chunk=16)
+        eng.schedule_pending()
+        out, _ = store.list("pods")
+        return {(p["metadata"]["name"]): (
+            p["spec"].get("nodeName"),
+            (p["metadata"].get("annotations") or {}).get(
+                "kube-scheduler-simulator.sigs.k8s.io/finalscore-result"))
+            for p in out}
+
+    TRACER.reset()
+    spec_out = run(mesh)
+    spans = TRACER.summary()["spans"]
+    assert "speculative_replay" in spans, sorted(spans)
+    base_out = run(None)
+    assert spec_out == base_out
+
+
+def test_point_enabled_unsafe_plugin_blocks_speculation():
+    """point_enabled can add a plugin cfg.enabled never lists; the gate
+    must look at the ACTIVE set (review finding: a score-point
+    PodTopologySpread silently corrupted speculative state)."""
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"],
+                          point_enabled={"score": ["PodTopologySpread"]})
+    assert not speculation_ok(cfg)
+
+
+def test_init_carry_survives_speculative_replay():
+    """commit() donates its carry; the workload's init_carry must be
+    copied first so the SAME cw can replay again (review finding)."""
+    nodes, pods = _workload(n_nodes=8, n_pods=10, seed=41)
+    cfg = PluginSetConfig(enabled=SAFE_CFG)
+    cw = compile_workload(nodes, pods, cfg)
+    rr1, _ = replay_speculative(cw, None, batch=4)
+    rr2, _ = replay_speculative(cw, None, batch=4)  # reuses cw.init_carry
+    np.testing.assert_array_equal(rr1.selected, rr2.selected)
+    base = replay(cw, chunk=4)  # the scan also reuses it
+    np.testing.assert_array_equal(rr1.selected, base.selected)
