@@ -79,7 +79,8 @@ def _device_initializes(timeout: float = 240) -> bool:
 
 
 def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
-                   decode_sample: int = 512, decode_stream: bool = True):
+                   decode_sample: int = 512, decode_stream: bool = True,
+                   node_scale: float | None = None, quick: bool = False):
     """Compile + warm + timed device-only + timed end-to-end + timed
     ANNOTATIONS-MATERIALIZED end-to-end (decode of every pod's result
     annotations streamed on_chunk, overlapping device compute — the
@@ -93,7 +94,8 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
     from kube_scheduler_simulator_tpu.store.decode import (
         decode_all_parallel, decode_chunk_into)
 
-    nodes, pods, cfg = baseline_config(idx, scale=scale, seed=seed)
+    nodes, pods, cfg = baseline_config(idx, scale=scale, seed=seed,
+                                       node_scale=node_scale)
     log(f"config {idx}: {len(pods)} pods x {len(nodes)} nodes, plugins={cfg.enabled}")
     t0 = time.time()
     cw = compile_workload(nodes, pods, cfg)
@@ -114,23 +116,25 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
     rr = replay(cw, chunk=chunk, collect=False, mesh=mesh)  # XLA compile + run
     log(f"  warm-up replay: {time.time()-t0:.1f}s, scheduled {rr.scheduled}/{len(pods)}")
 
-    t0 = time.time()
-    rr = replay(cw, chunk=chunk, collect=False, mesh=mesh)
-    dev_s = time.time() - t0
-    dev_cps = len(pods) / dev_s
-    log(f"  device-only replay: {dev_s:.2f}s -> {dev_cps:,.0f} cycles/s")
-
-    # best of 2: the tunneled link's bandwidth swings ~4x between runs;
-    # the better run reflects transfer capability, not link luck
-    e2e_s = None
-    for attempt in range(2):
+    dev_cps = e2e_cps = None
+    if not quick:  # quick: only the streamed-decode figure is wanted
         t0 = time.time()
-        rr = replay(cw, chunk=chunk, collect=True, mesh=mesh)
-        dt = time.time() - t0
-        log(f"  incl host transfer of result tensors (run {attempt + 1}): "
-            f"{dt:.2f}s -> {len(pods)/dt:,.0f} cycles/s")
-        e2e_s = dt if e2e_s is None else min(e2e_s, dt)
-    e2e_cps = len(pods) / e2e_s
+        rr = replay(cw, chunk=chunk, collect=False, mesh=mesh)
+        dev_s = time.time() - t0
+        dev_cps = len(pods) / dev_s
+        log(f"  device-only replay: {dev_s:.2f}s -> {dev_cps:,.0f} cycles/s")
+
+        # best of 2: the tunneled link's bandwidth swings ~4x between runs;
+        # the better run reflects transfer capability, not link luck
+        e2e_s = None
+        for attempt in range(2):
+            t0 = time.time()
+            rr = replay(cw, chunk=chunk, collect=True, mesh=mesh)
+            dt = time.time() - t0
+            log(f"  incl host transfer of result tensors (run {attempt + 1}): "
+                f"{dt:.2f}s -> {len(pods)/dt:,.0f} cycles/s")
+            e2e_s = dt if e2e_s is None else min(e2e_s, dt)
+        e2e_cps = len(pods) / e2e_s
 
     dec_cps = None
     if decode_sample:
@@ -160,8 +164,8 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
         del anns_all
     return {
         "pods": len(pods), "nodes": len(nodes),
-        "device_only_cps": round(dev_cps, 1),
-        "incl_host_transfer_cps": round(e2e_cps, 1),
+        "device_only_cps": round(dev_cps, 1) if dev_cps else None,
+        "incl_host_transfer_cps": round(e2e_cps, 1) if e2e_cps else None,
         "decode_inclusive_cps": round(di_cps, 1) if di_cps else None,
         "decode_pods_per_sec": round(dec_cps, 1) if dec_cps else None,
         "scheduled": rr.scheduled,
@@ -451,6 +455,44 @@ def _run(args):
     if not args.skip_config5 and args.config != 5:
         extra["config5"] = measure_replay(5, args.scale, args.seed, args.chunk,
                                           args.mesh, decode_sample=0)
+
+    if args.scale >= 1.0 and not args.assume_fallback:
+        # under-cliff control: this bench host's first-touch page backing
+        # collapses ~10x beyond ~8 GB resident (committed curve:
+        # docs/bench/r04-host-page-backing.json), which bounds the
+        # FULL-shape annotations-materialized figure at ~220 pods/s no
+        # matter how fast the decoder is.  A 0.4x queue at the full node
+        # shape holds ~5 GB and shows the code's sustained rate without
+        # the host artifact.  Runs in a FRESH SUBPROCESS (on the CPU
+        # backend) so the parent's already-touched memory cannot distort
+        # the control in either direction.
+        log("under-cliff control (0.4x queue, full node shape, subprocess):")
+        import os as _os
+        import subprocess as _sp
+
+        code = (
+            "import json, sys; sys.path.insert(0, '.');\n"
+            "from kube_scheduler_simulator_tpu.utils.platform import force_cpu\n"
+            "force_cpu()\n"
+            "import bench\n"
+            f"uc = bench.measure_replay({args.config}, 0.4, {args.seed}, "
+            f"{args.chunk}, 0, decode_sample=0, node_scale=1.0, quick=True)\n"
+            "print('UC ' + json.dumps(uc))\n"
+        )
+        try:
+            r = _sp.run([sys.executable, "-c", code], timeout=900,
+                        capture_output=True, text=True,
+                        env={**_os.environ, "JAX_PLATFORMS": "cpu"},
+                        cwd=str(Path(__file__).parent))
+            uc = next(json.loads(ln[3:]) for ln in r.stdout.splitlines()
+                      if ln.startswith("UC "))
+            extra["decode_inclusive_cps_undercliff"] = uc["decode_inclusive_cps"]
+            extra["undercliff_shape"] = {"pods": uc["pods"], "nodes": uc["nodes"]}
+            log(f"  under-cliff: {uc['decode_inclusive_cps']} cycles/s "
+                f"({uc['pods']} pods x {uc['nodes']} nodes)")
+        except (StopIteration, _sp.TimeoutExpired) as e:
+            log(f"  under-cliff control failed ({e}); omitting")
+            extra["decode_inclusive_cps_undercliff"] = None
 
     if not args.skip_engine:
         ep, en = (1000, 500) if not args.smoke else (50, 25)

@@ -22,6 +22,7 @@ import os
 import numpy as np
 
 from . import annotations as ann
+from ..utils.platform import effective_cpu_count
 from ..framework.replay import ReplayResult
 from ..plugins import (
     affinity, interpod, noderesources, nodevolumelimits, ports, taints,
@@ -287,7 +288,7 @@ def decode_chunk_into(rr, lo: int, hi: int, out: list) -> None:
     while the device executes later chunks.  Idempotent per index (a
     width-tier rerun re-delivers chunks)."""
     cc = getattr(rr, "_compact", None)
-    if hi - lo < 64 or (os.cpu_count() or 1) < 2:
+    if hi - lo < 64 or effective_cpu_count() < 2:
         # single-core hosts: the pool's dispatch + recon-lock traffic
         # costs more than the GIL-released C calls can win back
         for i in range(lo, hi):
@@ -320,7 +321,7 @@ def decode_all_parallel(rr: ReplayResult,
     if n is None:
         n = rr.cw.n_pods
     cc = getattr(rr, "_compact", None)
-    if cc is None or n < 64 or (os.cpu_count() or 1) < 2:
+    if cc is None or n < 64 or effective_cpu_count() < 2:
         return [decode_pod_result(rr, i) for i in range(n)]
     out: list = [None] * n
     for lo in range(0, n, cc.chunk):

@@ -42,3 +42,14 @@ def apply_env_platform() -> None:
     env var (see module docstring)."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         force_cpu()
+
+
+def effective_cpu_count() -> int:
+    """CPUs actually usable by THIS process: the scheduler affinity mask
+    (cgroup cpusets / taskset) when available, else os.cpu_count().
+    os.cpu_count() alone reports host logical cores, so a 1-CPU container
+    on an 8-core host would wrongly enable the multi-core code paths."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
