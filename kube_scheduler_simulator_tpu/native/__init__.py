@@ -96,10 +96,25 @@ def _build_and_load():
     return lib
 
 
+# str straight from the C buffer: PyUnicode_DecodeUTF8 builds the
+# (compact-ASCII) str object in ONE copy, where string_at(...).decode()
+# would materialize an intermediate bytes object first — at ~1.3 MB of
+# JSON per pod the extra pass is real memory traffic on the decode path
+try:
+    _PyUnicode_DecodeUTF8 = ctypes.pythonapi.PyUnicode_DecodeUTF8
+    _PyUnicode_DecodeUTF8.restype = ctypes.py_object
+    _PyUnicode_DecodeUTF8.argtypes = [
+        ctypes.c_void_p, ctypes.c_ssize_t, ctypes.c_char_p]
+except (AttributeError, OSError):  # non-CPython / no libpython symbols:
+    _PyUnicode_DecodeUTF8 = None   # keep the module's graceful fallback
+
+
 def take_sized_string(lib, ptr, length: int) -> str:
-    """Copy a codec-allocated buffer of known length and free it (skips
-    the strlen scan of take_string — the blobs run to ~1 MB)."""
+    """One-copy str from a codec-allocated buffer of known length; frees
+    the buffer."""
     try:
+        if _PyUnicode_DecodeUTF8 is not None:
+            return _PyUnicode_DecodeUTF8(ptr, length, b"strict")
         return ctypes.string_at(ptr, length).decode()
     finally:
         lib.codec_free(ptr)
