@@ -382,14 +382,16 @@ class SchedulerEngine:
                 and not self._custom_lifecycle_plugins()):
             from ..parallel.speculative import replay_speculative, speculation_ok
 
-            if speculation_ok(self.plugin_config):
+            if speculation_ok(self.plugin_config, have_manifests=True):
                 # dp-axis speculative batches: evaluate a pod batch against
                 # frozen state across the mesh's dp shards, commit the
                 # provably-non-interfering prefix — bit-identical to the
                 # scan (parallel/speculative.py; tests/test_speculative.py)
                 with TRACER.span("speculative_replay", pods=len(pending),
                                  nodes=len(nodes)):
-                    rr, spec_stats = replay_speculative(cw, self.mesh)
+                    rr, spec_stats = replay_speculative(
+                        cw, self.mesh, pods=pending,
+                        namespaces=self._list_shared("namespaces"))
                     TRACER.count("speculative_rounds_total",
                                  spec_stats["rounds"])
                 # rr's arrays are final host numpy here: decode through

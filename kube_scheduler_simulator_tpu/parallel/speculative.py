@@ -10,23 +10,35 @@ before it, and repeat.  Wall-clock drops because the per-pod [N] vector
 work becomes [B, N] tensor work (MXU-friendly) fanned across dp shards,
 while results stay BIT-IDENTICAL to the sequential scan.
 
-Exactness argument (why the accepted prefix is sequential-parity):
-speculation is restricted to plugin sets in SAFE_SPECULATIVE — per-node
-plugins whose filter/score for a pod depend only on (static node data,
-that node's accumulated resources).  Pod k in the batch is accepted only
-if every node bound by earlier-accepted pods was INFEASIBLE for k under
-the frozen state.  Sequentially, those nodes carry strictly more
-allocation, and NodeResourcesFit infeasibility is monotone in allocation
-(the only dynamic filter in the safe set), so they stay infeasible; all
-other nodes are untouched, so k's feasible set, raw scores on it, the
-feasible-set-wide normalization, and the argmax tie-break are identical
-to the sequential run.  The first pod of every round is unconditionally
-safe, so each round commits >= 1 pod and the loop terminates.
+Exactness argument (why the accepted prefix is sequential-parity).  Two
+acceptance rules compose:
 
-Plugin sets outside the safe class (PodTopologySpread, InterPodAffinity,
-NodePorts, the volume family — anything whose bind mutates cross-node
-state) automatically fall back to the scan path; parity is asserted by
-tests/test_speculative.py against the sequential oracle.
+* DIRTY-NODE rule (node-local plugins, SAFE_SPECULATIVE): pod k is
+  accepted only if every node bound by earlier-accepted pods was
+  INFEASIBLE for k under the frozen state.  Sequentially those nodes
+  carry strictly more allocation / port occupancy, and NodeResourcesFit
+  and NodePorts infeasibility are monotone in that state, so they stay
+  infeasible; all other nodes' node-local state is untouched, so k's
+  feasible set, raw scores on it, the feasible-set-wide normalization,
+  and the argmax tie-break are identical to the sequential run.
+* INTERACTION rule (label-coupled plugins, LABEL_COUPLED): a bound pod j
+  perturbs k's PodTopologySpread / InterPodAffinity inputs only when j
+  matches a selector k reads (k's constraint selectors / terms) or k
+  matches a term j imposes as an existing pod (j's anti + preferred
+  terms).  k is accepted only when no earlier-accepted BOUND pod
+  interacts either way, so every domain count and existing-term k reads
+  equals the sequential state.
+
+The first pod of every round is unconditionally safe, so each round
+commits >= 1 pod and the loop terminates.  Commit: core-only plugin sets
+fold all accepted binds in one scatter-add; sets with ports/topology/
+interpod carries fold the pipeline's own _bind_phase over the batch
+(non-accepted selections masked to -1, a no-op bind) — the same carry
+math as the scan.  The volume family stays excluded (PV/PVC bind state
+is cluster-wide and not label-gated), as do custom plugins and
+extenders; those fall back to the scan path.  Parity — including full
+annotation bytes for the headline configs 4 and 5 — is asserted by
+tests/test_speculative.py against the scan and the sequential oracle.
 """
 
 from __future__ import annotations
@@ -43,43 +55,150 @@ from .mesh import speculative_scores
 
 # per-node plugins with no cross-pod coupling: filters are static or
 # monotone in node allocation, scores depend only on the node's own
-# accumulated resources, binds touch only carry["core"]
+# accumulated resources, binds touch only carry["core"].  NodePorts is
+# node-local too (a bind occupies ports on the selected node only), so
+# the dirty-node rule already covers it.
 SAFE_SPECULATIVE = {
     "NodeResourcesFit", "NodeResourcesBalancedAllocation", "NodeAffinity",
     "TaintToleration", "NodeUnschedulable", "NodeName", "ImageLocality",
+    "NodePorts",
 }
 
+# label-coupled plugins: a bound pod j changes pod k's evaluation ONLY
+# when j is visible to k's selectors (PodTopologySpread counts pods
+# matching k's constraint selectors; InterPodAffinity counts pods
+# matching k's terms, and j's own anti/preferred terms act on k as
+# existing-pod constraints).  With the interaction rule below, batches
+# stay exact for the headline configs 4 and 5.  The volume family stays
+# excluded: PV/PVC bind state is cluster-wide and not label-gated.
+LABEL_COUPLED = {"PodTopologySpread", "InterPodAffinity"}
 
-def speculation_ok(cfg) -> bool:
+
+def speculation_ok(cfg, have_manifests: bool = True) -> bool:
     """True when the ACTIVE plugin set (enabled list plus every per-point
     override — point_enabled can add a plugin cfg.enabled never lists)
-    admits exact speculative batching."""
-    return not cfg.custom and set(cfg.active_plugins()) <= SAFE_SPECULATIVE
+    admits exact speculative batching.  Label-coupled plugins require the
+    pod manifests (for the interaction rule); without them only the
+    node-local class qualifies."""
+    if cfg.custom:
+        return False
+    active = set(cfg.active_plugins())
+    if active <= SAFE_SPECULATIVE:
+        return True
+    return have_manifests and active <= (SAFE_SPECULATIVE | LABEL_COUPLED)
 
 
-def _accept_prefix(feasible: np.ndarray, selected: np.ndarray) -> int:
+# ------------------------------------------------------------ interaction
+
+def _pod_terms(pod: dict, namespaces: list[dict] | None) -> tuple[list, list]:
+    """(selectors that OTHER pods are matched against for THIS pod's
+    evaluation, terms this pod imposes ON others once bound).
+
+    First list — "reads": k's spread-constraint selectors (same-namespace,
+    matchLabelKeys merged — plugins/topologyspread.effective_constraints)
+    and k's interpod terms.  Second list — "writes": j's interpod terms,
+    which act on later pods as existing-pod constraints (upstream
+    evaluates existing pods' anti and preferred terms against the
+    incoming pod).  Interpod terms come from the PLUGIN's own normalizer
+    (plugins/interpod.effective_terms) so namespaceSelector resolution
+    (against the live namespace manifests) and matchLabelKeys merging can
+    never diverge from what the evaluation actually matches."""
+    from ..plugins.interpod import effective_terms
+    from ..plugins.topologyspread import effective_constraints
+
+    meta = pod.get("metadata") or {}
+    ns = meta.get("namespace") or "default"
+    reads: list[tuple[list, dict]] = []
+    writes: list[tuple[list, dict]] = []
+    for c in effective_constraints(pod):
+        reads.append(([ns], c.get("labelSelector") or {}))
+    for field in ("podAffinity", "podAntiAffinity"):
+        for preferred in (False, True):
+            for term, _w in effective_terms(pod, field, preferred,
+                                            namespaces=namespaces):
+                entry = (list(term.get("namespaces") or [ns]),
+                         term.get("labelSelector") or {})
+                reads.append(entry)
+                writes.append(entry)
+    return reads, writes
+
+
+def _matches_any(terms: list, pod: dict) -> bool:
+    from ..state.selectors import label_selector_matches
+
+    meta = pod.get("metadata") or {}
+    ns = meta.get("namespace") or "default"
+    labels = {k: str(v) for k, v in (meta.get("labels") or {}).items()}
+    for ns_list, sel in terms:
+        if ns in ns_list and label_selector_matches(sel, labels):
+            return True
+    return False
+
+
+class _InteractionOracle:
+    """interacts(j, k): does pod j's bind change pod k's label-coupled
+    state?  True when j matches any selector k READS, or k matches any
+    term j WRITES (j's own anti/preferred terms acting as existing-pod
+    constraints).  Conservative and exact: a False guarantees k's
+    spread/interpod inputs are untouched by j's bind."""
+
+    def __init__(self, pods: list[dict], namespaces: list[dict] | None = None):
+        self.pods = pods
+        self.namespaces = namespaces
+        self._terms = [None] * len(pods)
+
+    def _t(self, i: int):
+        if self._terms[i] is None:
+            self._terms[i] = _pod_terms(self.pods[i], self.namespaces)
+        return self._terms[i]
+
+    def interacts(self, j: int, k: int) -> bool:
+        k_reads, _ = self._t(k)
+        _, j_writes = self._t(j)
+        return (_matches_any(k_reads, self.pods[j])
+                or _matches_any(j_writes, self.pods[k]))
+
+
+def _accept_prefix(feasible: np.ndarray, selected: np.ndarray,
+                   inter: _InteractionOracle | None = None,
+                   base: int = 0) -> int:
     """Longest non-interfering prefix: pod k is accepted iff every node
-    bound by earlier-accepted pods is infeasible for k (see module doc).
-    feasible: [B, N] bool (speculative), selected: [B] int32."""
+    bound by earlier-accepted pods is infeasible for k AND (when
+    label-coupled plugins are active) no earlier-accepted pod interacts
+    with k's spread/interpod selectors (see module doc).
+    feasible: [B, N] bool (speculative), selected: [B] int32; base is the
+    batch's first absolute pod index (the interaction oracle's space)."""
     b = selected.shape[0]
     dirty: list[int] = []
-    for k in range(b):
+    bound: list[int] = []  # accepted pods that actually bound (only a
+    for k in range(b):     # BIND can perturb later pods' state)
         if dirty and feasible[k, dirty].any():
+            return k
+        if inter is not None and any(
+                inter.interacts(j, base + k) for j in bound):
             return k
         s = int(selected[k])
         if s >= 0:
             dirty.append(s)
+            bound.append(base + k)
     return b
 
 
+# plugins whose bind mutates ONLY carry["core"] — eligible for the
+# one-scatter commit; anything else (NodePorts port occupancy, TSP domain
+# counts, interpod term counts) goes through the bind-phase scan commit
+_CORE_ONLY_CARRY = SAFE_SPECULATIVE - {"NodePorts"}
+
+
 def _batch_commit_fn(cw: CompiledWorkload):
-    """jitted (carry, core_xs_batch, selected, accept) -> carry with every
-    accepted bind applied in one scatter-add.  Safe-set workloads only
+    """jitted (carry, xs_batch, selected, accept) -> carry with every
+    accepted bind applied in one scatter-add.  Core-only workloads only
     mutate carry["core"] on bind (pipeline._bind_phase), and accepted
     pods bind distinct nodes, so one batched scatter == the sequential
     fold of core_bind_update."""
 
-    def commit(carry, core_batch, selected, accept):
+    def commit(carry, xs_batch, selected, accept):
+        core_batch = xs_batch["core"]
         core = carry["core"]
         bound = accept & (selected >= 0)
         idx = jnp.maximum(selected, 0)
@@ -97,9 +216,38 @@ def _batch_commit_fn(cw: CompiledWorkload):
     return jax.jit(commit, donate_argnums=(0,))
 
 
+def _bind_scan_commit_fn(cw: CompiledWorkload):
+    """jitted commit for workloads with non-core carries: fold the
+    pipeline's own _bind_phase over the batch with non-accepted pods'
+    selections masked to -1 (a no-op bind) — exactly the sequential
+    carry fold, so every plugin carry (ports, topology counts, interpod
+    terms) advances identically to the scan path."""
+    from ..framework.pipeline import _bind_phase
+
+    def commit(carry, xs_batch, selected, accept):
+        sel = jnp.where(accept, selected, jnp.int32(-1))
+
+        def body(c, t):
+            sl, s = t
+            return _bind_phase(cw, c, sl, s), None
+
+        out, _ = jax.lax.scan(body, carry, (xs_batch, sel))
+        return out
+
+    return jax.jit(commit, donate_argnums=(0,))
+
+
 def replay_speculative(cw: CompiledWorkload, mesh, batch: int | None = None,
+                       pods: list[dict] | None = None,
+                       namespaces: list[dict] | None = None,
                        ) -> tuple[ReplayResult, dict]:
     """Schedule the whole queue in speculative batches (see module doc).
+
+    pods: the pod manifests, required when label-coupled plugins
+    (PodTopologySpread / InterPodAffinity) are active — the interaction
+    rule reads their selectors.  namespaces: the namespace manifests for
+    interpod namespaceSelector resolution (pass whatever was given to
+    compile_workload).
 
     Returns (rr, stats): rr is a full-array ReplayResult bit-identical to
     replay(cw) / the sequential oracle; stats records round count and
@@ -112,11 +260,21 @@ def replay_speculative(cw: CompiledWorkload, mesh, batch: int | None = None,
         batch = max(dp, 1) * 8
     spec = speculative_scores(cw, mesh)  # (carry, xs_batch) -> StepOut[B]
 
+    active = set(cw.config.active_plugins())
+    inter: _InteractionOracle | None = None
+    if active & LABEL_COUPLED:
+        if pods is None:
+            raise ValueError(
+                "label-coupled plugins active: replay_speculative needs the "
+                "pod manifests for the interaction rule")
+        inter = _InteractionOracle(pods, namespaces)
+
     # copy: commit() donates its carry argument, and cw.init_carry must
     # survive for later replays of the same workload (same guard as
     # framework/replay.py's scan entry)
     carry = jax.tree.map(jnp.array, cw.init_carry)
-    commit = _batch_commit_fn(cw)
+    commit = (_batch_commit_fn(cw) if active <= _CORE_ONLY_CARRY
+              else _bind_scan_commit_fn(cw))
 
     f = len(cw.config.filters())
     s = len(cw.config.scorers())
@@ -145,7 +303,7 @@ def replay_speculative(cw: CompiledWorkload, mesh, batch: int | None = None,
         sel = np.asarray(outs.selected[: hi - lo])
         rej = np.asarray(outs.prefilter_reject[: hi - lo])
         feas = (codes == 0).all(axis=1) & (rej == 0)[:, None]
-        k = _accept_prefix(feas, sel)
+        k = _accept_prefix(feas, sel, inter, lo)
         rounds.append(k)
         a = lo + k
         filter_codes[lo:a] = codes[:k]
@@ -155,7 +313,7 @@ def replay_speculative(cw: CompiledWorkload, mesh, batch: int | None = None,
         feasible_count[lo:a] = np.asarray(outs.feasible_count[:k])
         prefilter_reject[lo:a] = rej[:k]
         accept = jnp.arange(batch) < k
-        carry = commit(carry, xs["core"], outs.selected, accept)
+        carry = commit(carry, xs, outs.selected, accept)
         lo = a
 
     rr = ReplayResult(

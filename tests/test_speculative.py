@@ -30,11 +30,18 @@ def _workload(n_nodes=24, n_pods=60, seed=9):
 
 def test_speculation_ok_classifier():
     assert speculation_ok(PluginSetConfig(enabled=SAFE_CFG))
-    assert not speculation_ok(PluginSetConfig(
+    # label-coupled plugins qualify WITH manifests (interaction rule),
+    # node-local NodePorts under the dirty-node rule alone
+    assert speculation_ok(PluginSetConfig(
         enabled=SAFE_CFG + ["PodTopologySpread"]))
-    assert not speculation_ok(PluginSetConfig(
+    assert speculation_ok(PluginSetConfig(
         enabled=SAFE_CFG + ["InterPodAffinity"]))
-    assert not speculation_ok(PluginSetConfig(enabled=["NodePorts"]))
+    assert speculation_ok(PluginSetConfig(enabled=["NodePorts"]))
+    # the volume family's cluster-wide PV/PVC bind state stays excluded
+    assert not speculation_ok(PluginSetConfig(
+        enabled=SAFE_CFG + ["VolumeBinding"]))
+    assert not speculation_ok(PluginSetConfig(
+        enabled=SAFE_CFG + ["VolumeRestrictions"]))
 
 
 @pytest.mark.parametrize("dp,batch", [(1, 4), (2, 8), (4, 16)])
@@ -121,11 +128,17 @@ def test_engine_uses_speculative_path_with_dp_mesh():
 
 def test_point_enabled_unsafe_plugin_blocks_speculation():
     """point_enabled can add a plugin cfg.enabled never lists; the gate
-    must look at the ACTIVE set (review finding: a score-point
-    PodTopologySpread silently corrupted speculative state)."""
+    must look at the ACTIVE set (review finding: a point-enabled coupled
+    plugin silently corrupted speculative state).  VolumeBinding is the
+    representative excluded plugin now that spread/interpod qualify."""
     cfg = PluginSetConfig(enabled=["NodeResourcesFit"],
-                          point_enabled={"score": ["PodTopologySpread"]})
+                          point_enabled={"filter": ["VolumeBinding"]})
     assert not speculation_ok(cfg)
+    # and a point-enabled LABEL_COUPLED plugin without manifests
+    cfg2 = PluginSetConfig(enabled=["NodeResourcesFit"],
+                           point_enabled={"score": ["PodTopologySpread"]})
+    assert not speculation_ok(cfg2, have_manifests=False)
+    assert speculation_ok(cfg2, have_manifests=True)
 
 
 def test_init_carry_survives_speculative_replay():
@@ -139,3 +152,123 @@ def test_init_carry_survives_speculative_replay():
     np.testing.assert_array_equal(rr1.selected, rr2.selected)
     base = replay(cw, chunk=4)  # the scan also reuses it
     np.testing.assert_array_equal(rr1.selected, base.selected)
+
+
+COUPLED_CFG = SAFE_CFG + ["PodTopologySpread"]
+
+
+def _coupled_workload(n_nodes=20, n_pods=48, seed=13, interpod=False):
+    nodes = make_nodes(n_nodes, seed=seed, taint_fraction=0.2)
+    pods = make_pods(n_pods, seed=seed + 1, with_affinity=True,
+                     with_tolerations=True, with_spread=True,
+                     with_interpod=interpod)
+    return nodes, pods
+
+
+@pytest.mark.parametrize("interpod", [False, True])
+def test_speculative_label_coupled_matches_scan(interpod):
+    """Configs 4/5 plugin sets (spread / interpod) under the interaction
+    rule: byte-parity with the scan down to full annotations."""
+    nodes, pods = _coupled_workload(interpod=interpod)
+    cfg = PluginSetConfig(enabled=COUPLED_CFG
+                          + (["InterPodAffinity"] if interpod else []))
+    assert speculation_ok(cfg)
+    base = replay(compile_workload(nodes, pods, cfg), chunk=16)
+    rr, stats = replay_speculative(compile_workload(nodes, pods, cfg),
+                                   None, batch=8, pods=pods)
+    np.testing.assert_array_equal(rr.selected, base.selected)
+    for i in range(len(pods)):
+        assert decode_pod_result(rr, i) == decode_pod_result(base, i), i
+    # interactions must actually cut batches on this workload (app-group
+    # selectors overlap), or the rule is vacuous
+    assert stats["mean_accept"] < stats["batch"]
+
+
+def test_speculative_label_coupled_oracle_parity():
+    from kube_scheduler_simulator_tpu.reference_impl.sequential import (
+        SequentialScheduler)
+
+    nodes, pods = _coupled_workload(n_nodes=10, n_pods=20, seed=29,
+                                    interpod=True)
+    cfg = PluginSetConfig(enabled=COUPLED_CFG + ["InterPodAffinity"])
+    oracle = SequentialScheduler(nodes, pods, cfg).schedule_all()
+    rr, _ = replay_speculative(compile_workload(nodes, pods, cfg),
+                               None, batch=6, pods=pods)
+    for i, (sa, _sel) in enumerate(oracle):
+        da = decode_pod_result(rr, i)
+        for key, v in sa.items():
+            assert da[key] == v, f"pod {i} {key}"
+
+
+def test_speculative_nodeports_exact():
+    """NodePorts rides the dirty-node rule: port conflicts are node-local
+    and monotone; parity with the scan under hostPort contention."""
+    nodes = make_nodes(6, seed=7)
+    pods = []
+    for i in range(18):
+        p = {"metadata": {"name": f"hp-{i}", "namespace": "default"},
+             "spec": {"containers": [{
+                 "name": "c",
+                 "resources": {"requests": {"cpu": "100m"}},
+                 "ports": [{"hostPort": 8000 + (i % 3),
+                            "protocol": "TCP"}]}]}}
+        pods.append(p)
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit", "NodePorts"])
+    assert speculation_ok(cfg)
+    base = replay(compile_workload(nodes, pods, cfg), chunk=8)
+    rr, _ = replay_speculative(compile_workload(nodes, pods, cfg),
+                               None, batch=6)
+    np.testing.assert_array_equal(rr.selected, base.selected)
+    for i in range(len(pods)):
+        assert decode_pod_result(rr, i) == decode_pod_result(base, i), i
+
+
+def test_label_coupled_requires_manifests():
+    nodes, pods = _coupled_workload(n_nodes=6, n_pods=6)
+    cfg = PluginSetConfig(enabled=COUPLED_CFG)
+    assert not speculation_ok(cfg, have_manifests=False)
+    with pytest.raises(ValueError):
+        replay_speculative(compile_workload(nodes, pods, cfg), None, batch=4)
+
+
+def test_namespace_selector_interaction_detected():
+    """Review counterexample: a cross-namespace required anti-affinity via
+    namespaceSelector must register as an interaction (the hand-rolled
+    term extraction missed it; the oracle now reuses
+    plugins/interpod.effective_terms with the namespace manifests)."""
+    def node(name, zone, cpu):
+        return {"metadata": {"name": name, "labels":
+                             {"topology.kubernetes.io/zone": zone,
+                              "kubernetes.io/hostname": name}},
+                "status": {"allocatable": {"cpu": cpu, "memory": "8Gi",
+                                           "pods": "10"}}}
+
+    nodes = [node("n0", "A", "300m"), node("n1", "A", "4"),
+             node("n2", "B", "4")]
+    namespaces = [{"metadata": {"name": "a", "labels": {"team": "x"}}},
+                  {"metadata": {"name": "b", "labels": {"team": "y"}}}]
+    p0 = {"metadata": {"name": "p0", "namespace": "a",
+                       "labels": {"app": "x"}},
+          "spec": {"containers": [{"name": "c", "resources":
+                                   {"requests": {"cpu": "200m"}}}]}}
+    p1 = {"metadata": {"name": "p1", "namespace": "b",
+                       "labels": {"app": "y"}},
+          "spec": {"containers": [{"name": "c", "resources":
+                                   {"requests": {"cpu": "1"}}}],
+                   "affinity": {"podAntiAffinity": {
+                       "requiredDuringSchedulingIgnoredDuringExecution": [{
+                           "labelSelector": {"matchLabels": {"app": "x"}},
+                           "namespaceSelector": {},
+                           "topologyKey": "topology.kubernetes.io/zone"}]}}}}
+    pods = [p0, p1]
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit", "InterPodAffinity"])
+    base = replay(compile_workload(nodes, pods, cfg, namespaces=namespaces),
+                  chunk=2)
+    rr, stats = replay_speculative(
+        compile_workload(nodes, pods, cfg, namespaces=namespaces),
+        None, batch=2, pods=pods, namespaces=namespaces)
+    np.testing.assert_array_equal(rr.selected, base.selected)
+    for i in range(2):
+        assert decode_pod_result(rr, i) == decode_pod_result(base, i), i
+    # the interaction must have cut the first batch to 1
+    assert stats["rounds"] == 2 and stats["mean_accept"] == 1.0
