@@ -92,12 +92,14 @@ def main():
 
     spec_result = None
     if n_dev >= 4:
+        # the CONFIG-4 plugin lineup — PodTopologySpread rides the
+        # interaction rule (round-4 extension)
         s_nodes = make_nodes(len(nodes), seed=2, taint_fraction=0.1)
         s_pods = make_pods(1000, seed=3, with_affinity=True,
-                           with_tolerations=True)
+                           with_tolerations=True, with_spread=True)
         s_cfg = PluginSetConfig(enabled=[
             "NodeResourcesFit", "NodeResourcesBalancedAllocation",
-            "NodeAffinity", "TaintToleration"])
+            "NodeAffinity", "TaintToleration", "PodTopologySpread"])
 
         def engine_run(mesh_arg):
             store = ObjectStore()
