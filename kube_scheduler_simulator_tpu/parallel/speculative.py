@@ -30,7 +30,15 @@ acceptance rules compose:
   equals the sequential state.
 
 The first pod of every round is unconditionally safe, so each round
-commits >= 1 pod and the loop terminates.  Commit: core-only plugin sets
+commits >= 1 pod and the loop terminates.  Where the win comes from:
+acceptance is long exactly when feasibility is SPARSE (taints, affinity
+pins, zone constraints, tight fit — i.e. realistic clusters); in a fully
+relaxed cluster where every pod fits everywhere, the dirty-node rule
+cuts every batch at 1 and the path degrades gracefully to ~scan cost.
+That conservatism is not incidental: byte-exact annotations require that
+NO feasible node's score inputs changed (normalization ranges over the
+whole feasible set), so any relaxation of the rule would break the
+bit-parity contract, not just the selection.  Commit: core-only plugin sets
 fold all accepted binds in one scatter-add; sets with ports/topology/
 interpod carries fold the pipeline's own _bind_phase over the batch
 (non-accepted selections masked to -1, a no-op bind) — the same carry
@@ -256,8 +264,19 @@ def replay_speculative(cw: CompiledWorkload, mesh, batch: int | None = None,
     """
     p = cw.n_pods
     dp = mesh.shape.get("dp", 1) if mesh is not None else 1
-    if batch is None:
-        batch = max(dp, 1) * 8
+    # adaptive batch ladder (only when the caller didn't pin a size):
+    # rungs are dp multiples so the dp shards stay balanced; climb a rung
+    # after a fully-accepted round, drop after a round cut below a
+    # quarter — contention-free queues reach big MXU-friendly batches,
+    # contended ones stop paying for work they throw away.  Each rung is
+    # one extra jit specialization (shapes differ), bounded by the ladder
+    # length.
+    unit = max(dp, 1) * 8
+    ladder = [unit, unit * 2, unit * 4]
+    adaptive = batch is None
+    if adaptive:
+        rung = 0
+        batch = ladder[rung]
     spec = speculative_scores(cw, mesh)  # (carry, xs_batch) -> StepOut[B]
 
     active = set(cw.config.active_plugins())
@@ -289,22 +308,23 @@ def replay_speculative(cw: CompiledWorkload, mesh, batch: int | None = None,
 
     from ..framework.replay import _slice_xs
 
-    def slice_xs(lo: int, hi: int):
-        xs = _slice_xs(cw.xs, lo, hi, batch)  # the scan path's slicer
-        xs["is_pad"] = jnp.arange(batch) >= (hi - lo)
+    def slice_xs(lo: int, hi: int, pad_to: int):
+        xs = _slice_xs(cw.xs, lo, hi, pad_to)  # the scan path's slicer
+        xs["is_pad"] = jnp.arange(pad_to) >= (hi - lo)
         return xs
 
     lo = 0
     while lo < p:
         hi = min(lo + batch, p)
-        xs = slice_xs(lo, hi)
+        m = hi - lo  # this round's size (lo/batch both move below)
+        xs = slice_xs(lo, hi, batch)
         outs = spec(carry, xs)
-        codes = np.asarray(outs.filter_codes[: hi - lo])   # [m, F, N]
-        sel = np.asarray(outs.selected[: hi - lo])
-        rej = np.asarray(outs.prefilter_reject[: hi - lo])
+        codes = np.asarray(outs.filter_codes[:m])   # [m, F, N]
+        sel = np.asarray(outs.selected[:m])
+        rej = np.asarray(outs.prefilter_reject[:m])
         feas = (codes == 0).all(axis=1) & (rej == 0)[:, None]
         k = _accept_prefix(feas, sel, inter, lo)
-        rounds.append(k)
+        rounds.append((k, m))
         a = lo + k
         filter_codes[lo:a] = codes[:k]
         score_raw[lo:a] = np.asarray(outs.score_raw[:k])
@@ -315,13 +335,23 @@ def replay_speculative(cw: CompiledWorkload, mesh, batch: int | None = None,
         accept = jnp.arange(batch) < k
         carry = commit(carry, xs, outs.selected, accept)
         lo = a
+        if adaptive:
+            if k == m and rung < len(ladder) - 1:
+                rung += 1
+            elif k < max(1, m // 4) and rung > 0:
+                rung -= 1
+            batch = ladder[rung]
 
     rr = ReplayResult(
         cw=cw, filter_codes=filter_codes, score_raw=score_raw,
         score_final=score_final, selected=selected,
         feasible_count=feasible_count, prefilter_reject=prefilter_reject,
     )
-    stats = {"rounds": len(rounds), "batch": batch,
-             "mean_accept": round(float(np.mean(rounds)), 2) if rounds else 0,
-             "accepted_first_try": int(sum(r == batch for r in rounds))}
+    accepts = [k for k, _ in rounds]
+    stats = {"rounds": len(rounds),
+             "batch": batch,        # final rung (== configured size when pinned)
+             "adaptive": adaptive,
+             "round_batches": [m for _, m in rounds],
+             "mean_accept": round(float(np.mean(accepts)), 2) if rounds else 0,
+             "accepted_first_try": int(sum(k == m for k, m in rounds))}
     return rr, stats

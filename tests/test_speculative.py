@@ -272,3 +272,44 @@ def test_namespace_selector_interaction_detected():
         assert decode_pod_result(rr, i) == decode_pod_result(base, i), i
     # the interaction must have cut the first batch to 1
     assert stats["rounds"] == 2 and stats["mean_accept"] == 1.0
+
+
+def test_adaptive_batch_ladder_stays_exact():
+    """batch=None engages the adaptive ladder (grow on full accept,
+    shrink on early cuts); results stay bit-identical to the scan."""
+    nodes, pods = _coupled_workload(n_nodes=24, n_pods=80, seed=51)
+    cfg = PluginSetConfig(enabled=COUPLED_CFG)
+    base = replay(compile_workload(nodes, pods, cfg), chunk=16)
+    rr, stats = replay_speculative(compile_workload(nodes, pods, cfg),
+                                   None, pods=pods)
+    assert stats["adaptive"]
+    np.testing.assert_array_equal(rr.selected, base.selected)
+    for i in range(len(pods)):
+        assert decode_pod_result(rr, i) == decode_pod_result(base, i), i
+
+
+def test_adaptive_ladder_climbs_on_sparse_feasibility():
+    """Disjoint feasible sets (per-node affinity pins) fully accept every
+    round, so the ladder must actually climb its rungs (review finding:
+    the climb condition was computed after `lo` moved and never fired)."""
+    nodes = make_nodes(80, seed=61)
+    pods = []
+    for i in range(80):
+        pods.append({
+            "metadata": {"name": f"pin-{i:03d}", "namespace": "default"},
+            "spec": {
+                "containers": [{"name": "c", "resources":
+                                {"requests": {"cpu": "100m"}}}],
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchExpressions": [{
+                            "key": "kubernetes.io/hostname",
+                            "operator": "In",
+                            "values": [f"node-{i:05d}"]}]}]}}},
+            }})
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit", "NodeAffinity"])
+    base = replay(compile_workload(nodes, pods, cfg), chunk=16)
+    rr, stats = replay_speculative(compile_workload(nodes, pods, cfg), None)
+    np.testing.assert_array_equal(rr.selected, base.selected)
+    assert max(stats["round_batches"]) == 32, stats["round_batches"]
+    assert stats["accepted_first_try"] == stats["rounds"]
