@@ -7,7 +7,18 @@
 
 PY ?= python
 
-.PHONY: codec test bench smoke clean
+.PHONY: codec test bench smoke clean parity-fullscale multichip-scaling host-probe
+
+# measurement artifacts (committed under docs/bench/; see BASELINE.md)
+parity-fullscale:
+	JAX_PLATFORMS=cpu $(PY) docs/bench/parity_fullscale.py
+
+multichip-scaling:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	    $(PY) docs/bench/multichip_scaling.py
+
+host-probe:
+	$(PY) docs/bench/host_page_backing.py
 
 codec:
 	$(PY) -c "from kube_scheduler_simulator_tpu.native import build_codec; print(build_codec())"
