@@ -124,6 +124,11 @@ class StoreReflector:
                     if event_type != "MODIFIED":
                         continue
                     meta = obj.get("metadata") or {}
+                    if meta.get("deletionTimestamp"):
+                        # the reference's FilterFunc excludes pods being
+                        # deleted (storereflector.go:61-68): no result
+                        # write races a graceful deletion
+                        continue
                     ns = meta.get("namespace") or "default"
                     name = meta.get("name", "")
                     # only fire when some store holds a result for the pod

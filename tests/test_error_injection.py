@@ -381,3 +381,78 @@ def test_informer_mode_reflects_externally_bound_pod():
     finally:
         stop.set()
         refl.stop_informer()
+
+
+def test_informer_mode_skips_deleting_pods():
+    """The reference's FilterFunc excludes pods carrying a
+    deletionTimestamp (storereflector.go:61-68): no result write races a
+    graceful deletion.  Deterministic: the pump is one FIFO thread, so
+    once a LATER sentinel pod's reflect has landed, the dying pod's event
+    has definitely been processed (and must have been skipped)."""
+    import threading
+    import time as _time
+
+    from kube_scheduler_simulator_tpu.store.reflector import StoreReflector
+
+    SEL = "kube-scheduler-simulator.sigs.k8s.io/selected-node"
+    store = ObjectStore()
+    for name in ("dying", "sentinel"):
+        store.create("pods", {"metadata": {"name": name,
+                                           "namespace": "default"},
+                              "spec": {}})
+    rs = ResultStore()
+    rs.put_decoded("default", "dying", {SEL: "n1"})
+    rs.put_decoded("default", "sentinel", {SEL: "n2"})
+    refl = StoreReflector(store)
+    refl.add_result_store(rs, "k")
+    stop = threading.Event()
+    refl.register_result_saving_to_informer(stop)
+    try:
+        p = store.get("pods", "dying")
+        p["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+        p["spec"]["nodeName"] = "n1"
+        store.update("pods", p)
+        s = store.get("pods", "sentinel")
+        s["spec"]["nodeName"] = "n2"
+        store.update("pods", s)
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            anns = (store.get("pods", "sentinel")["metadata"]
+                    .get("annotations") or {})
+            if SEL in anns:
+                break
+            _time.sleep(0.02)
+        assert SEL in (store.get("pods", "sentinel")["metadata"]
+                       .get("annotations") or {}), "sentinel never reflected"
+        anns = store.get("pods", "dying")["metadata"].get("annotations") or {}
+        assert SEL not in anns
+        # the stored result is NOT consumed either (the reference never
+        # reaches the delete-on-success path for filtered pods)
+        assert rs.get_stored_result({"metadata": {
+            "namespace": "default", "name": "dying"}}) is not None
+    finally:
+        stop.set()
+        refl.stop_informer()
+
+
+def test_update_result_history_reference_table():
+    """The reference's Test_updateResultHistory table
+    (storereflector_test.go:83-150) ported verbatim: empty -> one record,
+    append preserves order, and the oldest record is trimmed when the
+    encoded history exceeds the 256 KiB annotation limit."""
+    from kube_scheduler_simulator_tpu.store.reflector import (
+        update_result_history)
+
+    HIST = "kube-scheduler-simulator.sigs.k8s.io/result-history"
+    pod = {"metadata": {}}
+    update_result_history(pod, {"result1": "fuga", "result2": "hoge"})
+    assert pod["metadata"]["annotations"][HIST] == \
+        '[{"result1":"fuga","result2":"hoge"}]'
+    update_result_history(pod, {"result1": "fuga2", "result2": "hoge2"})
+    assert pod["metadata"]["annotations"][HIST] == \
+        '[{"result1":"fuga","result2":"hoge"},{"result1":"fuga2","result2":"hoge2"}]'
+
+    pod = {"metadata": {"annotations": {HIST: '[{"result":"%s"}]' % ("a" * 200000)}}}
+    update_result_history(pod, {"result": "b" * 200000})
+    assert pod["metadata"]["annotations"][HIST] == \
+        '[{"result":"%s"}]' % ("b" * 200000)
