@@ -80,7 +80,8 @@ def _device_initializes(timeout: float = 240) -> bool:
 
 def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
                    decode_sample: int = 512, decode_stream: bool = True,
-                   node_scale: float | None = None, quick: bool = False):
+                   node_scale: float | None = None, quick: bool = False,
+                   unroll: int = 2):
     """Compile + warm + timed device-only + timed end-to-end + timed
     ANNOTATIONS-MATERIALIZED end-to-end (decode of every pod's result
     annotations streamed on_chunk, overlapping device compute — the
@@ -113,13 +114,14 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
             log(f"  mesh: node axis sharded over {shards} devices")
 
     t0 = time.time()
-    rr = replay(cw, chunk=chunk, collect=False, mesh=mesh)  # XLA compile + run
+    rr = replay(cw, chunk=chunk, collect=False, mesh=mesh,
+                unroll=unroll)  # XLA compile + run
     log(f"  warm-up replay: {time.time()-t0:.1f}s, scheduled {rr.scheduled}/{len(pods)}")
 
     dev_cps = e2e_cps = None
     if not quick:  # quick: only the streamed-decode figure is wanted
         t0 = time.time()
-        rr = replay(cw, chunk=chunk, collect=False, mesh=mesh)
+        rr = replay(cw, chunk=chunk, collect=False, mesh=mesh, unroll=unroll)
         dev_s = time.time() - t0
         dev_cps = len(pods) / dev_s
         log(f"  device-only replay: {dev_s:.2f}s -> {dev_cps:,.0f} cycles/s")
@@ -129,7 +131,8 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
         e2e_s = None
         for attempt in range(2):
             t0 = time.time()
-            rr = replay(cw, chunk=chunk, collect=True, mesh=mesh)
+            rr = replay(cw, chunk=chunk, collect=True, mesh=mesh,
+                        unroll=unroll)
             dt = time.time() - t0
             log(f"  incl host transfer of result tensors (run {attempt + 1}): "
                 f"{dt:.2f}s -> {len(pods)/dt:,.0f} cycles/s")
@@ -154,7 +157,7 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
     if decode_stream:
         anns_all: list = [None] * len(pods)
         t0 = time.time()
-        rr = replay(cw, chunk=chunk, collect=True, mesh=mesh,
+        rr = replay(cw, chunk=chunk, collect=True, mesh=mesh, unroll=unroll,
                     on_chunk=lambda r, lo, hi: decode_chunk_into(r, lo, hi, anns_all))
         di_s = time.time() - t0
         di_cps = len(pods) / di_s
@@ -355,6 +358,11 @@ def main():
                          "keeps the REAL cluster size so per-cycle cost is honest")
     ap.add_argument("--cpu-parallelism", type=int, default=16)
     ap.add_argument("--chunk", type=int, default=1024)
+    ap.add_argument("--unroll", type=int, default=2,
+                    help="lax.scan unroll for the replay measurements "
+                         "(the step's [N] ops are tiny, so per-iteration "
+                         "overhead matters; 2 measured ~8%% faster than 1 "
+                         "on the CPU backend, flat beyond)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the node axis over this many devices "
                          "(0: unsharded single-chip)")
@@ -447,14 +455,15 @@ def _run(args):
 
     # --- TPU measurements -----------------------------------------------
     main_fig = measure_replay(args.config, args.scale, args.seed, args.chunk,
-                              args.mesh)
+                              args.mesh, unroll=args.unroll)
     extra = {"device_only_cps": main_fig["device_only_cps"],
              "incl_host_transfer_cps": main_fig["incl_host_transfer_cps"],
              "decode_pods_per_sec": main_fig["decode_pods_per_sec"]}
 
     if not args.skip_config5 and args.config != 5:
         extra["config5"] = measure_replay(5, args.scale, args.seed, args.chunk,
-                                          args.mesh, decode_sample=0)
+                                          args.mesh, decode_sample=0,
+                                          unroll=args.unroll)
 
     if args.scale >= 1.0 and not args.assume_fallback:
         # under-cliff control: this bench host's first-touch page backing
@@ -476,7 +485,8 @@ def _run(args):
             "force_cpu()\n"
             "import bench\n"
             f"uc = bench.measure_replay({args.config}, 0.4, {args.seed}, "
-            f"{args.chunk}, 0, decode_sample=0, node_scale=1.0, quick=True)\n"
+            f"{args.chunk}, 0, decode_sample=0, node_scale=1.0, quick=True, "
+            f"unroll={args.unroll})\n"
             "print('UC ' + json.dumps(uc))\n"
         )
         try:

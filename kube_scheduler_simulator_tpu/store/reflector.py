@@ -121,6 +121,15 @@ class StoreReflector:
                     if ev is None:
                         return
                     _, event_type, obj = ev
+                    if event_type == "DELETED":
+                        # purge any unreflected results so a long-lived
+                        # informer process doesn't accumulate entries for
+                        # pods whose deletion-time updates were filtered
+                        # (the reference leaks here; completing the
+                        # cleanup matches our UID-guard precedent)
+                        for rs in self.result_stores.values():
+                            rs.delete_data(obj)
+                        continue
                     if event_type != "MODIFIED":
                         continue
                     meta = obj.get("metadata") or {}

@@ -456,3 +456,45 @@ def test_update_result_history_reference_table():
     update_result_history(pod, {"result": "b" * 200000})
     assert pod["metadata"]["annotations"][HIST] == \
         '[{"result":"%s"}]' % ("b" * 200000)
+
+
+def test_informer_mode_purges_results_of_deleted_pods():
+    """A DELETED event purges unreflected store entries so a long-lived
+    informer process doesn't leak per-pod result maps (review finding on
+    the deletionTimestamp filter; the reference leaks here)."""
+    import threading
+    import time as _time
+
+    from kube_scheduler_simulator_tpu.store.reflector import StoreReflector
+
+    SEL = "kube-scheduler-simulator.sigs.k8s.io/selected-node"
+    store = ObjectStore()
+    for name in ("goner", "sentinel"):
+        store.create("pods", {"metadata": {"name": name,
+                                           "namespace": "default"},
+                              "spec": {}})
+    rs = ResultStore()
+    rs.put_decoded("default", "goner", {SEL: "n1"})
+    rs.put_decoded("default", "sentinel", {SEL: "n2"})
+    refl = StoreReflector(store)
+    refl.add_result_store(rs, "k")
+    stop = threading.Event()
+    refl.register_result_saving_to_informer(stop)
+    try:
+        store.delete("pods", "goner", "default")
+        s = store.get("pods", "sentinel")
+        s["spec"]["nodeName"] = "n2"
+        store.update("pods", s)
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            anns = (store.get("pods", "sentinel")["metadata"]
+                    .get("annotations") or {})
+            if SEL in anns:
+                break
+            _time.sleep(0.02)
+        # FIFO pump: sentinel reflected => the DELETED event was handled
+        assert rs.get_stored_result({"metadata": {
+            "namespace": "default", "name": "goner"}}) is None
+    finally:
+        stop.set()
+        refl.stop_informer()
