@@ -375,11 +375,25 @@ class SchedulerEngine:
         if self._needs_host_path():
             return self._schedule_host_path(cw, pending)
 
+        # a live cluster's node count need not divide the mesh's "nodes"
+        # extent; shard only waves where it does and run the rest
+        # unsharded (shard_workload would reject the shape) — speculative
+        # dp batching below tolerates mesh=None
+        mesh = self.mesh
+        if mesh is not None:
+            from ..parallel.mesh import can_shard
+
+            if not can_shard(cw.n_nodes, mesh):
+                TRACER.count("mesh_fallback_indivisible_nodes_total")
+                mesh = None
+
         from ..store.decode import decode_chunk_into
 
         if (self.mesh is not None and self.mesh.shape.get("dp", 1) > 1
                 and self.extender_service is None
                 and not self._custom_lifecycle_plugins()):
+            # (uses the divisibility-checked mesh; dp batching itself
+            # works unsharded, so the wave still speculates)
             from ..parallel.speculative import replay_speculative, speculation_ok
 
             if speculation_ok(self.plugin_config, have_manifests=True):
@@ -390,7 +404,7 @@ class SchedulerEngine:
                 with TRACER.span("speculative_replay", pods=len(pending),
                                  nodes=len(nodes)):
                     rr, spec_stats = replay_speculative(
-                        cw, self.mesh, pods=pending,
+                        cw, mesh, pods=pending,
                         namespaces=self._list_shared("namespaces"))
                     TRACER.count("speculative_rounds_total",
                                  spec_stats["rounds"])
@@ -408,7 +422,7 @@ class SchedulerEngine:
             # the rest — decode per pod so an aborted wave wastes nothing
             with TRACER.span("device_replay", pods=len(pending), nodes=len(nodes)):
                 rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                            mesh=self.mesh)
+                            mesh=mesh)
             all_annotations = _LazyDecode(rr)
         else:
             # stream: each chunk decodes (host, thread pool) as soon as its
@@ -417,7 +431,7 @@ class SchedulerEngine:
             with TRACER.span("replay_and_decode_stream", pods=len(pending),
                              nodes=len(nodes)):
                 rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                            mesh=self.mesh,
+                            mesh=mesh,
                             on_chunk=lambda rr_, lo, hi: decode_chunk_into(
                                 rr_, lo, hi, all_annotations))
         return self._finish_wave(cw, rr, all_annotations, pending, exclude)

@@ -67,6 +67,17 @@ def _node_axis_spec(x, n_nodes: int, skip_leading: bool):
     return P(*spec)
 
 
+def can_shard(n_nodes: int, mesh: Mesh | None) -> bool:
+    """Whether shard_workload accepts this node count on this mesh — the
+    single divisibility predicate shared with callers that degrade to an
+    unsharded replay instead of erroring (the engine's live waves: a real
+    cluster's node count need not divide the mesh)."""
+    if mesh is None:
+        return False
+    shards = mesh.shape.get("nodes", 1)
+    return shards <= 1 or n_nodes % shards == 0
+
+
 def shard_workload(cw: CompiledWorkload, mesh: Mesh) -> CompiledWorkload:
     """A copy of `cw` with statics/xs/carry placed node-axis-sharded over
     the mesh (the input workload is left untouched so unsharded replays of

@@ -114,3 +114,40 @@ def test_engine_soak(seed):
     # final wave picks up any pods that became schedulable after deletes
     engine.schedule_pending()
     check_invariants(store)
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_engine_soak_dp_mesh(seed):
+    """The soak's config churn / priority mix / deletion rounds, run on a
+    dp>1 mesh: waves route through the speculative path when the active
+    plugin set qualifies and must land in the same invariant-clean state
+    as the scan engine on an identical store."""
+    from kube_scheduler_simulator_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(seed)
+    nodes = make_nodes(int(rng.integers(6, 14)), seed=seed,
+                       taint_fraction=0.25)
+    pod_rounds = [
+        make_pods(int(rng.integers(4, 14)), seed=seed * 10 + r,
+                  with_affinity=True, with_tolerations=True, with_spread=True)
+        for r in range(3)
+    ]
+
+    def run(mesh):
+        store = ObjectStore()
+        for n in nodes:
+            store.create("nodes", n)
+        engine = SchedulerEngine(store, mesh=mesh, chunk=16)
+        for r, pods in enumerate(pod_rounds):
+            for p in pods:
+                q = {"metadata": dict(p["metadata"]), "spec": dict(p["spec"])}
+                q["metadata"]["name"] = f"r{r}-{p['metadata']['name']}"
+                store.create("pods", q)
+            engine.schedule_pending()
+            check_invariants(store)
+        return {p["metadata"]["name"]: (p.get("spec") or {}).get("nodeName")
+                for p in store.list("pods")[0]}
+
+    mesh_out = run(make_mesh(4, dp=2))
+    base_out = run(None)
+    assert mesh_out == base_out
