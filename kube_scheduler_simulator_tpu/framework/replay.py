@@ -341,8 +341,12 @@ def _fetch_chunk(out) -> dict[str, np.ndarray]:
     """Blocking D2H of one chunk's outputs (runs on a fetch thread so the
     transfer overlaps later chunks' device compute — the copy starts the
     moment the chunk's results exist, and np.asarray releases the GIL
-    while it waits on the tunnel)."""
-    return {f: np.asarray(getattr(out, f)) for f in out._fields}
+    while it waits on the tunnel).  ascontiguousarray: on TPU the fetched
+    array keeps the DEVICE layout (e.g. strides (1,10,5) for a [C,S,N]
+    int8), and the native codec walks raw pointers assuming C order — a
+    strided buffer silently decodes neighboring pods' values."""
+    return {f: np.ascontiguousarray(np.asarray(getattr(out, f)))
+            for f in out._fields}
 
 
 def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
@@ -455,10 +459,7 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
             xs_chunk["is_pad"] = (jnp.arange(chunk) >= (hi - lo))
             carry, out = scan_jit(carry, xs_chunk)
             outs.append(_TinyOut(out))
-        chunks = [
-            {f: np.asarray(getattr(o, f)) for f in _TinyOut._fields}
-            for o in outs
-        ]
+        chunks = [_fetch_chunk(o) for o in outs]
 
         def cat(field: str) -> np.ndarray:
             pieces = [c[field] for c in chunks]

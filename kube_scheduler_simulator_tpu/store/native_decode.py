@@ -229,6 +229,10 @@ def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
     cc = rr._compact
     ci, r = divmod(i, cc.chunk)
     packed = cc.packed[ci]
+    if not packed.flags["C_CONTIGUOUS"]:
+        # device-layout fetch (TPU backends can return strided host
+        # arrays); the C codec walks raw pointers in C order
+        packed = cc.packed[ci] = np.ascontiguousarray(packed)
     code_bits = PACK_MODES[cc.pack_mode][1]
     prow = packed[r]
 
@@ -239,6 +243,9 @@ def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
     if want_scores:
         for q, (group, row) in enumerate(cc.score_cols):
             arr = getattr(cc, group)[ci]
+            if not arr.flags["C_CONTIGUOUS"]:
+                arr = np.ascontiguousarray(arr)
+                getattr(cc, group)[ci] = arr
             col = arr[r, row]
             cols_alive.append(col)
             col_ptrs[q] = col.ctypes.data

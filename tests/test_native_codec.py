@@ -113,3 +113,34 @@ def test_history_splice_matches_full_marshal():
     got = pod["metadata"]["annotations"][ann.RESULT_HISTORY]
     assert got == ann.marshal(records)
     assert json.loads(got) == records
+
+
+def test_fused_decode_on_device_layout_strides(monkeypatch):
+    """TPU fetches can return host arrays in the DEVICE layout (non-C
+    strides); the fused decoder hands raw pointers to C, so a strided
+    compact chunk must be renormalized, not walked as-if-contiguous
+    (round-4 real-TPU parity failure: score-result read the next pod's
+    value)."""
+    import numpy as np
+
+    nodes, pods, cfg = baseline_config(1, scale=0.05, seed=0)
+    cw = compile_workload(nodes, pods, cfg)
+    rr = replay(cw, chunk=64)
+    cc = rr._compact
+
+    def restride(a):
+        # transpose-copy-transpose: same values, F-order memory like a
+        # TPU minor-to-major fetch
+        return np.asfortranarray(a)
+
+    for field in ("packed", "raw8", "raw16", "raw32"):
+        setattr(cc, field, [restride(x) for x in getattr(cc, field)])
+        for x in getattr(cc, field):
+            assert x.size == 0 or not x.flags["C_CONTIGUOUS"] or x.ndim < 2
+
+    strided = [decode_pod_result(rr, i) for i in range(len(pods))]
+
+    monkeypatch.setenv("KSS_TPU_DISABLE_NATIVE", "1")
+    pure = [decode_pod_result(rr, i) for i in range(len(pods))]
+    for i, (sa, pa) in enumerate(zip(strided, pure)):
+        assert sa == pa, f"pod {i}: strided fused decode diverged"
