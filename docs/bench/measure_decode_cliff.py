@@ -1,0 +1,73 @@
+"""Quantify the release-and-reuse decode fix on the bench host.
+
+Measures the streamed-decode consumer's sustained rate at the 5k-node
+shape with the process pushed past the host's ~8 GB page-backing cliff
+(docs/bench/r04-host-page-backing.json), in three regimes:
+  hold      — every pod's annotation strings kept live (the old bench
+              consumer; every page is a fresh fault)
+  release   — strings dropped after size-accounting (reference reflector
+              semantics) with default glibc (munmap on free -> re-fault)
+  release+mallopt — plus tune_host_allocator() (arena reuse, no faults)
+
+Writes docs/bench/r04-decode-cliff.json.  Run on an idle host.
+"""
+
+import json
+import resource
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from kube_scheduler_simulator_tpu.utils.platform import force_cpu
+
+force_cpu()
+
+import numpy as np
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.models.workloads import baseline_config
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+from kube_scheduler_simulator_tpu.store.decode import decode_chunk_into
+from kube_scheduler_simulator_tpu.utils.platform import tune_host_allocator
+
+N_PODS = 600
+
+nodes, pods, cfg = baseline_config(4, scale=0.06, seed=0, node_scale=1.0)
+cw = compile_workload(nodes, pods, cfg)
+rr = replay(cw, chunk=512)
+ballast = np.ones(int(8.3e9 // 8), dtype=np.float64)  # touch past the cliff
+rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def run(tag, hold):
+    kept = []
+    t0 = time.time()
+    total = 0
+    n = min(N_PODS, len(pods))
+    for lo in range(0, n, 512):
+        hi = min(lo + 512, n)
+        sink = [None] * (hi - lo)
+        decode_chunk_into(rr, lo, hi, sink, base=lo)
+        total += sum(sum(len(v) for v in a.values()) for a in sink if a)
+        if hold:
+            kept.append(sink)
+    dt = time.time() - t0
+    rate = n / dt
+    print(f"{tag}: {dt:.2f}s -> {rate:.0f} pods/s ({total/1e9:.2f} GB built)",
+          flush=True)
+    return round(rate, 1)
+
+
+out = {"rss_gb_before": round(rss0, 2), "pods": min(N_PODS, len(pods)),
+       "nodes": len(nodes)}
+out["hold_pods_per_sec"] = run("hold           ", hold=True)
+out["release_pods_per_sec"] = run("release        ", hold=False)
+out["mallopt_applied"] = tune_host_allocator()
+out["release_mallopt_pods_per_sec"] = run("release+mallopt", hold=False)
+out["release_mallopt_pass2"] = run("release+mallopt (pass 2)", hold=False)
+
+Path(__file__).with_name("r04-decode-cliff.json").write_text(
+    json.dumps(out, indent=1))
+print(json.dumps(out))

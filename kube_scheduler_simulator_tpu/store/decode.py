@@ -282,17 +282,19 @@ def _decode_pool():
     return _DECODE_POOL
 
 
-def decode_chunk_into(rr, lo: int, hi: int, out: list) -> None:
-    """Decode pods lo..hi of one replay chunk into out[lo:hi] — the
-    replay(on_chunk=...) streaming consumer: runs on the dispatch thread
-    while the device executes later chunks.  Idempotent per index (a
-    width-tier rerun re-delivers chunks)."""
+def decode_chunk_into(rr, lo: int, hi: int, out: list, base: int = 0) -> None:
+    """Decode pods lo..hi of one replay chunk into out[lo-base:hi-base] —
+    the replay(on_chunk=...) streaming consumer: runs on the dispatch
+    thread while the device executes later chunks.  Idempotent per index
+    (a width-tier rerun re-delivers chunks).  base: offset for callers
+    passing a chunk-local sink (out[i-base]) instead of a queue-length
+    list."""
     cc = getattr(rr, "_compact", None)
     if hi - lo < 64 or effective_cpu_count() < 2:
         # single-core hosts: the pool's dispatch + recon-lock traffic
         # costs more than the GIL-released C calls can win back
         for i in range(lo, hi):
-            out[i] = decode_pod_result(rr, i)
+            out[i - base] = decode_pod_result(rr, i)
         return
     if cc is not None and _native_ctx(rr.cw) is None:
         # pure-Python path reads codes_of/raw_of/final_of: reconstruct the
@@ -304,7 +306,7 @@ def decode_chunk_into(rr, lo: int, hi: int, out: list) -> None:
     for i, a in zip(range(lo, hi),
                     _decode_pool().map(lambda i: decode_pod_result(rr, i),
                                        range(lo, hi))):
-        out[i] = a
+        out[i - base] = a
 
 
 def decode_all_parallel(rr: ReplayResult,

@@ -53,3 +53,29 @@ def effective_cpu_count() -> int:
         return len(os.sched_getaffinity(0)) or 1
     except (AttributeError, OSError):
         return os.cpu_count() or 1
+
+
+def tune_host_allocator() -> bool:
+    """Keep glibc from returning freed large blocks to the kernel.
+
+    The annotation product cycles multi-MB JSON strings; above the default
+    mmap threshold (128 KiB) each one is mmap'd and munmap'd, so every
+    build page-faults fresh pages — ruinous on hosts whose first-touch
+    bandwidth collapses at high resident set (this bench host: ~10x past
+    ~8 GB, docs/bench/r04-host-page-backing.json).  Raising the thresholds
+    makes the arena REUSE freed pages: steady-state string churn touches
+    already-backed memory and never faults.  For BATCH processes (the
+    bench, one-shot replays) only — with trim disabled a long-lived
+    server would hold its peak heap forever.  Returns True when applied
+    (glibc only; silently a no-op elsewhere)."""
+    import ctypes
+
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        mallopt = libc.mallopt
+    except (OSError, AttributeError):
+        return False
+    M_TRIM_THRESHOLD, M_MMAP_THRESHOLD = -1, -3
+    ok = mallopt(M_MMAP_THRESHOLD, 1 << 30)   # strings stay in the arena
+    ok &= mallopt(M_TRIM_THRESHOLD, 1 << 30)  # arena keeps freed pages
+    return bool(ok)
