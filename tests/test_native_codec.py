@@ -144,3 +144,21 @@ def test_fused_decode_on_device_layout_strides(monkeypatch):
     pure = [decode_pod_result(rr, i) for i in range(len(pods))]
     for i, (sa, pa) in enumerate(zip(strided, pure)):
         assert sa == pa, f"pod {i}: strided fused decode diverged"
+
+
+def test_decode_chunk_into_base_offset():
+    """decode_chunk_into with a chunk-local sink (base=lo) fills the same
+    annotations as the whole-queue list — the bench's release-after-build
+    consumer depends on it."""
+    nodes, pods, cfg = baseline_config(1, scale=0.05, seed=1)
+    cw = compile_workload(nodes, pods, cfg)
+    rr = replay(cw, chunk=4)
+    from kube_scheduler_simulator_tpu.store.decode import decode_chunk_into
+
+    whole: list = [None] * len(pods)
+    decode_chunk_into(rr, 0, len(pods), whole)
+    for lo in range(0, len(pods), 4):
+        hi = min(lo + 4, len(pods))
+        sink = [None] * (hi - lo)
+        decode_chunk_into(rr, lo, hi, sink, base=lo)
+        assert sink == whole[lo:hi]
