@@ -13,6 +13,8 @@ while true; do
     echo "$ts probe: ALIVE -> running bench.py" >> "$LOG"
     python bench.py > docs/bench/r04-tpu-bench.json 2> docs/bench/r04-tpu-bench.err
     echo "$(date -u +%FT%TZ) bench rc=$? (json+err under docs/bench/)" >> "$LOG"
+    timeout 1800 python docs/bench/unroll_sweep.py > docs/bench/r04-unroll-sweep.log 2>&1
+    echo "$(date -u +%FT%TZ) unroll sweep rc=$?" >> "$LOG"
     exit 0
   fi
   echo "$ts probe: dead" >> "$LOG"

@@ -1,0 +1,37 @@
+"""Device-only replay rate vs lax.scan unroll, config 4, on the live
+backend.  Run by tpu_watch.sh after a successful bench so the unroll
+choice (bench.py --unroll default) is grounded on-device, not on the CPU
+backend.  Writes r04-unroll-sweep.json next to this file."""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+
+from kube_scheduler_simulator_tpu.framework.replay import replay
+from kube_scheduler_simulator_tpu.models.workloads import baseline_config
+from kube_scheduler_simulator_tpu.state.compile import compile_workload
+
+print("devices:", jax.devices(), flush=True)
+nodes, pods, cfg = baseline_config(4, scale=1.0, seed=0)
+cw = compile_workload(nodes, pods, cfg)
+out = {"pods": len(pods), "nodes": len(nodes),
+       "backend": jax.default_backend(), "rates": {}}
+for unroll in (1, 2, 4, 8):
+    t0 = time.time()
+    rr = replay(cw, chunk=1024, collect=False, unroll=unroll)  # compile+run
+    warm_s = time.time() - t0
+    t0 = time.time()
+    rr = replay(cw, chunk=1024, collect=False, unroll=unroll)
+    dt = time.time() - t0
+    rate = round(len(pods) / dt, 1)
+    out["rates"][str(unroll)] = {"cycles_per_sec": rate,
+                                 "compile_plus_run_s": round(warm_s, 1)}
+    print(f"unroll {unroll}: {rate} cycles/s (first run {warm_s:.1f}s)",
+          flush=True)
+Path(__file__).with_name("r04-unroll-sweep.json").write_text(
+    json.dumps(out, indent=1))
