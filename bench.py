@@ -46,12 +46,22 @@ def log(*a):
 def run_parity_gate(idx: int, scale: float, seed: int) -> bool:
     from kube_scheduler_simulator_tpu.framework.replay import replay
     from kube_scheduler_simulator_tpu.models.workloads import baseline_config
-    from kube_scheduler_simulator_tpu.reference_impl.parallel import ParallelScheduler
+    from kube_scheduler_simulator_tpu.reference_impl.parallel import (
+        OracleWorkerError, ParallelScheduler)
     from kube_scheduler_simulator_tpu.state.compile import compile_workload
     from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
 
     nodes, pods, cfg = baseline_config(idx, scale=scale, seed=seed)
-    oracle = ParallelScheduler(nodes, pods, cfg, parallelism=8).schedule_all()
+    try:
+        oracle = ParallelScheduler(nodes, pods, cfg, parallelism=8).schedule_all()
+    except OracleWorkerError as e:
+        # a worker died or deadlocked (fork-after-JAX-threads hazard) —
+        # the sequential oracle is the ground truth anyway, just slower
+        log(f"parallel oracle failed ({e}); gating against the sequential oracle")
+        from kube_scheduler_simulator_tpu.reference_impl.sequential import (
+            SequentialScheduler)
+
+        oracle = SequentialScheduler(nodes, pods, cfg).schedule_all()
     rr = replay(compile_workload(nodes, pods, cfg), chunk=64)
     for i, (sa, _) in enumerate(oracle):
         da = decode_pod_result(rr, i)
@@ -390,6 +400,13 @@ def main():
     ap.add_argument("--assume-fallback", action="store_true",
                     help=argparse.SUPPRESS)  # set by the crash re-exec
     args = ap.parse_args()
+    # the parity gates' parallel-oracle workers must not fork from this
+    # process once JAX threads exist (deadlock hazard); start their
+    # forkserver NOW, while we are still single-threaded
+    from kube_scheduler_simulator_tpu.reference_impl.parallel import (
+        warm_forkserver)
+
+    warm_forkserver()
     try:
         _run(args)
     except SystemExit:

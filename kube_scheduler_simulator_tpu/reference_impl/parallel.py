@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import pickle
 
 from . import sequential as seq_mod
 from .sequential import SequentialScheduler
@@ -43,9 +44,74 @@ MAX_NODE_SCORE = seq_mod.MAX_NODE_SCORE
 
 DEFAULT_PARALLELISM = 16  # upstream parallelism default
 
+# forking from a JAX-multithreaded parent can deadlock the child (it
+# inherits locked malloc/logging mutexes whose owner threads don't exist
+# after fork) — observed as a wedged bench parity gate.  Workers therefore
+# come from a forkserver: its server process is forked ONCE, ideally
+# before any JAX threads exist (call warm_forkserver() at process start),
+# and every worker forks from that clean server.  Falls back to plain
+# fork when the forkserver can't pickle the workload (exotic configs).
+_MP_METHOD = os.environ.get("KSS_TPU_ORACLE_MP", "forkserver")
+
+# one finite bound turns a deadlocked worker into a diagnosable error;
+# covers worker startup (a full SequentialScheduler init) and the
+# slowest per-cycle node slice
+_RECV_TIMEOUT_S = float(os.environ.get("KSS_TPU_ORACLE_TIMEOUT", "600"))
+
+
+class OracleWorkerError(RuntimeError):
+    """A parallel-oracle worker died or stopped responding."""
+
+
+def warm_forkserver() -> None:
+    """Start the forkserver while the process is still single-threaded.
+    Call before the first JAX touch; later ParallelScheduler workers then
+    fork from the clean server regardless of the caller's thread state."""
+    if _MP_METHOD != "forkserver":
+        return
+    try:
+        ctx = mp.get_context("forkserver")
+        # preload: workers fork from the server WITH the package already
+        # imported (jax import included — import alone starts no backend),
+        # instead of each worker re-importing it
+        ctx.set_forkserver_preload([__name__])
+        p = ctx.Process(target=_noop, daemon=True)
+        p.start()
+        p.join(timeout=30)
+    except Exception:  # pragma: no cover - best effort
+        pass
+
+
+def _noop():
+    return None
+
+
+def _main_is_importable() -> bool:
+    import sys
+
+    main = sys.modules.get("__main__")
+    path = getattr(main, "__file__", None)
+    return path is None or os.path.exists(path)
+
+
+def _recv(conn, proc, timeout: float = _RECV_TIMEOUT_S):
+    """conn.recv with a liveness bound (a vanished or deadlocked worker
+    raises OracleWorkerError instead of hanging the caller forever)."""
+    try:
+        if not conn.poll(timeout):
+            raise OracleWorkerError(
+                f"oracle worker pid={proc.pid} unresponsive after "
+                f"{timeout:.0f}s (exitcode={proc.exitcode})")
+        return conn.recv()
+    except (EOFError, BrokenPipeError, OSError) as e:
+        raise OracleWorkerError(
+            f"oracle worker pid={proc.pid} died "
+            f"(exitcode={proc.exitcode})") from e
+
 
 def _worker_main(conn, nodes, pods, config, bound_pods, volumes, namespaces,
                  lo, hi):
+    conn.send(("ready",))  # master's startup handshake
     seq = SequentialScheduler(nodes, pods, config, bound_pods=bound_pods,
                               volumes=volumes, namespaces=namespaces)
     msg_ids: dict[str, int] = {}
@@ -126,24 +192,64 @@ class ParallelScheduler:
         n = self.master.n
         workers = max(1, min(parallelism, n, os.cpu_count() or parallelism))
         bounds = [round(k * n / workers) for k in range(workers + 1)]
-        ctx = mp.get_context("fork")
         self._conns = []
         self._procs = []
         self._msgs: list[list[str]] = []  # per-worker interned msg tables
         self._pending_bind: tuple[int, int] | None = None
-        for k in range(workers):
-            parent, child = ctx.Pipe()
-            proc = ctx.Process(
-                target=_worker_main,
-                args=(child, nodes, pods, self.master.config, bound_pods,
-                      volumes, namespaces, bounds[k], bounds[k + 1]),
-                daemon=True,
-            )
-            proc.start()
-            child.close()
-            self._conns.append(parent)
-            self._procs.append(proc)
-            self._msgs.append([])
+        last_exc: BaseException | None = None
+        methods = ((_MP_METHOD, "fork") if _MP_METHOD != "fork"
+                   else ("fork",))
+        if _MP_METHOD == "forkserver" and not _main_is_importable():
+            # spawn-family workers re-import __main__; a REPL/stdin main
+            # has no file to import, so forkserver workers die on arrival
+            methods = ("fork",)
+        for method in methods:
+            ctx = mp.get_context(method)
+            if method == "forkserver":
+                try:  # no-op once the server is already running
+                    ctx.set_forkserver_preload([__name__])
+                except Exception:
+                    pass
+            try:
+                for k in range(workers):
+                    parent, child = ctx.Pipe()
+                    proc = ctx.Process(
+                        target=_worker_main,
+                        args=(child, nodes, pods, self.master.config,
+                              bound_pods, volumes, namespaces,
+                              bounds[k], bounds[k + 1]),
+                        daemon=True,
+                    )
+                    proc.start()
+                    child.close()
+                    self._conns.append(parent)
+                    self._procs.append(proc)
+                    self._msgs.append([])
+                # readiness handshake: a worker whose interpreter failed
+                # to come up (forkserver can't re-import some callers'
+                # __main__; fork can inherit a wedged thread state) shows
+                # up HERE, while falling back to the next method is still
+                # possible
+                for c, p in zip(self._conns, self._procs):
+                    if _recv(c, p, timeout=120)[0] != "ready":
+                        raise OracleWorkerError(
+                            f"worker pid={p.pid} sent a non-ready first "
+                            "message")
+                break
+            except (pickle.PicklingError, TypeError, OSError,
+                    mp.ProcessError, OracleWorkerError) as e:
+                # forkserver pickles the args (PicklingError/TypeError) —
+                # an unpicklable workload, a dead-on-arrival worker, or
+                # fd/process exhaustion falls back to plain fork (which
+                # accepts the fork-after-threads risk, bounded by _recv's
+                # timeout)
+                last_exc = e
+                self.close()
+                self._msgs = []
+        if not self._procs:
+            raise OracleWorkerError(
+                "no oracle worker survived startup under any start "
+                "method") from last_exc
 
     def close(self):
         for c in self._conns:
@@ -154,6 +260,9 @@ class ParallelScheduler:
                 pass
         for p in self._procs:
             p.join(timeout=5)
+            if p.exitcode is None:  # wedged: reap it
+                p.terminate()
+                p.join(timeout=5)
         self._conns, self._procs = [], []
 
     def __enter__(self):
@@ -190,7 +299,7 @@ class ParallelScheduler:
         feasible: list[int] = []
         worker_raws: list[tuple[list[int], list[list[int]]]] = []
         for w, c in enumerate(self._conns):
-            fails, feas, raws, new_msgs = c.recv()
+            fails, feas, raws, new_msgs = _recv(c, self._procs[w])
             self._msgs[w].extend(new_msgs)
             table = self._msgs[w]
             for j, n_passed, mid in fails:
