@@ -346,9 +346,11 @@ def build_step(cw, out_mode: str = "full", pack_mode: str = "p16",
     (replay passes a slim view so cached jits don't pin per-pod data).
     out_mode "full" -> StepOut; "compact" -> CompactOut (first-fail-packed
     filters, narrow raw scores, no finalscore — see CompactOut).
-    score_dtypes: per-scorer "i8"/"i16" group assignment (compact mode);
-    wide_raw "i32"/"i64" pools every scorer into the raw32 field at that
-    width after an overflow (the replay's widening ladder)."""
+    score_dtypes: per-scorer "i8"/"i16"/"i32"/"host" group assignment
+    (compact mode; "host" = the raw is a precompiled host-resident row and
+    is omitted from the device outputs entirely);
+    wide_raw "i32"/"i64" pools every transferred scorer into the raw32
+    field at that width after an overflow (the replay's widening ladder)."""
     cfg = cw.config
     filter_names = cfg.filters()
     score_names = cfg.scorers()
@@ -371,7 +373,10 @@ def build_step(cw, out_mode: str = "full", pack_mode: str = "p16",
         if out_mode == "compact":
             groups: dict[str, list] = {"i8": [], "i16": [], "i32": []}
             for s in range(len(score_names)):
-                g = "i32" if wide_raw else score_dtypes[s]
+                g = score_dtypes[s]
+                if g == "host":
+                    continue  # precompiled host row: never travels D2H
+                g = "i32" if wide_raw else g
                 groups[g].append(score_raw[s])
             n = cw.n_nodes
 

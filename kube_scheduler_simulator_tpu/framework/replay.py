@@ -163,7 +163,21 @@ class ReplayResult:
             if "ignored" not in d:  # scores-only cost; codes path skips it
                 d["ignored"] = self._tsp_ignored_chunk(ci, c, n)
             raw = np.empty((c, len(cc.score_cols), n), np.int64)
+            static_rows = self.cw.host.get("static_score_rows", {})
+            sskip = self.cw.host.get("score_skip", {})
+            lo = ci * cc.chunk
             for s, (group, row) in enumerate(cc.score_cols):
+                if group == "host":
+                    # precompiled row, never transferred; mask skipped pods
+                    # to 0 exactly as the device output did
+                    src = static_rows[row]
+                    hi = min(lo + c, src.shape[0])
+                    m = hi - lo
+                    raw[:, s, :] = 0
+                    if m > 0:
+                        skip = np.asarray(sskip[row][lo:hi], bool)
+                        raw[:m, s, :] = np.where(skip[:, None], 0, src[lo:hi])
+                    continue
                 raw[:, s, :] = getattr(cc, group)[ci][:, row, :]
             d["raw"] = raw
             d["final"] = hostnorm.finalize_chunk(
@@ -413,7 +427,12 @@ def _compact_plan(cw: CompiledWorkload, wide: str | None):
         "score_dtypes", tuple("i16" for _ in cw.config.scorers()))
     counts = {"i8": 0, "i16": 0, "i32": 0}
     cols = []
-    for g in score_dtypes:
+    for name, g in zip(cw.config.scorers(), score_dtypes):
+        if g == "host":
+            # precompiled host-resident raw (cw.host["static_score_rows"]):
+            # reconstructed from the host copy, never transferred
+            cols.append(("host", name))
+            continue
         g = "i32" if wide else g  # widened runs pool every scorer in raw32
         cols.append(({"i8": "raw8", "i16": "raw16", "i32": "raw32"}[g], counts[g]))
         counts[g] += 1

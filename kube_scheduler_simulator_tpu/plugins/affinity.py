@@ -46,7 +46,8 @@ class NodeAffinityXS(NamedTuple):
 
 
 def build(table: NodeTable, pods: list[dict],
-          args: dict | None = None) -> NodeAffinityXS:
+          args: dict | None = None,
+          host_out: dict | None = None) -> NodeAffinityXS:
     n, p = table.n, len(pods)
     required_ok = np.ones((p, n), dtype=bool)
     pref_raw = np.zeros((p, n), dtype=np.int32)
@@ -105,6 +106,12 @@ def build(table: NodeTable, pods: list[dict],
                 pref_rows[key] = row
             pref_raw[i] = row if added_pref_row is None else (row + added_pref_row)
 
+    if host_out is not None:
+        # the raw score IS this precompiled row (score_kernel is a pure
+        # pass-through), so the compact replay never transfers it back
+        # from the device — the decoder reads this host copy directly
+        # (framework/replay.py "host" score group)
+        host_out.setdefault("static_score_rows", {})[NAME] = pref_raw
     return NodeAffinityXS(
         required_ok=jnp.asarray(required_ok),
         pref_raw=jnp.asarray(pref_raw),

@@ -126,7 +126,8 @@ class CustomXS(NamedTuple):
     scores: jnp.ndarray  # [P, N] int64
 
 
-def build_custom(plugin: CustomPlugin, table, pods: list[dict], node_manifests: list[dict]):
+def build_custom(plugin: CustomPlugin, table, pods: list[dict], node_manifests: list[dict],
+                 name: str | None = None, host_out: dict | None = None):
     """-> (CustomXS, msg_table) — messages interned per plugin.
 
     A plugin with normalize() compiles like any other; its NormalizeScore
@@ -149,4 +150,9 @@ def build_custom(plugin: CustomPlugin, table, pods: list[dict], node_manifests: 
                     codes[i, j] = 1 + mid
             if plugin.has_score:
                 scores[i, j] = int(plugin.score(pod, node_manifests[j]))
+    if host_out is not None and name is not None and plugin.has_score:
+        # custom raw scores are fully precompiled per (pod, node): the
+        # compact replay reads this host copy instead of transferring the
+        # row back from the device (framework/replay.py "host" group)
+        host_out.setdefault("static_score_rows", {})[name] = scores
     return CustomXS(codes=jnp.asarray(codes), scores=jnp.asarray(scores)), msgs

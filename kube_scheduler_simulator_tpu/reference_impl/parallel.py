@@ -293,8 +293,16 @@ class ParallelScheduler:
         scorer_names = [n for n in cfg.scorers() if not m._score_skip(n, pod)]
 
         bind, self._pending_bind = self._pending_bind, None
-        for c in self._conns:
-            c.send(("eval", pod_idx, active, scorer_names, bind))
+        for c, p in zip(self._conns, self._procs):
+            try:
+                c.send(("eval", pod_idx, active, scorer_names, bind))
+            except (BrokenPipeError, OSError) as e:
+                # a worker died between cycles (e.g. OOM-killed): surface
+                # it as OracleWorkerError so callers' sequential-oracle
+                # fallback catches it instead of a raw pipe error
+                raise OracleWorkerError(
+                    f"worker pid={p.pid} pipe closed on send "
+                    f"(exitcode={p.exitcode}): {e}") from e
         filter_map: dict[str, dict[str, str]] = {}
         feasible: list[int] = []
         worker_raws: list[tuple[list[int], list[list[int]]]] = []

@@ -159,7 +159,7 @@ def compile_workload(
 
     if "NodeAffinity" in enabled:
         xs["NodeAffinity"] = affinity.build(
-            table, pods, args=config.args.get("NodeAffinity"))
+            table, pods, args=config.args.get("NodeAffinity"), host_out=host)
     if "NodePorts" in enabled:
         st, x, carry = ports.build(table, pods, bound_pods)
         statics["NodePorts"] = st
@@ -223,7 +223,8 @@ def compile_workload(
             continue
         from ..plugins.custom import build_custom
 
-        x, msg_table = build_custom(plugin, table, pods, nodes)
+        x, msg_table = build_custom(plugin, table, pods, nodes,
+                                    name=name, host_out=host)
         xs[name] = x
         host.setdefault("custom_msgs", {})[name] = msg_table
     if "InterPodAffinity" in enabled:
@@ -379,6 +380,11 @@ _SCORE_I8_SAFE = frozenset({
 
 
 def _score_dtype(cw: CompiledWorkload, name: str) -> str:
+    if name in cw.host.get("static_score_rows", {}):
+        # raw is a precompiled host-resident [P, N] row (NodeAffinity
+        # pref_raw, custom scores): it never travels back from the device
+        # — the replay's compact plan reads the host copy directly
+        return "host"
     if name in _SCORE_I8_SAFE:
         return "i8"
     if name == "TaintToleration":
@@ -387,7 +393,12 @@ def _score_dtype(cw: CompiledWorkload, name: str) -> str:
             return "i8"
         return "i16"
     # raws that are fully precompiled per (pod, node) have an exact
-    # compile-time bound (the kernels just emit the row)
+    # compile-time bound (the kernels just emit the row).  NOTE: with
+    # compile_workload stashing static_score_rows, NodeAffinity and
+    # score-bearing custom plugins return "host" above and never reach
+    # this block; it stays as the defensive transfer-dtype fallback for
+    # rows built without the host stash (and for custom plugins whose
+    # CustomXS carries a scores field but has_score is False -> bound 0)
     x = cw.xs.get(name)
     rows = None
     if name == "NodeAffinity" and x is not None:

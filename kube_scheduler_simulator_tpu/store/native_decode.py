@@ -241,7 +241,18 @@ def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
     col_elem = (ctypes.c_int32 * s)()
     cols_alive = []
     if want_scores:
+        static_rows = rr.cw.host.get("static_score_rows", {})
         for q, (group, row) in enumerate(cc.score_cols):
+            if group == "host":
+                # precompiled host-resident raw ([P, N] C-contiguous
+                # numpy); sskip'd scorers are never read by the C codec,
+                # so the unmasked row is safe to hand over
+                src = static_rows[row]
+                col = src[hi]
+                cols_alive.append(col)
+                col_ptrs[q] = col.ctypes.data
+                col_elem[q] = src.dtype.itemsize
+                continue
             arr = getattr(cc, group)[ci]
             if not arr.flags["C_CONTIGUOUS"]:
                 arr = np.ascontiguousarray(arr)

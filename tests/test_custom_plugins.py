@@ -177,8 +177,8 @@ def test_extender_duration_and_nodes_response():
 
 class HugeScorer(CustomPlugin):
     """Scores beyond int32 (upstream node scores are int64): the compact
-    replay must pick the i64 transfer tier straight from the compile-time
-    bound instead of rediscovering the overflow at runtime."""
+    replay keeps the precompiled row host-resident ("host" group) so the
+    full-width values never travel from the device at all."""
 
     name = "HugeScorer"
     default_weight = 1
@@ -195,7 +195,9 @@ def test_custom_scores_beyond_int32_round_trip():
         custom={"HugeScorer": HugeScorer()},
     )
     cw = compile_workload(nodes, pods, cfg)
-    assert "i64" in cw.host["score_dtypes"]
+    pos = cw.config.scorers().index("HugeScorer")
+    assert cw.host["score_dtypes"][pos] == "host"
+    assert (cw.host["static_score_rows"]["HugeScorer"] > (1 << 33) - 1).any()
     seq = SequentialScheduler(nodes, pods, cfg).schedule_all()
     rr = replay(cw, chunk=4)
     for i, (sa, ss) in enumerate(seq):
