@@ -7,7 +7,7 @@ queue-length independent: [N]-shaped rows) and sums the actual transferred
 chunk bytes, splitting out rows that stayed host-resident
 ("host" score group, framework/replay.py) as the saving.
 
-Usage: JAX_PLATFORMS=cpu python docs/bench/payload_bytes.py
+Usage: python docs/bench/payload_bytes.py  (hermetically CPU-backed)
 Writes docs/bench/r04-payload-bytes.json.
 """
 
@@ -16,6 +16,10 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from kube_scheduler_simulator_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu()  # the axon sitecustomize hook ignores JAX_PLATFORMS=cpu
 
 from kube_scheduler_simulator_tpu.framework.replay import replay  # noqa: E402
 from kube_scheduler_simulator_tpu.models.workloads import baseline_config  # noqa: E402
@@ -29,8 +33,12 @@ def measure(idx: int, scale: float = 0.02) -> dict:
     cc = rr._compact
     p = len(pods)
     n = len(nodes)
-    transferred = sum(a.nbytes for group in (cc.packed, cc.raw8, cc.raw16, cc.raw32)
-                      for a in group)
+    # per-POD bytes = per-row bytes: the last chunk is padded to the full
+    # chunk size, so divide by the padded row count, not by p
+    total_rows = sum(a.shape[0] for a in cc.packed)
+    transferred = round(sum(
+        a.nbytes for group in (cc.packed, cc.raw8, cc.raw16, cc.raw32)
+        for a in group) * p / max(total_rows, 1))
     host_rows = [name for g, name in cc.score_cols if g == "host"]
     # bytes those rows would have cost at their narrowest transfer dtype
     # (the pre-change behavior: bound-derived i8/i16/i32/i64)

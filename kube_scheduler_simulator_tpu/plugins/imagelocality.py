@@ -92,12 +92,17 @@ def score_for(pod: dict, states, n_nodes: int) -> np.ndarray:
     return out
 
 
-def build(nodes: list[dict], pods: list[dict]) -> ImageXS:
+def build(nodes: list[dict], pods: list[dict],
+          host_out: dict | None = None) -> ImageXS:
     states = node_image_states(nodes)
     n = len(nodes)
     score = np.zeros((len(pods), n), dtype=np.int64)
     for i, pod in enumerate(pods):
         score[i] = score_for(pod, states, n)
+    if host_out is not None:
+        # score_kernel is a pure pass-through of this precompiled row: the
+        # compact replay keeps it host-resident ("host" group, no D2H)
+        host_out.setdefault("static_score_rows", {})[NAME] = score
     return ImageXS(score=jnp.asarray(score))
 
 

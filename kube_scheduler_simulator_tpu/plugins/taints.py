@@ -54,7 +54,8 @@ class NodeNameXS(NamedTuple):
     fail: jnp.ndarray  # [P, N] bool
 
 
-def build_taints(table: NodeTable, pods: list[dict]) -> TaintXS:
+def build_taints(table: NodeTable, pods: list[dict],
+                 host_out: dict | None = None) -> TaintXS:
     n, p = table.n, len(pods)
     code = np.zeros((p, n), dtype=np.int16)
     prefer = np.zeros((p, n), dtype=np.int16)
@@ -78,6 +79,11 @@ def build_taints(table: NodeTable, pods: list[dict]) -> TaintXS:
             cached = (crow, prow)
             rows[cache_key] = cached
         code[i], prefer[i] = cached
+    if host_out is not None:
+        # the raw score IS this precompiled row (taint_score is a pure
+        # pass-through): the compact replay keeps it host-resident
+        # (framework/replay.py "host" score group) instead of paying D2H
+        host_out.setdefault("static_score_rows", {})[NAME_TAINT] = prefer
     return TaintXS(filter_code=jnp.asarray(code), prefer_count=jnp.asarray(prefer))
 
 

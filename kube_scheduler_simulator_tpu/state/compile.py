@@ -166,9 +166,9 @@ def compile_workload(
         xs["NodePorts"] = x
         init_carry["NodePorts"] = carry
     if "ImageLocality" in enabled:
-        xs["ImageLocality"] = imagelocality.build(nodes, pods)
+        xs["ImageLocality"] = imagelocality.build(nodes, pods, host_out=host)
     if "TaintToleration" in enabled:
-        xs["TaintToleration"] = taints.build_taints(table, pods)
+        xs["TaintToleration"] = taints.build_taints(table, pods, host_out=host)
     if "NodeUnschedulable" in enabled:
         xs["NodeUnschedulable"] = taints.build_unschedulable(table, pods)
     if "NodeName" in enabled:
@@ -210,6 +210,10 @@ def compile_workload(
             xs["VolumeBinding"] = x
             init_carry["VolumeBinding"] = carry
             rejects["VolumeBinding"] = vb_rejects
+            # VolumeCapacityPriority is off: Score is constant 0 for every
+            # (pod, node) — keep it host-resident (np.zeros is COW-cheap)
+            host.setdefault("static_score_rows", {})["VolumeBinding"] = (
+                np.zeros((p, table.n), dtype=np.int8))
         if "VolumeZone" in enabled:
             xs["VolumeZone"] = volumezone.build(vt, table, pods)
         if any(any(m is not None for m in msgs) for msgs in rejects.values()):
@@ -394,11 +398,13 @@ def _score_dtype(cw: CompiledWorkload, name: str) -> str:
         return "i16"
     # raws that are fully precompiled per (pod, node) have an exact
     # compile-time bound (the kernels just emit the row).  NOTE: with
-    # compile_workload stashing static_score_rows, NodeAffinity and
-    # score-bearing custom plugins return "host" above and never reach
-    # this block; it stays as the defensive transfer-dtype fallback for
-    # rows built without the host stash (and for custom plugins whose
-    # CustomXS carries a scores field but has_score is False -> bound 0)
+    # compile_workload stashing static_score_rows, NodeAffinity,
+    # TaintToleration, ImageLocality, VolumeBinding, and score-bearing
+    # custom plugins all return "host" above, so the TaintToleration
+    # branch, the ImageLocality/VolumeBinding _SCORE_I8_SAFE entries, and
+    # this block are defensive transfer-dtype fallbacks for rows built
+    # without the host stash (and for custom plugins whose CustomXS
+    # carries a scores field but has_score is False -> bound 0)
     x = cw.xs.get(name)
     rows = None
     if name == "NodeAffinity" and x is not None:

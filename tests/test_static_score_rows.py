@@ -23,24 +23,50 @@ from kube_scheduler_simulator_tpu.state.compile import compile_workload
 from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
 
 
-def test_nodeaffinity_row_is_host_static():
-    nodes, pods, cfg = baseline_config(3, scale=0.02, seed=7)
-    cw = compile_workload(nodes, pods, cfg)
-    assert "NodeAffinity" in cw.host["static_score_rows"]
-    na_pos = cw.config.scorers().index("NodeAffinity")
-    assert cw.host["score_dtypes"][na_pos] == "host"
-
-    rr = replay(cw, chunk=16)
+def _assert_host_layout(cw, rr, must_include):
+    scorers = cw.config.scorers()
+    static = set(cw.host["static_score_rows"]) & set(scorers)
+    assert must_include <= static
+    for name in static:
+        assert cw.host["score_dtypes"][scorers.index(name)] == "host"
+    dynamic = [n for n in scorers if n not in static]
+    assert dynamic, "workload must still carry dynamic scorers"
     cc = rr._compact
-    assert ("host", "NodeAffinity") in cc.score_cols
-    # the transferred groups carry every OTHER scorer but not NodeAffinity
+    host_cols = {name for g, name in cc.score_cols if g == "host"}
+    assert host_cols == static
     n_transferred = sum(1 for g, _ in cc.score_cols if g != "host")
-    assert n_transferred == len(cw.config.scorers()) - 1
-    for chunk_arr in cc.raw8 + cc.raw16 + cc.raw32:
-        assert chunk_arr.shape[0] >= 0  # smoke: layout intact
+    assert n_transferred == len(dynamic)
     rows = {g: arr.shape[1] for g, arr in (
         ("raw8", cc.raw8[0]), ("raw16", cc.raw16[0]), ("raw32", cc.raw32[0]))}
     assert sum(rows.values()) == n_transferred
+
+
+def test_static_rows_are_host_tagged():
+    """Every scorer whose raw is a precompiled pass-through row rides the
+    "host" group; dynamic scorers (carry-dependent) still travel."""
+    nodes, pods, cfg = baseline_config(3, scale=0.02, seed=7)
+    cw = compile_workload(nodes, pods, cfg)
+    rr = replay(cw, chunk=16)
+    _assert_host_layout(cw, rr, {"NodeAffinity", "TaintToleration"})
+
+
+def test_imagelocality_volumebinding_rows_are_host_tagged():
+    """The default-lineup statics: ImageLocality's precompiled row and
+    VolumeBinding's constant-zero score stay host-resident too."""
+    from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    nodes = make_nodes(10, seed=5)
+    pods = make_pods(20, seed=6, with_affinity=True, with_tolerations=True)
+    cfg = PluginSetConfig(enabled=[
+        "NodeResourcesFit", "NodeResourcesBalancedAllocation", "NodeAffinity",
+        "TaintToleration", "ImageLocality", "VolumeBinding"])
+    cw = compile_workload(nodes, pods, cfg)
+    rr = replay(cw, chunk=8)
+    _assert_host_layout(
+        cw, rr,
+        {"NodeAffinity", "TaintToleration", "ImageLocality", "VolumeBinding"})
+    assert not cw.host["static_score_rows"]["VolumeBinding"].any()
 
 
 def test_host_row_parity_including_score_skip():
