@@ -42,8 +42,6 @@ def measure(idx: int, scale: float = 0.02) -> dict:
     host_rows = [name for g, name in cc.score_cols if g == "host"]
     # bytes those rows would have cost at their narrowest transfer dtype
     # (the pre-change behavior: bound-derived i8/i16/i32/i64)
-    import numpy as np
-
     saved = 0
     for name in host_rows:
         src = cw.host["static_score_rows"][name]

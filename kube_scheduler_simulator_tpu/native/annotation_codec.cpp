@@ -595,6 +595,13 @@ int32_t ctx_decode_pod(
     }
 
     // ---- score-result (raw) and finalscore-result (normalize x weight) --
+    //
+    // Row-dedup: workloads cluster — at the 5k-node shape only ~0.5% of
+    // feasible nodes carry a DISTINCT (raw values, ignored) tuple, and
+    // normalization is a pure function of that tuple + the per-pod
+    // reductions above.  Render each distinct row suffix (everything
+    // after the node key) once into scratch, then emit = node key +
+    // memcpy — measured ~3x on the score/final emit.
     size_t cap = 3 + (act.empty() ? 0 : ctx.sum_node_key + (size_t)n * (1 + row_fixed));
     char* sbuf = (char*)std::malloc(cap);
     char* fbuf = (char*)std::malloc(cap);
@@ -604,6 +611,23 @@ int32_t ctx_decode_pod(
     *fw++ = '{';
     bool first_node = true;
     if (!act.empty()) {
+        struct Slot {
+            uint64_t hash; uint32_t val_off;  // into val_store
+            uint32_t s_off, s_len, f_off, f_len;
+            uint8_t ig; uint8_t used;
+        };
+        thread_local std::vector<Slot> table;
+        thread_local std::vector<int64_t> val_store;
+        thread_local std::string scr_s, scr_f;
+        table.assign(256, Slot{});  // initial size; grows 4x at 1/2 load
+        size_t tmask = table.size() - 1, filled = 0;
+        val_store.clear();
+        scr_s.clear();
+        scr_f.clear();
+        const size_t kvals = act.size();
+        thread_local std::vector<int64_t> vals;
+        vals.resize(kvals);
+
         for (int32_t si = 0; si < n; ++si) {
             int32_t j = ctx.sorted_nodes[si];
             if (!feas_buf[j]) continue;
@@ -611,53 +635,113 @@ int32_t ctx_decode_pod(
             first_node = false;
             put(sw, ctx.node_key[j]);
             put(fw, ctx.node_key[j]);
-            for (size_t k = 0; k < act.size(); ++k) {
-                int32_t q = act[k];
-                int64_t raw = read_score(score_cols[q], score_elem[q], j);
-                put(sw, prefix[k]);
-                auto rs = std::to_chars(sw, sw + 24, (long long)raw);
-                sw = rs.ptr;
-                *sw++ = '"';
 
-                int64_t normed;
-                const Red& r = red[k];
-                switch (ctx.score_kind[q]) {
-                    case 1: {  // default_normalize
-                        normed = (r.mx == 0)
-                            ? raw : floordiv(raw * 100, std::max(r.mx, (int64_t)1));
-                        break;
-                    }
-                    case 2: {  // default reverse (TaintToleration)
-                        normed = (r.mx == 0)
-                            ? 100 : 100 - floordiv(raw * 100, std::max(r.mx, (int64_t)1));
-                        break;
-                    }
-                    case 3: {  // PodTopologySpread
-                        if (ignored && ignored[j]) { normed = 0; break; }
-                        normed = (r.mx == 0)
-                            ? 100
-                            : floordiv(100 * (r.mx + r.mn - raw),
-                                       std::max(r.mx, (int64_t)1));
-                        break;
-                    }
-                    case 4: {  // InterPodAffinity (float64 + trunc, like Go)
-                        double diff = (double)(r.mx - r.mn);
-                        double fv = diff > 0
-                            ? 100.0 * ((double)(raw - r.mn) / std::max(diff, 1.0))
-                            : 0.0;
-                        normed = (int64_t)fv;
-                        break;
-                    }
-                    default: normed = raw;
-                }
-                put(fw, prefix[k]);
-                auto rf = std::to_chars(fw, fw + 24,
-                                        (long long)(normed * ctx.score_weight[q]));
-                fw = rf.ptr;
-                *fw++ = '"';
+            uint64_t h = 1469598103934665603ull;  // FNV-1a over the tuple
+            for (size_t k = 0; k < kvals; ++k) {
+                int64_t v = read_score(score_cols[act[k]], score_elem[act[k]], j);
+                vals[k] = v;
+                h ^= (uint64_t)v;
+                h *= 1099511628211ull;
             }
-            *sw++ = '}';
-            *fw++ = '}';
+            uint8_t ig = (ignored && ignored[j]) ? 1 : 0;
+            h ^= ig;
+            h *= 1099511628211ull;
+
+            size_t slot = (size_t)h & tmask;
+            Slot* e;
+            for (;;) {
+                e = &table[slot];
+                if (!e->used) break;
+                if (e->hash == h && e->ig == ig &&
+                    std::memcmp(&val_store[e->val_off], vals.data(),
+                                kvals * sizeof(int64_t)) == 0)
+                    break;
+                slot = (slot + 1) & tmask;
+            }
+            if (!e->used) {
+                // render this distinct row once into the scratch buffers
+                e->used = 1;
+                e->hash = h;
+                e->ig = ig;
+                e->val_off = (uint32_t)val_store.size();
+                val_store.insert(val_store.end(), vals.begin(), vals.end());
+                e->s_off = (uint32_t)scr_s.size();
+                e->f_off = (uint32_t)scr_f.size();
+                char num[24];
+                for (size_t k = 0; k < kvals; ++k) {
+                    int32_t q = act[k];
+                    int64_t raw = vals[k];
+                    scr_s += prefix[k];
+                    auto rs = std::to_chars(num, num + 24, (long long)raw);
+                    scr_s.append(num, rs.ptr - num);
+                    scr_s.push_back('"');
+
+                    int64_t normed;
+                    const Red& r = red[k];
+                    switch (ctx.score_kind[q]) {
+                        case 1: {  // default_normalize
+                            normed = (r.mx == 0)
+                                ? raw : floordiv(raw * 100, std::max(r.mx, (int64_t)1));
+                            break;
+                        }
+                        case 2: {  // default reverse (TaintToleration)
+                            normed = (r.mx == 0)
+                                ? 100 : 100 - floordiv(raw * 100, std::max(r.mx, (int64_t)1));
+                            break;
+                        }
+                        case 3: {  // PodTopologySpread
+                            if (ig) { normed = 0; break; }
+                            normed = (r.mx == 0)
+                                ? 100
+                                : floordiv(100 * (r.mx + r.mn - raw),
+                                           std::max(r.mx, (int64_t)1));
+                            break;
+                        }
+                        case 4: {  // InterPodAffinity (float64 + trunc, like Go)
+                            double diff = (double)(r.mx - r.mn);
+                            double fv = diff > 0
+                                ? 100.0 * ((double)(raw - r.mn) / std::max(diff, 1.0))
+                                : 0.0;
+                            normed = (int64_t)fv;
+                            break;
+                        }
+                        default: normed = raw;
+                    }
+                    scr_f += prefix[k];
+                    auto rf = std::to_chars(num, num + 24,
+                                            (long long)(normed * ctx.score_weight[q]));
+                    scr_f.append(num, rf.ptr - num);
+                    scr_f.push_back('"');
+                }
+                scr_s.push_back('}');
+                scr_f.push_back('}');
+                e->s_len = (uint32_t)(scr_s.size() - e->s_off);
+                e->f_len = (uint32_t)(scr_f.size() - e->f_off);
+                // grow + rehash at 1/2 load (scratch offsets stay valid)
+                if (++filled * 2 > table.size()) {
+                    std::vector<Slot> old;
+                    old.swap(table);
+                    table.assign(old.size() * 4, Slot{});
+                    tmask = table.size() - 1;
+                    for (const Slot& o : old) {
+                        if (!o.used) continue;
+                        size_t s2 = (size_t)o.hash & tmask;
+                        while (table[s2].used) s2 = (s2 + 1) & tmask;
+                        table[s2] = o;
+                    }
+                    // re-find e after the rehash for the puts below
+                    size_t s3 = (size_t)h & tmask;
+                    while (!(table[s3].used && table[s3].hash == h &&
+                             table[s3].ig == ig &&
+                             std::memcmp(&val_store[table[s3].val_off],
+                                         vals.data(),
+                                         kvals * sizeof(int64_t)) == 0))
+                        s3 = (s3 + 1) & tmask;
+                    e = &table[s3];
+                }
+            }
+            put(sw, scr_s.data() + e->s_off, e->s_len);
+            put(fw, scr_f.data() + e->f_off, e->f_len);
         }
     }
     *sw++ = '}'; *sw = 0;
