@@ -197,8 +197,7 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
     from kube_scheduler_simulator_tpu.framework.replay import replay
     from kube_scheduler_simulator_tpu.models.workloads import baseline_config
     from kube_scheduler_simulator_tpu.state.compile import compile_workload
-    from kube_scheduler_simulator_tpu.store.decode import (
-        decode_all_parallel, decode_chunk_into)
+    from kube_scheduler_simulator_tpu.store.decode import decode_release_batches
 
     nodes, pods, cfg = baseline_config(idx, scale=scale, seed=seed,
                                        node_scale=node_scale)
@@ -246,14 +245,25 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
 
     dec_cps = None
     if decode_sample:
+        # release-style sample (the product semantics: the reflector
+        # PATCHes each pod's annotations out and holds nothing) — holding
+        # the whole sample resident would measure this host's page
+        # backing, not the decoder
         ds = min(decode_sample, len(pods))
+        sample = {"bytes": 0}
+
+        def _sample_pod(i, a):
+            if i == 0:
+                sample["bytes"] = sum(len(v) for v in a.values())
+
         t0 = time.time()
-        anns = decode_all_parallel(rr, ds)
+        decode_release_batches(rr, 0, ds, on_pod=_sample_pod)
         dec_s = time.time() - t0
-        sample_bytes = sum(len(v) for v in anns[0].values())
+        sample_bytes = sample["bytes"]
         dec_cps = ds / dec_s
-        log(f"  annotation decode ({ds}-pod sample): {dec_s:.2f}s -> "
-            f"{dec_cps:,.0f} pods/s decoded (~{sample_bytes/1024:.0f} KiB/pod)")
+        log(f"  annotation decode ({ds}-pod sample, released per batch): "
+            f"{dec_s:.2f}s -> {dec_cps:,.0f} pods/s decoded "
+            f"(~{sample_bytes/1024:.0f} KiB/pod)")
 
     # annotations-materialized end-to-end: one replay with EVERY pod's 13
     # result annotations decoded to their final JSON strings, streamed as
@@ -270,12 +280,13 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
 
         ann_bytes = _np.zeros(len(pods), dtype=_np.int64)  # idempotent per pod
 
+        def _on_pod(i, a):
+            ann_bytes[i] = sum(len(v) for v in a.values())
+
         def _consume(r, lo, hi):
-            sink: list = [None] * (hi - lo)
-            decode_chunk_into(r, lo, hi, sink, base=lo)
-            for j, a in enumerate(sink):
-                if a is not None:
-                    ann_bytes[lo + j] = sum(len(v) for v in a.values())
+            # release-per-batch (decode_release_batches docstring): the
+            # reference reflector holds one pod's annotations at a time
+            decode_release_batches(r, lo, hi, on_pod=_on_pod)
 
         t0 = time.time()
         rr = replay(cw, chunk=chunk, collect=True, mesh=mesh, unroll=unroll,

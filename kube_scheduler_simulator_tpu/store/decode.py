@@ -309,6 +309,31 @@ def decode_chunk_into(rr, lo: int, hi: int, out: list, base: int = 0) -> None:
         out[i - base] = a
 
 
+def decode_release_batches(rr, lo: int, hi: int, on_pod=None,
+                           batch: int = 64) -> None:
+    """Decode pods lo..hi in small compact-chunk-aligned batches,
+    releasing each batch's annotations after on_pod(i, ann) — the
+    reflector-style consumer (holds nothing, BASELINE.md): holding a
+    whole replay chunk's strings before releasing pays ~1.3 GB of
+    first-touch page faults at the 5k-node shape, a harness transient
+    rather than decoder cost.  Batches never straddle a compact chunk so
+    pool workers share one recon-cache slot; batch=64 matches
+    decode_chunk_into's pool threshold (smaller batches go serial)."""
+    cc = getattr(rr, "_compact", None)
+    s0 = lo
+    while s0 < hi:
+        s1 = min(s0 + batch, hi)
+        if cc is not None:
+            s1 = min(s1, (s0 // cc.chunk + 1) * cc.chunk)
+        sink: list = [None] * (s1 - s0)
+        decode_chunk_into(rr, s0, s1, sink, base=s0)
+        if on_pod is not None:
+            for j, a in enumerate(sink):
+                if a is not None:
+                    on_pod(s0 + j, a)
+        s0 = s1
+
+
 def decode_all_parallel(rr: ReplayResult,
                         n: int | None = None) -> list[dict[str, str]]:
     """Decode pods 0..n across a thread pool, chunk by chunk.
