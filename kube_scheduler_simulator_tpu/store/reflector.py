@@ -60,7 +60,14 @@ def update_result_history(pod: dict, result_set: dict[str, str]) -> None:
             "result record alone exceeds the annotation size limit"
         )
     rec = _encode_record(result_set)
-    if raw.startswith("[") and raw.endswith("]"):
+    # textual-splice fast path: only for values shaped like this
+    # function's own output (empty array, or array of objects) — anything
+    # else falls through to the parsing path so corrupt histories raise
+    # instead of being spliced into deeper corruption.  Residual trust:
+    # a value that keeps the '[{"..."}]' shell but is internally invalid
+    # still splices (validating would mean re-parsing ~256 KiB per pod,
+    # the cost this fast path exists to avoid).
+    if raw == "[]" or (raw.startswith('[{"') and raw.endswith('"}]')):
         encoded = ("[" + rec + "]" if raw == "[]"
                    else raw[:-1] + "," + rec + "]")
         if len(encoded) <= RESULT_HISTORY_LIMIT:
@@ -68,10 +75,15 @@ def update_result_history(pod: dict, result_set: dict[str, str]) -> None:
             return
     try:
         results = json.loads(raw)
-        if not isinstance(results, list):
-            results = []
-    except json.JSONDecodeError:
-        results = []
+    except json.JSONDecodeError as e:
+        # the reference surfaces a broken existing history as an error
+        # (updateResultHistory json.Unmarshal, storereflector.go:169-171)
+        # rather than silently resetting it; reflect() treats this like
+        # the oversized-record case (log-and-continue without history)
+        raise ValueError(f"broken result-history annotation: {e}") from e
+    if not isinstance(results, list):
+        raise ValueError(
+            "broken result-history annotation: not a JSON array")
     results.append(result_set)
     while results:
         encoded = ann.marshal(results)

@@ -498,3 +498,41 @@ def test_informer_mode_purges_results_of_deleted_pods():
     finally:
         stop.set()
         refl.stop_informer()
+
+
+def test_result_history_broken_annotation_raises():
+    """A broken existing result-history errors (reference
+    storereflector.go:169-171 surfaces the json.Unmarshal failure) instead
+    of silently resetting the history; reflect() downgrades it to
+    log-and-continue like the oversized-record case."""
+    from kube_scheduler_simulator_tpu.store.reflector import update_result_history
+
+    for broken in ("broken", "{}", '{"a":"b"}', "[1,2", "[oops]",
+                   "[truncated", '[{"k":"v"}'):
+        pod = {"metadata": {"annotations": {ann.RESULT_HISTORY: broken}}}
+        with pytest.raises(ValueError):
+            update_result_history(pod, {"k": "v"})
+        # the broken value is left in place for inspection
+        assert pod["metadata"]["annotations"][ann.RESULT_HISTORY] == broken
+
+
+def test_reflect_continues_past_broken_history():
+    """End-to-end: a pod whose history annotation is corrupt still gets
+    its fresh result annotations written back."""
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+
+    s = ObjectStore()
+    s.create("nodes", {"metadata": {"name": "n1"},
+                       "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                                  "pods": "10"}}})
+    s.create("pods", {"metadata": {"name": "p1", "namespace": "default",
+                                   "annotations": {ann.RESULT_HISTORY: "broken"}},
+                      "spec": {"containers": [{"name": "c", "resources": {
+                          "requests": {"cpu": "1", "memory": "1Gi"}}}]}})
+    eng = SchedulerEngine(s)
+    assert eng.schedule_pending() == 1
+    pod = s.get("pods", "p1", "default")
+    assert pod["spec"]["nodeName"] == "n1"
+    assert pod["metadata"]["annotations"][ann.SELECTED_NODE] == "n1"
+    assert pod["metadata"]["annotations"][ann.RESULT_HISTORY] == "broken"
