@@ -555,12 +555,21 @@ int32_t ctx_decode_pod(
     out_lens[1] = out_lens[2] = 0;
     if (!want_scores) return 0;
 
-    // ---- per-scorer reductions over the node axis (hostnorm mirrors) ----
+    // ---- distinct-tuple pass (hostnorm mirrors) ------------------------
+    //
+    // Workloads cluster: at the 5k-node shape only ~0.5% of feasible
+    // nodes carry a DISTINCT (raw values, ignored) tuple, and both the
+    // reductions (max/min ignore multiplicity) and the normalization are
+    // pure functions of that tuple + per-pod state.  So: hash every
+    // feasible node's tuple ONCE, compute reductions over the distinct
+    // entries, render each distinct score/finalscore row suffix once,
+    // and emit = node key + two memcpys per node.  Byte-identical to the
+    // per-node math (the 0 floors below replicate the per-node loops'
+    // accumulator init values); measured ~3x on the score/final side.
     std::vector<std::string> prefix;
     std::vector<int32_t> act;
-    struct Red { int64_t mn, mx; bool any_scored; };
-    std::vector<Red> red;
-    prefix.reserve(s); act.reserve(s); red.reserve(s);
+    prefix.reserve(s);
+    act.reserve(s);
     size_t row_fixed = 3;
     for (int32_t k = 0; k < s; ++k) {
         int32_t q = ctx.sorted_scores[k];
@@ -570,57 +579,9 @@ int32_t ctx_decode_pod(
         pre.push_back('"');
         row_fixed += pre.size() + 21;
         prefix.push_back(std::move(pre));
-
-        Red r{0, 0, false};
-        int32_t kind = ctx.score_kind[q];
-        const void* col = score_cols[q];
-        int32_t esz = score_elem[q];
-        if (kind == 1 || kind == 2) {
-            // default_normalize: max over feasible of raw (0 fill)
-            int64_t mx = 0;
-            for (int32_t j = 0; j < n; ++j) {
-                int64_t v = feas_buf[j] ? read_score(col, esz, j) : 0;
-                if (v > mx) mx = v;
-            }
-            r.mx = mx;
-        } else if (kind == 3) {
-            int64_t mn = ctx.tsp_big, mx = 0;
-            bool any = false;
-            for (int32_t j = 0; j < n; ++j) {
-                bool scored = feas_buf[j] && !(ignored && ignored[j]);
-                int64_t v_mn = scored ? read_score(col, esz, j) : ctx.tsp_big;
-                int64_t v_mx = scored ? read_score(col, esz, j) : 0;
-                if (v_mn < mn) mn = v_mn;
-                if (v_mx > mx) mx = v_mx;
-                any |= scored;
-            }
-            r.mn = any ? mn : 0;
-            r.mx = mx;
-            r.any_scored = any;
-        } else if (kind == 4) {
-            const int64_t big = (int64_t)1 << 40;
-            int64_t mn = big, mx = -big;
-            for (int32_t j = 0; j < n; ++j) {
-                int64_t raw = read_score(col, esz, j);
-                int64_t v_mn = feas_buf[j] ? raw : big;
-                int64_t v_mx = feas_buf[j] ? raw : -big;
-                if (v_mn < mn) mn = v_mn;
-                if (v_mx > mx) mx = v_mx;
-            }
-            r.mn = mn; r.mx = mx;
-        }
-        red.push_back(r);
         act.push_back(q);
     }
 
-    // ---- score-result (raw) and finalscore-result (normalize x weight) --
-    //
-    // Row-dedup: workloads cluster — at the 5k-node shape only ~0.5% of
-    // feasible nodes carry a DISTINCT (raw values, ignored) tuple, and
-    // normalization is a pure function of that tuple + the per-pod
-    // reductions above.  Render each distinct row suffix (everything
-    // after the node key) once into scratch, then emit = node key +
-    // memcpy — measured ~3x on the score/final emit.
     size_t cap = 3 + (act.empty() ? 0 : ctx.sum_node_key + (size_t)n * (1 + row_fixed));
     char* sbuf = (char*)std::malloc(cap);
     char* fbuf = (char*)std::malloc(cap);
@@ -630,31 +591,30 @@ int32_t ctx_decode_pod(
     *fw++ = '{';
     bool first_node = true;
     if (!act.empty()) {
-        struct Slot {
-            uint64_t hash; uint32_t val_off;  // into val_store
+        const size_t kvals = act.size();
+        struct Entry {
+            uint64_t hash; uint32_t val_off;
             uint32_t s_off, s_len, f_off, f_len;
-            uint8_t ig; uint8_t used;
+            uint8_t ig;
         };
-        thread_local std::vector<Slot> table;
+        thread_local std::vector<Entry> entries;
+        thread_local std::vector<uint32_t> table;  // slot -> entry id + 1
         thread_local std::vector<int64_t> val_store;
+        thread_local std::vector<int32_t> ent_of;  // node -> entry id (-1 infeasible)
+        thread_local std::vector<int64_t> vals;
         thread_local std::string scr_s, scr_f;
-        table.assign(256, Slot{});  // initial size; grows 4x at 1/2 load
-        size_t tmask = table.size() - 1, filled = 0;
+        entries.clear();
         val_store.clear();
         scr_s.clear();
         scr_f.clear();
-        const size_t kvals = act.size();
-        thread_local std::vector<int64_t> vals;
+        table.assign(256, 0);  // grows 4x at 1/2 load
+        size_t tmask = table.size() - 1;
+        ent_of.assign(n, -1);
         vals.resize(kvals);
 
-        for (int32_t si = 0; si < n; ++si) {
-            int32_t j = ctx.sorted_nodes[si];
+        // pass 1: dedup every feasible node's tuple
+        for (int32_t j = 0; j < n; ++j) {
             if (!feas_buf[j]) continue;
-            if (!first_node) { *sw++ = ','; *fw++ = ','; }
-            first_node = false;
-            put(sw, ctx.node_key[j]);
-            put(fw, ctx.node_key[j]);
-
             uint64_t h = 1469598103934665603ull;  // FNV-1a over the tuple
             for (size_t k = 0; k < kvals; ++k) {
                 int64_t v = read_score(score_cols[act[k]], score_elem[act[k]], j);
@@ -667,100 +627,148 @@ int32_t ctx_decode_pod(
             h *= 1099511628211ull;
 
             size_t slot = (size_t)h & tmask;
-            Slot* e;
+            int32_t eid = -1;
             for (;;) {
-                e = &table[slot];
-                if (!e->used) break;
-                if (e->hash == h && e->ig == ig &&
-                    std::memcmp(&val_store[e->val_off], vals.data(),
-                                kvals * sizeof(int64_t)) == 0)
+                uint32_t ref = table[slot];
+                if (!ref) break;
+                const Entry& e = entries[ref - 1];
+                if (e.hash == h && e.ig == ig &&
+                    std::memcmp(&val_store[e.val_off], vals.data(),
+                                kvals * sizeof(int64_t)) == 0) {
+                    eid = (int32_t)(ref - 1);
                     break;
+                }
                 slot = (slot + 1) & tmask;
             }
-            if (!e->used) {
-                // render this distinct row once into the scratch buffers
-                e->used = 1;
-                e->hash = h;
-                e->ig = ig;
-                e->val_off = (uint32_t)val_store.size();
+            if (eid < 0) {
+                eid = (int32_t)entries.size();
+                Entry e{};
+                e.hash = h;
+                e.ig = ig;
+                e.val_off = (uint32_t)val_store.size();
                 val_store.insert(val_store.end(), vals.begin(), vals.end());
-                e->s_off = (uint32_t)scr_s.size();
-                e->f_off = (uint32_t)scr_f.size();
-                char num[24];
-                for (size_t k = 0; k < kvals; ++k) {
-                    int32_t q = act[k];
-                    int64_t raw = vals[k];
-                    scr_s += prefix[k];
-                    auto rs = std::to_chars(num, num + 24, (long long)raw);
-                    scr_s.append(num, rs.ptr - num);
-                    scr_s.push_back('"');
-
-                    int64_t normed;
-                    const Red& r = red[k];
-                    switch (ctx.score_kind[q]) {
-                        case 1: {  // default_normalize
-                            normed = (r.mx == 0)
-                                ? raw : floordiv(raw * 100, std::max(r.mx, (int64_t)1));
-                            break;
-                        }
-                        case 2: {  // default reverse (TaintToleration)
-                            normed = (r.mx == 0)
-                                ? 100 : 100 - floordiv(raw * 100, std::max(r.mx, (int64_t)1));
-                            break;
-                        }
-                        case 3: {  // PodTopologySpread
-                            if (ig) { normed = 0; break; }
-                            normed = (r.mx == 0)
-                                ? 100
-                                : floordiv(100 * (r.mx + r.mn - raw),
-                                           std::max(r.mx, (int64_t)1));
-                            break;
-                        }
-                        case 4: {  // InterPodAffinity (float64 + trunc, like Go)
-                            double diff = (double)(r.mx - r.mn);
-                            double fv = diff > 0
-                                ? 100.0 * ((double)(raw - r.mn) / std::max(diff, 1.0))
-                                : 0.0;
-                            normed = (int64_t)fv;
-                            break;
-                        }
-                        default: normed = raw;
-                    }
-                    scr_f += prefix[k];
-                    auto rf = std::to_chars(num, num + 24,
-                                            (long long)(normed * ctx.score_weight[q]));
-                    scr_f.append(num, rf.ptr - num);
-                    scr_f.push_back('"');
-                }
-                scr_s.push_back('}');
-                scr_f.push_back('}');
-                e->s_len = (uint32_t)(scr_s.size() - e->s_off);
-                e->f_len = (uint32_t)(scr_f.size() - e->f_off);
-                // grow + rehash at 1/2 load (scratch offsets stay valid)
-                if (++filled * 2 > table.size()) {
-                    std::vector<Slot> old;
-                    old.swap(table);
-                    table.assign(old.size() * 4, Slot{});
+                entries.push_back(e);
+                table[slot] = (uint32_t)eid + 1;
+                if (entries.size() * 2 > table.size()) {  // grow + rehash
+                    table.assign(table.size() * 4, 0);
                     tmask = table.size() - 1;
-                    for (const Slot& o : old) {
-                        if (!o.used) continue;
-                        size_t s2 = (size_t)o.hash & tmask;
-                        while (table[s2].used) s2 = (s2 + 1) & tmask;
-                        table[s2] = o;
+                    for (size_t t2 = 0; t2 < entries.size(); ++t2) {
+                        size_t s2 = (size_t)entries[t2].hash & tmask;
+                        while (table[s2]) s2 = (s2 + 1) & tmask;
+                        table[s2] = (uint32_t)t2 + 1;
                     }
-                    // re-find e after the rehash for the puts below
-                    size_t s3 = (size_t)h & tmask;
-                    while (!(table[s3].used && table[s3].hash == h &&
-                             table[s3].ig == ig &&
-                             std::memcmp(&val_store[table[s3].val_off],
-                                         vals.data(),
-                                         kvals * sizeof(int64_t)) == 0))
-                        s3 = (s3 + 1) & tmask;
-                    e = &table[s3];
                 }
             }
-            put(sw, scr_s.data() + e->s_off, e->s_len);
-            put(fw, scr_f.data() + e->f_off, e->f_len);
+            ent_of[j] = eid;
+        }
+
+        // pass 2: reductions over the distinct tuples
+        struct Red { int64_t mn, mx; };
+        std::vector<Red> red(kvals);
+        for (size_t k = 0; k < kvals; ++k) {
+            int32_t kind = ctx.score_kind[act[k]];
+            Red r{0, 0};
+            if (kind == 1 || kind == 2) {
+                // default_normalize: max over feasible of raw (0 floor)
+                int64_t mx = 0;
+                for (const Entry& e : entries) {
+                    int64_t v = val_store[e.val_off + k];
+                    if (v > mx) mx = v;
+                }
+                r.mx = mx;
+            } else if (kind == 3) {
+                int64_t mn = ctx.tsp_big, mx = 0;
+                bool any = false;
+                for (const Entry& e : entries) {
+                    if (e.ig) continue;
+                    int64_t v = val_store[e.val_off + k];
+                    if (v < mn) mn = v;
+                    if (v > mx) mx = v;
+                    any = true;
+                }
+                r.mn = any ? mn : 0;
+                r.mx = mx;
+            } else if (kind == 4) {
+                const int64_t big = (int64_t)1 << 40;
+                int64_t mn = big, mx = -big;
+                for (const Entry& e : entries) {
+                    int64_t v = val_store[e.val_off + k];
+                    if (v < mn) mn = v;
+                    if (v > mx) mx = v;
+                }
+                r.mn = mn;
+                r.mx = mx;
+            }
+            red[k] = r;
+        }
+
+        // pass 3: render each distinct row suffix once
+        char num[24];
+        for (Entry& e : entries) {
+            e.s_off = (uint32_t)scr_s.size();
+            e.f_off = (uint32_t)scr_f.size();
+            for (size_t k = 0; k < kvals; ++k) {
+                int32_t q = act[k];
+                int64_t raw = val_store[e.val_off + k];
+                scr_s += prefix[k];
+                auto rs = std::to_chars(num, num + 24, (long long)raw);
+                scr_s.append(num, rs.ptr - num);
+                scr_s.push_back('"');
+
+                int64_t normed;
+                const Red& r = red[k];
+                switch (ctx.score_kind[q]) {
+                    case 1: {  // default_normalize
+                        normed = (r.mx == 0)
+                            ? raw : floordiv(raw * 100, std::max(r.mx, (int64_t)1));
+                        break;
+                    }
+                    case 2: {  // default reverse (TaintToleration)
+                        normed = (r.mx == 0)
+                            ? 100 : 100 - floordiv(raw * 100, std::max(r.mx, (int64_t)1));
+                        break;
+                    }
+                    case 3: {  // PodTopologySpread
+                        if (e.ig) { normed = 0; break; }
+                        normed = (r.mx == 0)
+                            ? 100
+                            : floordiv(100 * (r.mx + r.mn - raw),
+                                       std::max(r.mx, (int64_t)1));
+                        break;
+                    }
+                    case 4: {  // InterPodAffinity (float64 + trunc, like Go)
+                        double diff = (double)(r.mx - r.mn);
+                        double fv = diff > 0
+                            ? 100.0 * ((double)(raw - r.mn) / std::max(diff, 1.0))
+                            : 0.0;
+                        normed = (int64_t)fv;
+                        break;
+                    }
+                    default: normed = raw;
+                }
+                scr_f += prefix[k];
+                auto rf = std::to_chars(num, num + 24,
+                                        (long long)(normed * ctx.score_weight[q]));
+                scr_f.append(num, rf.ptr - num);
+                scr_f.push_back('"');
+            }
+            scr_s.push_back('}');
+            scr_f.push_back('}');
+            e.s_len = (uint32_t)(scr_s.size() - e.s_off);
+            e.f_len = (uint32_t)(scr_f.size() - e.f_off);
+        }
+
+        // pass 4: emit = node key + two row-suffix memcpys per node
+        for (int32_t si = 0; si < n; ++si) {
+            int32_t j = ctx.sorted_nodes[si];
+            if (ent_of[j] < 0) continue;
+            if (!first_node) { *sw++ = ','; *fw++ = ','; }
+            first_node = false;
+            put(sw, ctx.node_key[j]);
+            put(fw, ctx.node_key[j]);
+            const Entry& e = entries[ent_of[j]];
+            put(sw, scr_s.data() + e.s_off, e.s_len);
+            put(fw, scr_f.data() + e.f_off, e.f_len);
         }
     }
     *sw++ = '}'; *sw = 0;
