@@ -711,6 +711,12 @@ def _run(args):
               f"_{shape}")
     if args.fallback:
         metric += "_cpu_fallback"
+        # the wedged-tunnel fallback is a same-host CPU run; point the
+        # reader at the committed real-TPU evidence for the device rates
+        extra["real_tpu_session_artifact"] = (
+            "docs/bench/r04-tpu-session.log: parity gates configs 1-5 on "
+            "the v5e-1; config 4 at 2,831 device cycles/s, config 5 at "
+            "2,738 (predates the round-4 transfer/decode wins)")
     e2e = main_fig["decode_inclusive_cps"] or main_fig["incl_host_transfer_cps"]
     # divisor: the strongest CPU figure available — a measured multi-core
     # run when the host has cores, else the Amdahl-modeled 16-way number
