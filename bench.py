@@ -138,34 +138,191 @@ def _start_hang_watchdog(args, stale_s: float = 1200) -> None:
     threading.Thread(target=run, daemon=True, name="bench-hang-watchdog").start()
 
 
-def run_parity_gate(idx: int, scale: float, seed: int) -> bool:
+_ORACLE_CHILD = """\
+import json, resource, sys
+# self-imposed address-space cap: a runaway oracle gets a MemoryError in
+# its own process instead of inviting the kernel OOM killer to take the
+# whole bench (round 4's exit 137, docs/bench/r04-tpu-bench.err).  Set
+# here post-exec rather than via preexec_fn: running Python in a child
+# forked from the JAX-multithreaded parent can deadlock before exec.
+resource.setrlimit(resource.RLIMIT_AS, (4 << 30, 4 << 30))
+sys.path.insert(0, {repo!r})
+# hermetic CPU: the axon sitecustomize ignores JAX_PLATFORMS=cpu, and the
+# oracle's plugin-helper imports pull jax in — force the CPU backend
+# before anything can touch the (possibly wedged) tunnel
+from kube_scheduler_simulator_tpu.utils.platform import force_cpu
+force_cpu()
+from kube_scheduler_simulator_tpu.models.workloads import baseline_config
+from kube_scheduler_simulator_tpu.reference_impl.sequential import (
+    SequentialScheduler)
+nodes, pods, cfg = baseline_config({idx}, scale={scale}, seed={seed})
+s = SequentialScheduler(nodes, pods, cfg)
+w = sys.stdout
+for pod in s.pods:
+    anns, _ = s.schedule_one(pod)
+    w.write(json.dumps(anns) + chr(10))
+w.write("DONE " + str(len(s.pods)) + chr(10))
+"""
+
+
+def stream_oracle_parity(idx: int, scale: float, seed: int, chunk: int = 64,
+                         want_digest: bool = False, heartbeat=None) -> dict:
+    """Bit-parity check: device replay vs the sequential CPU oracle,
+    both sides streamed so neither ever materializes the full annotation
+    product (~13 GB at 10k x 5k).
+
+    The oracle runs in ONE separate CPU-forced subprocess (address space
+    self-capped via RLIMIT_AS) and streams one pod's annotations per
+    line; this process decodes the same pod from the device replay and
+    compares as lines arrive, holding one pod at a time.  Round 4 ran an
+    8-worker parallel oracle in-process and the kernel OOM-killed the
+    whole bench on the memory-starved TPU host (exit 137,
+    docs/bench/r04-tpu-bench.err) — the parity machinery must never be
+    able to take the measured process down with it.
+    Parallel-vs-sequential oracle parity is covered by
+    tests/test_parallel_oracle.py; the sequential oracle is the ground
+    truth here (reference semantics: simulator/scheduler/plugin/
+    wrappedplugin.go recording shim, resultstore/store.go score math).
+
+    Returns {ok, pods, compared, keys_checked, mismatches,
+    first_mismatch, sha256 (of every compared value, when want_digest),
+    oracle_rc, oracle_err, oracle_seconds, replay_seconds}."""
+    import hashlib
+    import os as _os
+    import subprocess as _sp
+    import tempfile
+
     from kube_scheduler_simulator_tpu.framework.replay import replay
     from kube_scheduler_simulator_tpu.models.workloads import baseline_config
-    from kube_scheduler_simulator_tpu.reference_impl.parallel import (
-        OracleWorkerError, ParallelScheduler)
     from kube_scheduler_simulator_tpu.state.compile import compile_workload
     from kube_scheduler_simulator_tpu.store.decode import decode_pod_result
 
     nodes, pods, cfg = baseline_config(idx, scale=scale, seed=seed)
-    try:
-        oracle = ParallelScheduler(nodes, pods, cfg, parallelism=8).schedule_all()
-    except OracleWorkerError as e:
-        # a worker died or deadlocked (fork-after-JAX-threads hazard) —
-        # the sequential oracle is the ground truth anyway, just slower
-        log(f"parallel oracle failed ({e}); gating against the sequential oracle")
-        from kube_scheduler_simulator_tpu.reference_impl.sequential import (
-            SequentialScheduler)
+    t0 = time.time()
+    rr = replay(compile_workload(nodes, pods, cfg), chunk=chunk)
+    replay_s = time.time() - t0
+    h = hashlib.sha256() if want_digest else None
+    out = {"ok": False, "pods": len(pods), "compared": 0, "keys_checked": 0,
+           "mismatches": 0, "first_mismatch": None, "sha256": None,
+           "oracle_rc": None, "oracle_err": "",
+           "replay_seconds": round(replay_s, 1)}
+    t0 = time.time()
+    # child stderr goes to a temp file, not a pipe: this loop only drains
+    # stdout, and a filled stderr pipe would deadlock the child mid-run
+    with tempfile.TemporaryFile(mode="w+") as errf:
+        child = _sp.Popen(
+            [sys.executable, "-c",
+             _ORACLE_CHILD.format(repo=str(Path(__file__).parent), idx=idx,
+                                  scale=scale, seed=seed)],
+            stdout=_sp.PIPE, stderr=errf, text=True,
+            env={**_os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        i = 0
+        done = False
+        try:
+            for line in child.stdout:
+                if heartbeat is not None:
+                    heartbeat(i)
+                if line.startswith("DONE "):
+                    done = int(line[5:]) == len(pods) == i
+                    break
+                sa = json.loads(line)
+                da = decode_pod_result(rr, i)
+                for k, v in sa.items():
+                    out["keys_checked"] += 1
+                    if h is not None:
+                        h.update(v.encode())
+                    # .get: a device-side MISSING key is a mismatch to
+                    # record, not a KeyError that kills the whole check
+                    if da.get(k, "\0missing") != v:
+                        out["mismatches"] += 1
+                        if out["first_mismatch"] is None:
+                            out["first_mismatch"] = {
+                                "pod": i, "key": k,
+                                "dev": da.get(k, "<missing>")[:200],
+                                "oracle": v[:200]}
+                i += 1
+                out["compared"] = i
+        finally:
+            # clean DONE: give the child a moment to exit on its own so
+            # the artifact records its true rc (not a kill's -9)
+            try:
+                child.wait(timeout=10 if done else 0.1)
+            except _sp.TimeoutExpired:
+                child.kill()
+                child.wait()
+            errf.seek(0)
+            out["oracle_err"] = errf.read().strip()[-300:]
+    out["oracle_rc"] = child.returncode
+    out["oracle_seconds"] = round(time.time() - t0, 1)
+    out["ok"] = done and out["mismatches"] == 0
+    if h is not None:
+        out["sha256"] = h.hexdigest()
+    if not done and out["mismatches"] == 0:
+        out["oracle_died"] = True  # environment failure, not a parity one
+    return out
 
-        oracle = SequentialScheduler(nodes, pods, cfg).schedule_all()
-    rr = replay(compile_workload(nodes, pods, cfg), chunk=64)
-    for i, (sa, _) in enumerate(oracle):
-        da = decode_pod_result(rr, i)
-        for k, v in sa.items():
-            if da[k] != v:
-                log(f"PARITY MISMATCH config {idx} pod {i} key {k}\n"
-                    f"  dev={da[k][:200]}\n  seq={v[:200]}")
-                return False
-    return True
+
+def run_parity_gate(idx: int, scale: float, seed: int,
+                    _retry: bool = True) -> bool:
+    def hb(_i):
+        _HEARTBEAT["t"] = time.time()  # streamed progress feeds watchdog
+
+    r = stream_oracle_parity(idx, scale, seed, heartbeat=hb)
+    if r["ok"]:
+        return True
+    if r["first_mismatch"]:
+        m = r["first_mismatch"]
+        log(f"PARITY MISMATCH config {idx} pod {m['pod']} key {m['key']}\n"
+            f"  dev={m['dev']}\n  seq={m['oracle']}")
+        return False
+    # the oracle child died (rlimit MemoryError, OOM kill, crash) — that
+    # is an environment failure, not a parity failure; shed load and
+    # retry once at a smaller gate shape rather than reporting value 0
+    log(f"parity-gate oracle child died at pod {r['compared']}/{r['pods']} "
+        f"(rc={r['oracle_rc']}): {r['oracle_err']}")
+    if _retry and scale > 0.011:
+        log(f"  retrying gate config {idx} at scale {scale / 4}")
+        return run_parity_gate(idx, scale / 4, seed, _retry=False)
+    return False
+
+
+def _available_gb() -> float:
+    """MemAvailable from /proc/meminfo, in GiB (inf if unreadable)."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) / (1 << 20)
+    except OSError:
+        pass
+    return float("inf")
+
+
+class _host_phase_ticker:
+    """Touch the hang-watchdog heartbeat every 60s during a PURE-HOST
+    phase (CPU oracle runs, subprocesses with their own timeouts).  Host
+    phases cannot wedge on the accelerator tunnel, so keeping them alive
+    is safe; device phases must only heartbeat on real progress
+    (on_chunk), or a wedged device op would be masked."""
+
+    def __enter__(self):
+        import threading
+
+        self._stop = threading.Event()
+
+        def tick():
+            while not self._stop.wait(60):
+                _HEARTBEAT["t"] = time.time()
+
+        self._t = threading.Thread(target=tick, daemon=True,
+                                   name="bench-host-phase-ticker")
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        return False
 
 
 def _device_initializes(timeout: float = 240) -> bool:
@@ -285,7 +442,10 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
 
         def _consume(r, lo, hi):
             # release-per-batch (decode_release_batches docstring): the
-            # reference reflector holds one pod's annotations at a time
+            # reference reflector holds one pod's annotations at a time.
+            # Each chunk landing is real end-to-end progress — feed the
+            # hang watchdog so a long full-scale phase can't false-fire it
+            _HEARTBEAT["t"] = time.time()
             decode_release_batches(r, lo, hi, on_pod=_on_pod)
 
         t0 = time.time()
@@ -391,13 +551,15 @@ def _instrumented_compute_fraction(seq) -> float:
 
 def measure_cpu_baseline(idx: int, cpu_scale: float, node_scale: float,
                          seed: int, parallelism: int, cache: dict, rev: str):
-    import os as _os
-
     from kube_scheduler_simulator_tpu.models.workloads import baseline_config
     from kube_scheduler_simulator_tpu.reference_impl.parallel import ParallelScheduler
     from kube_scheduler_simulator_tpu.reference_impl.sequential import SequentialScheduler
+    from kube_scheduler_simulator_tpu.utils.platform import effective_cpu_count
 
-    cores = _os.cpu_count() or 1
+    # effective (affinity-masked) count, matching main()'s forkserver
+    # warm-up gate: a 1-CPU container on an 8-core host must not construct
+    # ParallelScheduler with a cold forkserver after JAX threads exist
+    cores = effective_cpu_count()
     out = {"cores": cores}
 
     # instrumented sequential run: throughput + the Filter/Score compute
@@ -512,13 +674,19 @@ def main():
     ap.add_argument("--assume-fallback", action="store_true",
                     help=argparse.SUPPRESS)  # set by the crash re-exec
     args = ap.parse_args()
-    # the parity gates' parallel-oracle workers must not fork from this
-    # process once JAX threads exist (deadlock hazard); start their
-    # forkserver NOW, while we are still single-threaded
-    from kube_scheduler_simulator_tpu.reference_impl.parallel import (
-        warm_forkserver)
+    # the measured multi-core divisor's parallel-oracle workers must not
+    # fork from this process once JAX threads exist (deadlock hazard);
+    # start their forkserver NOW, while we are still single-threaded.
+    # Only multi-core hosts ever construct a ParallelScheduler (the
+    # parity gate streams the sequential oracle from a subprocess).
+    from kube_scheduler_simulator_tpu.utils.platform import (
+        effective_cpu_count)
 
-    warm_forkserver()
+    if effective_cpu_count() > 1:
+        from kube_scheduler_simulator_tpu.reference_impl.parallel import (
+            warm_forkserver)
+
+        warm_forkserver()
     import os as _os_main
 
     if (_os_main.environ.get("KSS_BENCH_NO_REEXEC") != "1"
@@ -672,11 +840,20 @@ def _run(args):
             # or wedge fallback — benchmarks the serving path at the full
             # config-4 shape (annotations + reflect included; the per-pod
             # result JSON lives in the store until the next reset, ~13 GB
-            # at 10k x 5k — fine on this 128 GB host)
+            # at 10k x 5k).  The full-scale wave only runs when the HOST
+            # can hold that product: a memory-starved TPU host must not
+            # trade its headline artifact for a kernel OOM kill
             extra["engine_2k_1k"] = measure_engine(2000, 1000, args.seed)
-            extra["engine_10k_5k"] = measure_engine(
-                max(int(10000 * args.scale), 100),
-                max(int(5000 * args.scale), 50), args.seed)
+            avail = _available_gb()
+            if avail >= 20:
+                extra["engine_10k_5k"] = measure_engine(
+                    max(int(10000 * args.scale), 100),
+                    max(int(5000 * args.scale), 50), args.seed)
+            else:
+                log(f"skipping engine_10k_5k: only {avail:.1f} GiB "
+                    "available on this host (needs ~20 for the resident "
+                    "result store)")
+                extra["engine_10k_5k"] = None
             # the config-5 hard plugin on the serving path
             extra["engine_interpod"] = measure_engine(ep, en, args.seed,
                                                       interpod=True)
@@ -693,9 +870,14 @@ def _run(args):
         ).stdout.strip() or "norev"
     except OSError:
         rev = "norev"
-    cpu = measure_cpu_baseline(
-        args.config, args.cpu_scale, args.cpu_node_scale, args.seed,
-        args.cpu_parallelism, cache, rev)
+    with _host_phase_ticker():
+        # pure-host phase: the full-node-axis sequential divisor can run
+        # for several minutes with no log lines on a slow TPU-VM core —
+        # it cannot wedge on the tunnel, so keeping the watchdog fed is
+        # safe (advisor round-4 finding)
+        cpu = measure_cpu_baseline(
+            args.config, args.cpu_scale, args.cpu_node_scale, args.seed,
+            args.cpu_parallelism, cache, rev)
     try:
         cache_path.write_text(json.dumps(cache))
     except OSError:

@@ -1,41 +1,88 @@
 #!/bin/bash
-# TPU-tunnel recovery watcher (round 4).
+# TPU-tunnel recovery watcher (round 5).
 #
 # The axon tunnel wedges server-side for hours after a client dies mid-run
 # (see BASELINE.md / round-3 notes), and can also wedge MID-CALL (bench.py
-# now carries a hang watchdog that re-execs the CPU fallback).  This loop
+# carries a hang watchdog that re-execs the CPU fallback).  This loop
 # probes device init in a subprocess every ~10 min and, while the probe
-# succeeds, runs bench.py; it exits only once a NON-fallback real-TPU
-# artifact exists, so an unattended recovery still produces the number.
+# succeeds, runs bench.py; once a NON-fallback real-TPU artifact exists it
+# also captures the full-scale ON-DEVICE parity artifact (round-4 verdict
+# #5), then exits.
+#
+# Round-5 hygiene (the round-4 OOM post-mortem, r04-tpu-bench.err): a
+# previous wedged bench left running can hold GBs while a new bench
+# starts, inviting the kernel OOM killer.  The watcher therefore (a)
+# kills ITS OWN previous bench (tracked by pidfile) once the probe shows
+# the tunnel alive again, (b) bounds each bench with a hard timeout, and
+# (c) skips the attempt when MemAvailable is too low for the full shape.
 cd /root/repo || exit 1
-LOG=docs/bench/r04-tpu-watch.log
+LOG=docs/bench/r05-tpu-watch.log
+PIDFILE=/tmp/kss_tpu_watch_bench.pid
+
+avail_gb() { awk '/MemAvailable/{printf "%d", $2/1048576}' /proc/meminfo; }
+
+kill_leftover() {
+  if [ -f "$PIDFILE" ]; then
+    oldpid=$(cat "$PIDFILE")
+    if kill -0 "$oldpid" 2>/dev/null; then
+      echo "$(date -u +%FT%TZ) killing leftover bench pid $oldpid" >> "$LOG"
+      # $oldpid is the timeout(1) wrapper: TERM is forwarded to the bench
+      # child; escalate to KILL on wrapper AND children (a SIGKILLed
+      # wrapper alone would orphan the bench, which keeps holding memory
+      # and its open fd to the .tmp artifact)
+      kill "$oldpid" 2>/dev/null
+      sleep 10
+      pkill -9 -P "$oldpid" 2>/dev/null
+      kill -9 "$oldpid" 2>/dev/null
+      sleep 2
+    fi
+    rm -f "$PIDFILE"
+  fi
+}
+
 while true; do
   ts=$(date -u +%FT%TZ)
   if timeout 240 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    kill_leftover
+    if [ "$(avail_gb)" -lt 6 ]; then
+      echo "$ts probe: ALIVE but only $(avail_gb) GiB available; waiting" >> "$LOG"
+      sleep 300
+      continue
+    fi
     echo "$ts probe: ALIVE -> running bench.py" >> "$LOG"
     # write to temp files and promote the json+err PAIR only on non-empty
     # JSON, so a later SIGKILLed run cannot truncate or mismatch an
     # already-captured artifact pair; a failed attempt's stderr is kept
-    # separately for diagnosis
-    python bench.py > docs/bench/r04-tpu-bench.json.tmp 2> docs/bench/r04-tpu-bench.err.tmp
+    # separately for diagnosis.  Hard 2h cap: bench's internal hang
+    # watchdog should re-exec its own fallback long before this fires.
+    timeout -k 60 7200 python bench.py \
+      > docs/bench/r05-tpu-bench.json.tmp \
+      2> docs/bench/r05-tpu-bench.err.tmp &
+    echo $! > "$PIDFILE"
+    wait $!
     rc=$?
-    if [ -s docs/bench/r04-tpu-bench.json.tmp ]; then
-      mv docs/bench/r04-tpu-bench.json.tmp docs/bench/r04-tpu-bench.json
-      mv docs/bench/r04-tpu-bench.err.tmp docs/bench/r04-tpu-bench.err
+    rm -f "$PIDFILE"
+    if [ -s docs/bench/r05-tpu-bench.json.tmp ]; then
+      mv docs/bench/r05-tpu-bench.json.tmp docs/bench/r05-tpu-bench.json
+      mv docs/bench/r05-tpu-bench.err.tmp docs/bench/r05-tpu-bench.err
     else
-      rm -f docs/bench/r04-tpu-bench.json.tmp
-      mv docs/bench/r04-tpu-bench.err.tmp docs/bench/r04-tpu-bench-lastfail.err
+      rm -f docs/bench/r05-tpu-bench.json.tmp
+      mv docs/bench/r05-tpu-bench.err.tmp docs/bench/r05-tpu-bench-lastfail.err
     fi
     echo "$(date -u +%FT%TZ) bench rc=$rc (json+err under docs/bench/)" >> "$LOG"
     # success = non-empty, not a CPU-fallback run, and not a parity-gate
     # failure line (those emit "value": 0.0 and must be retried, not
     # recorded as the round's TPU artifact)
-    if [ -s docs/bench/r04-tpu-bench.json ] && \
-       ! grep -q cpu_fallback docs/bench/r04-tpu-bench.json && \
-       ! grep -q '"value": 0.0' docs/bench/r04-tpu-bench.json; then
+    if [ -s docs/bench/r05-tpu-bench.json ] && \
+       ! grep -q cpu_fallback docs/bench/r05-tpu-bench.json && \
+       ! grep -q '"value": 0.0' docs/bench/r05-tpu-bench.json; then
       echo "$(date -u +%FT%TZ) non-fallback TPU artifact captured" >> "$LOG"
-      timeout 1800 python docs/bench/unroll_sweep.py > docs/bench/r04-unroll-sweep.log 2>&1
-      echo "$(date -u +%FT%TZ) unroll sweep rc=$?; watcher done" >> "$LOG"
+      # round-4 verdict #5: full-scale parity ON DEVICE (config 4, then 5
+      # if the tunnel holds).  Streamed both sides, so it fits this host.
+      timeout -k 60 14400 python docs/bench/parity_fullscale.py \
+        docs/bench/r05-parity-fullscale-tpu.json --device --configs 4,5 \
+        > docs/bench/r05-parity-fullscale-tpu.log 2>&1
+      echo "$(date -u +%FT%TZ) device parity rc=$? ; watcher done" >> "$LOG"
       exit 0
     fi
   else
