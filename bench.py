@@ -674,6 +674,14 @@ def main():
     ap.add_argument("--assume-fallback", action="store_true",
                     help=argparse.SUPPRESS)  # set by the crash re-exec
     args = ap.parse_args()
+    # THP for the malloc arenas (re-execs once, before anything heavy):
+    # the annotation product is ~13 GB of live strings at full scale and
+    # 4 KiB-page first-touch faults dominate past this host's ~8 GB
+    # page-backing cliff; measured 450 -> 575 engine cycles/s
+    from kube_scheduler_simulator_tpu.utils.platform import (
+        ensure_malloc_hugepages)
+
+    ensure_malloc_hugepages()
     # the measured multi-core divisor's parallel-oracle workers must not
     # fork from this process once JAX threads exist (deadlock hazard);
     # start their forkserver NOW, while we are still single-threaded.

@@ -19,8 +19,9 @@ def main(argv=None) -> None:
                     help="simulator config.yaml path (env vars override)")
     args = ap.parse_args(argv)
 
-    from ..utils.platform import apply_env_platform
+    from ..utils.platform import apply_env_platform, ensure_malloc_hugepages
 
+    ensure_malloc_hugepages()  # THP arenas: the annotation product is GBs
     apply_env_platform()  # JAX_PLATFORMS=cpu must never touch the TPU tunnel
 
     from ..config.config import load_config

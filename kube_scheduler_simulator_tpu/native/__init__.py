@@ -85,6 +85,8 @@ def _build_and_load():
     ]
     lib.codec_ctx_free.restype = None
     lib.codec_ctx_free.argtypes = [ctypes.c_void_p]
+    lib.ctx_all_ascii.restype = ctypes.c_int32
+    lib.ctx_all_ascii.argtypes = [ctypes.c_void_p]
     lib.ctx_encode_filter.restype = ctypes.c_void_p
     lib.ctx_encode_filter.argtypes = [
         ctypes.c_void_p, P(ctypes.c_int32), P(ctypes.c_uint8),
@@ -116,6 +118,47 @@ def take_sized_string(lib, ptr, length: int) -> str:
         if _PyUnicode_DecodeUTF8 is not None:
             return _PyUnicode_DecodeUTF8(ptr, length, b"strict")
         return ctypes.string_at(ptr, length).decode()
+    finally:
+        lib.codec_free(ptr)
+
+
+# ASCII fast path: when the codec context proves every emitted byte is
+# ASCII (ctx_all_ascii), the str can be built by PyUnicode_New + memmove —
+# a plain vectorized copy instead of DecodeUTF8's validating scan.  The
+# data offset of a compact-ASCII str is derived at runtime
+# (sys.getsizeof("") counts PyASCIIObject + the NUL) and the whole path is
+# self-tested once at import; any surprise falls back to the decode path.
+_ASCII_TAKE_OK = False
+try:
+    import sys as _sys
+
+    _PyUnicode_New = ctypes.pythonapi.PyUnicode_New
+    _PyUnicode_New.restype = ctypes.py_object
+    _PyUnicode_New.argtypes = [ctypes.c_ssize_t, ctypes.c_uint32]
+    _ASCII_DATA_OFF = _sys.getsizeof("") - 1
+
+    def _ascii_take(ptr, length: int) -> str:
+        if length == 0:
+            return ""  # PyUnicode_New(0, ...) returns the shared singleton
+        s = _PyUnicode_New(length, 127)
+        # C buffers are NUL-terminated; copy the NUL along with the data
+        ctypes.memmove(id(s) + _ASCII_DATA_OFF, ptr, length + 1)
+        return s
+
+    _probe = b"probe{\"x\":\"1\"}"
+    _buf = ctypes.create_string_buffer(_probe)  # NUL-terminated
+    _ASCII_TAKE_OK = (_ascii_take(ctypes.addressof(_buf), len(_probe))
+                      == _probe.decode())
+except Exception:
+    _ASCII_TAKE_OK = False
+
+
+def take_sized_string_ascii(lib, ptr, length: int) -> str:
+    """take_sized_string for buffers PROVEN pure-ASCII by the codec ctx."""
+    if not _ASCII_TAKE_OK:
+        return take_sized_string(lib, ptr, length)
+    try:
+        return _ascii_take(ptr, length)
     finally:
         lib.codec_free(ptr)
 

@@ -20,6 +20,7 @@
 // the node's taint key/value).  Python builds the LUTs once per compiled
 // workload.
 
+#include <atomic>
 #include <cstdint>
 #include <charconv>
 #include <cstring>
@@ -255,6 +256,7 @@ char* encode_score_result(
 namespace {
 
 struct Ctx {
+    uint64_t uid = 0;                     // for thread-local cache keying
     int32_t n = 0, f = 0, s = 0;
     std::vector<int32_t> sorted_nodes;    // si -> node index j (name order)
     std::vector<int32_t> sorted_filters;  // k -> filter exec index (name order)
@@ -273,7 +275,17 @@ struct Ctx {
     std::vector<int32_t> score_kind;
     std::vector<int64_t> score_weight;
     int64_t tsp_big = 0;
+    // 1 when every fragment this ctx can emit is pure ASCII (append_escaped
+    // passes bytes >= 0x80 through verbatim, so non-ASCII names/messages
+    // clear it); lets the Python side build result strs with a plain
+    // memcpy instead of a UTF-8-validating decode
+    int32_t all_ascii = 1;
 };
+
+bool str_is_ascii(const std::string& s) {
+    for (unsigned char c : s) if (c >= 0x80) return false;
+    return true;
+}
 
 // raw output buffer: one malloc sized from an upper bound, pointer-bump
 // writes (std::string's per-append capacity checks and the final
@@ -309,6 +321,30 @@ struct FilterFrags {
     std::vector<Frag> frag;
     size_t max_frag = 0;
     bool any_active = false;
+};
+
+// Everything about the filter blob that depends only on (workload,
+// active set) — i.e. NOT on the per-pod codes: the per-fail-plugin
+// fragments, and `cat`, the full concatenation over name-sorted nodes of
+// "," + node_key + all_pass with per-node offsets.  Workloads run the
+// same active set for nearly every pod, and most nodes pass every
+// filter, so a pod's blob is mostly maximal RUNS of consecutive all-pass
+// nodes — each run emits as ONE memcpy out of `cat` (measured: the
+// per-node emit loop was the largest decode slice at 5k nodes, ~0.36
+// ms/pod; runs cut it to near-memcpy).  Cached thread-local, one entry
+// (active sets change between pods only on PreFilter-skip boundaries).
+struct FilterCache {
+    uint64_t uid = ~0ull;
+    uint64_t mask = 0;
+    bool valid = false;
+    FilterFrags ff;
+    std::string cat;
+    std::vector<uint32_t> off;  // [n+1] into cat
+    // pre-rendered head+msg+tail per (fail plugin, code) for plugins with
+    // a SHARED (not per-node) message LUT: a failing node then emits as
+    // key + ONE suffix memcpy instead of three puts
+    std::vector<std::string> suffix;      // indexed lut_off[pf] + code-1
+    std::vector<uint8_t> suffix_ok;       // same indexing; 0 = per-node LUT
 };
 
 void build_filter_frags(const Ctx& ctx, const uint8_t* active, FilterFrags& ff) {
@@ -355,11 +391,89 @@ void build_filter_frags(const Ctx& ctx, const uint8_t* active, FilterFrags& ff) 
                                fr.head.size() + ctx.max_msg + fr.tail.size());
 }
 
+// thread_local: ctx_decode_pod runs from a decode thread pool; each
+// thread keeps its own cache so no locking is needed.  Keyed by
+// (ctx uid, active bitmask); several entries live at once because pods
+// ALTERNATE between a handful of active sets (PreFilter-skip patterns —
+// measured 4 distinct masks at config 4 with the mask changing between
+// ~76% of consecutive pods, so a single-entry cache would rebuild its
+// ~1 MB cat nearly every pod).  f > 64 filters disables caching
+// (rebuild per pod — no real lineup is that large).
+const FilterCache& filter_cache_for(const Ctx& ctx, const uint8_t* active) {
+    thread_local std::vector<FilterCache> caches;
+    thread_local size_t victim = 0;
+    FilterCache* cache = nullptr;
+    uint64_t mask = 0;
+    bool cacheable = ctx.f <= 64;
+    if (cacheable) {
+        for (int32_t pf = 0; pf < ctx.f; ++pf)
+            if (active[pf]) mask |= 1ull << pf;
+        for (FilterCache& c : caches)
+            if (c.valid && c.uid == ctx.uid && c.mask == mask) return c;
+        if (caches.size() < 8) {
+            caches.emplace_back();
+            cache = &caches.back();
+        } else {
+            cache = &caches[victim];       // round-robin eviction
+            victim = (victim + 1) % caches.size();
+        }
+    } else {
+        thread_local FilterCache uncached;
+        cache = &uncached;
+    }
+    cache->valid = cacheable;
+    cache->uid = ctx.uid;
+    cache->mask = mask;
+    build_filter_frags(ctx, active, cache->ff);
+    if (!cacheable) {
+        // the run/suffix paths check fc.valid and can never read these —
+        // don't pay the O(n) concatenation per pod on the uncached path
+        cache->cat.clear();
+        cache->off.clear();
+        cache->suffix.clear();
+        cache->suffix_ok.clear();
+        return *cache;
+    }
+    const int32_t n = ctx.n;
+    cache->cat.clear();
+    cache->cat.reserve(ctx.sum_node_key
+                       + (size_t)n * (1 + cache->ff.all_pass.size()));
+    cache->off.assign((size_t)n + 1, 0);
+    for (int32_t si = 0; si < n; ++si) {
+        int32_t j = ctx.sorted_nodes[si];
+        cache->cat.push_back(',');
+        cache->cat += ctx.node_key[j];
+        cache->cat += cache->ff.all_pass;
+        cache->off[(size_t)si + 1] = (uint32_t)cache->cat.size();
+    }
+    int32_t total = ctx.lut_off.empty() ? 0 : ctx.lut_off.back();
+    cache->suffix.assign(total, {});
+    cache->suffix_ok.assign(total, 0);
+    for (int32_t pf = 0; pf < ctx.f; ++pf) {
+        if (!active[pf] || ctx.per_node[pf]) continue;
+        const FilterFrags::Frag& fr = cache->ff.frag[pf];
+        for (int32_t c = ctx.lut_off[pf]; c < ctx.lut_off[pf + 1]; ++c) {
+            cache->suffix[c] = fr.head + ctx.lut[c] + fr.tail;
+            cache->suffix_ok[c] = 1;
+        }
+    }
+    return *cache;
+}
+
 // fail_buf[j]: first-fail exec idx (f = all active passed); code_buf[j]:
-// the failing plugin's code (only read when fail_buf[j] < f)
-char* emit_filter_blob(const Ctx& ctx, const FilterFrags& ff,
+// the failing plugin's code (only read when fail_buf[j] < f).
+// n_fail picks the emit strategy: when failures are rare, maximal runs
+// of consecutive all-pass nodes memcpy straight out of the cached `cat`
+// (one big copy per run); when failures are dense the runs are short
+// (measured mean 2 at config 4's ~55% fail rate) and walking the ~1 MB
+// cat in scattered pieces costs more cache traffic than rendering from
+// the small L1-resident fragments — so the per-node path is kept, with
+// the pre-rendered (plugin, code) suffix turning a failing node into
+// two memcpys.
+char* emit_filter_blob(const Ctx& ctx, const FilterCache& fc,
                        const int32_t* fail_buf, const int32_t* code_buf,
-                       int64_t* out_len) {
+                       int32_t n_fail, int64_t* out_len) {
+    const FilterFrags& ff = fc.ff;
     const int32_t n = ctx.n, f = ctx.f;
     size_t cap = 3 + (ff.any_active
         ? ctx.sum_node_key + (size_t)n * (1 + ff.max_frag) : 0);
@@ -367,28 +481,52 @@ char* emit_filter_blob(const Ctx& ctx, const FilterFrags& ff,
     char* w = buf;
     *w++ = '{';
     bool first_node = true;
-    for (int32_t si = 0; si < n && ff.any_active; ++si) {
+    // mean all-pass run length >= ~128 nodes before the cat walk pays
+    const bool use_runs = fc.valid && n_fail * 128 < n;
+    int32_t si = 0;
+    while (si < n && ff.any_active) {
         int32_t j = ctx.sorted_nodes[si];
+        int32_t fail_at = fail_buf[j];
+        if (fail_at == f && use_runs) {
+            // maximal run of consecutive all-pass nodes -> one memcpy of
+            // the cached ",node":{...passed...}" bytes (skip the leading
+            // comma at blob start)
+            int32_t run_end = si + 1;
+            while (run_end < n && fail_buf[ctx.sorted_nodes[run_end]] == f)
+                ++run_end;
+            const char* src = fc.cat.data() + fc.off[si];
+            size_t len = fc.off[run_end] - fc.off[si];
+            if (first_node) { ++src; --len; first_node = false; }
+            put(w, src, len);
+            si = run_end;
+            continue;
+        }
         if (!first_node) *w++ = ',';
         first_node = false;
         put(w, ctx.node_key[j]);
-        int32_t fail_at = fail_buf[j];
         if (fail_at == f) {
             put(w, ff.all_pass);
-        } else {
-            const FilterFrags::Frag& fr = ff.frag[fail_at];
-            put(w, fr.head);
-            int32_t span = ctx.lut_off[fail_at + 1] - ctx.lut_off[fail_at];
-            int32_t base = ctx.lut_off[fail_at];
-            int32_t code = code_buf[j];
-            if (ctx.per_node[fail_at]) {
-                int32_t stride = span / n;
-                put(w, ctx.lut[base + (size_t)j * stride + (code - 1)]);
-            } else {
-                put(w, ctx.lut[base + (code - 1)]);
-            }
-            put(w, fr.tail);
+            ++si;
+            continue;
         }
+        int32_t base = ctx.lut_off[fail_at];
+        int32_t code = code_buf[j];
+        if (fc.valid && fc.suffix_ok[base + (code - 1)]) {
+            put(w, fc.suffix[base + (code - 1)]);
+            ++si;
+            continue;
+        }
+        const FilterFrags::Frag& fr = ff.frag[fail_at];
+        put(w, fr.head);
+        int32_t span = ctx.lut_off[fail_at + 1] - ctx.lut_off[fail_at];
+        if (ctx.per_node[fail_at]) {
+            int32_t stride = span / n;
+            put(w, ctx.lut[base + (size_t)j * stride + (code - 1)]);
+        } else {
+            put(w, ctx.lut[base + (code - 1)]);
+        }
+        put(w, fr.tail);
+        ++si;
     }
     *w++ = '}';
     *w = 0;
@@ -415,6 +553,8 @@ void* codec_ctx_new(
     const int64_t* score_weight,
     int64_t tsp_big) {
     Ctx* ctx = new Ctx();
+    static std::atomic<uint64_t> next_uid{1};
+    ctx->uid = next_uid.fetch_add(1);
     ctx->n = n; ctx->f = f; ctx->s = s;
     ctx->sorted_nodes.assign(sorted_nodes, sorted_nodes + n);
     ctx->sorted_filters.assign(sorted_filters, sorted_filters + f);
@@ -441,8 +581,14 @@ void* codec_ctx_new(
     ctx->score_kind.assign(score_kind, score_kind + s);
     ctx->score_weight.assign(score_weight, score_weight + s);
     ctx->tsp_big = tsp_big;
+    for (const auto& v : {&ctx->node_key, &ctx->filter_key,
+                          &ctx->score_key, &ctx->lut})
+        for (const std::string& str : *v)
+            if (!str_is_ascii(str)) { ctx->all_ascii = 0; break; }
     return ctx;
 }
+
+int32_t ctx_all_ascii(void* p) { return ((const Ctx*)p)->all_ascii; }
 
 void codec_ctx_free(void* p) { delete (Ctx*)p; }
 
@@ -454,6 +600,7 @@ char* ctx_encode_filter(void* p, const int32_t* codes, const uint8_t* active,
     thread_local std::vector<int32_t> code_buf;
     fail_buf.resize(n);
     code_buf.resize(n);
+    int32_t n_fail = 0;
     for (int32_t j = 0; j < n; ++j) {
         int32_t fail_at = f, code = 0;
         for (int32_t pf = 0; pf < f; ++pf) {
@@ -463,10 +610,11 @@ char* ctx_encode_filter(void* p, const int32_t* codes, const uint8_t* active,
         }
         fail_buf[j] = fail_at;
         code_buf[j] = code;
+        n_fail += (fail_at != f);
     }
-    FilterFrags ff;
-    build_filter_frags(ctx, active, ff);
-    return emit_filter_blob(ctx, ff, fail_buf.data(), code_buf.data(), out_len);
+    return emit_filter_blob(ctx, filter_cache_for(ctx, active),
+                            fail_buf.data(), code_buf.data(), n_fail,
+                            out_len);
 }
 
 // Fused per-pod decode from the COMPACT replay layout: reads the packed
@@ -533,6 +681,7 @@ int32_t ctx_decode_pod(
     fail_buf.resize(n);
     code_buf.resize(n);
 
+    int32_t n_fail = 0;
     for (int32_t j = 0; j < n; ++j) {
         uint64_t w = read_packed(packed, pack_elem, j);
         int32_t ffp = (int32_t)(w >> code_bits);
@@ -541,15 +690,15 @@ int32_t ctx_decode_pod(
         if (ffp > 0 && ffp <= f && code != 0 && active[ffp - 1]) {
             fail_buf[j] = ffp - 1;
             code_buf[j] = code;
+            ++n_fail;
         } else {
             fail_buf[j] = f;  // all active plugins passed (or fail not active)
             code_buf[j] = 0;
         }
     }
 
-    FilterFrags ff;
-    build_filter_frags(ctx, active, ff);
-    out_blobs[0] = emit_filter_blob(ctx, ff, fail_buf.data(), code_buf.data(),
+    out_blobs[0] = emit_filter_blob(ctx, filter_cache_for(ctx, active),
+                                    fail_buf.data(), code_buf.data(), n_fail,
                                     &out_lens[0]);
     out_blobs[1] = out_blobs[2] = nullptr;
     out_lens[1] = out_lens[2] = 0;

@@ -185,15 +185,28 @@ def build(table: NodeTable, pods: list[dict]):
     n_groups = max(len(group_list), 1)
 
     # --- domain indexing per group key -----------------------------------
+    # the domain row depends only on (node labels, topologyKey) — cache it
+    # on the NodeTable so the engine's per-wave rebuild (reuse=NodeTable)
+    # skips the n-iteration Python loop for keys it has already indexed
+    dom_cache = getattr(table, "_tsp_dom_cache", None)
+    if dom_cache is None:
+        dom_cache = {}
+        table._tsp_dom_cache = dom_cache
     dom_idx = np.full((n_groups, n), -1, dtype=np.int32)
     n_domains = np.zeros(n_groups, dtype=np.int64)
     for c_id, (_, key, _) in enumerate(group_list):
-        vals: dict[str, int] = {}
-        for j in range(n):
-            v = labels[j].get(key)
-            if v is not None:
-                dom_idx[c_id, j] = vals.setdefault(v, len(vals))
-        n_domains[c_id] = len(vals)
+        hit = dom_cache.get(key)
+        if hit is None:
+            vals: dict[str, int] = {}
+            row = np.full(n, -1, dtype=np.int32)
+            for j in range(n):
+                v = labels[j].get(key)
+                if v is not None:
+                    row[j] = vals.setdefault(v, len(vals))
+            hit = (row, len(vals))
+            dom_cache[key] = hit
+        dom_idx[c_id] = hit[0]
+        n_domains[c_id] = hit[1]
     d_max = max(int(dom_idx.max()) + 1, 1)
 
     # --- pod x group selector matches ------------------------------------

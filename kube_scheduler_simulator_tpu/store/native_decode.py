@@ -13,7 +13,8 @@ import ctypes
 
 import numpy as np
 
-from ..native import get_lib, take_sized_string, take_string
+from ..native import (get_lib, take_sized_string, take_sized_string_ascii,
+                      take_string)
 from ..plugins import (
     affinity, interpod, nodevolumelimits, ports, taints, topologyspread,
     volumebinding, volumerestrictions, volumezone,
@@ -166,7 +167,7 @@ class _NativeCtx:
     """Owns one C-side codec context; freed with the workload."""
 
     __slots__ = ("lib", "ptr", "n", "active_rows", "sskip_rows",
-                 "has_tsp_score", "__weakref__")
+                 "has_tsp_score", "take", "__weakref__")
 
     def __init__(self, lib, ptr, n):
         self.lib = lib
@@ -175,6 +176,10 @@ class _NativeCtx:
         self.active_rows = None
         self.sskip_rows = None
         self.has_tsp_score = False
+        # blob -> str builder: plain memcpy when the ctx proves every
+        # emitted byte ASCII, else the UTF-8-validating decode
+        self.take = (take_sized_string_ascii if lib.ctx_all_ascii(ptr)
+                     else take_sized_string)
 
     def __del__(self):
         if self.ptr:
@@ -200,7 +205,7 @@ def encode_filter(ctx: _NativeCtx, codes: np.ndarray, active: np.ndarray) -> str
     out_len = ctypes.c_int64()
     ptr = ctx.lib.ctx_encode_filter(ctx.ptr, _i32p(codes), _u8p(active),
                                     ctypes.byref(out_len))
-    return take_sized_string(ctx.lib, ptr, out_len.value)
+    return ctx.take(ctx.lib, ptr, out_len.value)
 
 
 def encode_scores(ctx: _NativeCtx, values: np.ndarray, sskip: np.ndarray,
@@ -211,7 +216,7 @@ def encode_scores(ctx: _NativeCtx, values: np.ndarray, sskip: np.ndarray,
     out_len = ctypes.c_int64()
     ptr = ctx.lib.ctx_encode_scores(ctx.ptr, _i64p(values), _u8p(sskip),
                                     _u8p(feasible), ctypes.byref(out_len))
-    return take_sized_string(ctx.lib, ptr, out_len.value)
+    return ctx.take(ctx.lib, ptr, out_len.value)
 
 
 def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
@@ -289,12 +294,12 @@ def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
         col_ptrs, col_elem, ignored_ptr, 1 if want_scores else 0,
         out_blobs, out_lens,
     )
-    filter_json = take_sized_string(ctx.lib, out_blobs[0], out_lens[0])
+    filter_json = ctx.take(ctx.lib, out_blobs[0], out_lens[0])
     score_json = final_json = None
     if out_blobs[1]:
-        score_json = take_sized_string(ctx.lib, out_blobs[1], out_lens[1])
+        score_json = ctx.take(ctx.lib, out_blobs[1], out_lens[1])
     if out_blobs[2]:
-        final_json = take_sized_string(ctx.lib, out_blobs[2], out_lens[2])
+        final_json = ctx.take(ctx.lib, out_blobs[2], out_lens[2])
     return filter_json, score_json, final_json
 
 

@@ -55,6 +55,50 @@ def effective_cpu_count() -> int:
         return os.cpu_count() or 1
 
 
+def ensure_malloc_hugepages() -> bool:
+    """Re-exec this process once with GLIBC_TUNABLES=glibc.malloc.hugetlb=1
+    so glibc madvise(MADV_HUGEPAGE)s its arenas.
+
+    The annotation product is tens of GB of live strings at the full
+    benchmark shape; with 4 KiB pages the first touch of every page is a
+    fault, and this class of host collapses to ~200 MB/s fault bandwidth
+    past ~8 GB resident (docs/bench/r04-host-page-backing.json).  THP
+    cuts faults ~512x: measured 450 -> 575 engine cycles/s at 10k x 5k
+    on the bench host.  The tunable is only read by glibc at process
+    start, hence the re-exec; callers must invoke this FIRST in main(),
+    before heavy imports.  Returns False when already active or not
+    applicable (non-Linux, THP 'never', KSS_NO_HUGEPAGE_REEXEC=1) — on
+    success the process is replaced and the call never returns."""
+    import sys
+
+    if not sys.platform.startswith("linux"):
+        return False
+    cur = os.environ.get("GLIBC_TUNABLES", "")
+    if ("glibc.malloc.hugetlb" in cur
+            or os.environ.get("KSS_NO_HUGEPAGE_REEXEC") == "1"):
+        return False
+    try:
+        with open("/sys/kernel/mm/transparent_hugepage/enabled") as f:
+            if "[never]" in f.read():
+                return False
+    except OSError:
+        return False
+    env = dict(os.environ)
+    env["GLIBC_TUNABLES"] = ((cur + ":") if cur else "") + "glibc.malloc.hugetlb=1"
+    env["KSS_NO_HUGEPAGE_REEXEC"] = "1"  # belt+braces against exec loops
+    # `python -m pkg.mod` must re-exec as -m (argv[0] is the module FILE,
+    # and running it directly breaks the package's relative imports)
+    main_spec = getattr(sys.modules.get("__main__"), "__spec__", None)
+    if main_spec is not None and main_spec.name:
+        argv = [sys.executable, "-m", main_spec.name] + sys.argv[1:]
+    else:
+        argv = [sys.executable] + sys.argv
+    try:
+        os.execve(sys.executable, argv, env)
+    except OSError:
+        return False
+
+
 def tune_host_allocator() -> bool:
     """Keep glibc from returning freed large blocks to the kernel.
 
