@@ -1,10 +1,12 @@
 """Standalone recorder CLI (reference: simulator/cmd/sched-recorder/recorder.go:31-93).
 
-Watches the 7 resource kinds on a (simulated or remote) cluster and
-appends JSON-lines records to --path.  Flags mirror the reference:
---path is required; --kubeconfig points at the cluster (here: the
-simulator server's URL instead of a kubeconfig file); --duration limits
-the recording (0 = until SIGINT, the reference's behavior without
+Watches the 7 resource kinds on a cluster and appends JSON-lines records
+to --path.  Flags mirror the reference: --path is required; --kubeconfig
+points at the cluster — an actual kubeconfig FILE for a real
+kube-apiserver (the reference's clientcmd path, recorder.go:69-93), a
+bare real-apiserver URL (KWOK without auth), or a simulator server URL
+(cluster/kubeapi.connect_source decides); --duration limits the
+recording (0 = until SIGINT, the reference's behavior without
 --duration).
 """
 
@@ -19,16 +21,17 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="sched-recorder")
     ap.add_argument("--path", required=True, help="record file to write (JSON lines)")
     ap.add_argument("--kubeconfig", default="http://localhost:1212",
-                    help="cluster to record: simulator server URL")
+                    help="cluster to record: kubeconfig file path, real "
+                         "apiserver URL, or simulator server URL")
     ap.add_argument("--duration", type=float, default=0,
                     help="seconds to record; 0 records until SIGINT")
     args = ap.parse_args(argv)
 
-    from ..cluster.remote import RemoteCluster
+    from ..cluster.kubeapi import connect_source
     from ..services.recorder import RecorderService
 
-    remote = RemoteCluster(args.kubeconfig)
-    recorder = RecorderService(remote, args.path)
+    source = connect_source(args.kubeconfig)
+    recorder = RecorderService(source, args.path)
     recorder.run()
     print(f"recording {args.kubeconfig} -> {args.path}")
 
@@ -37,7 +40,10 @@ def main(argv=None) -> None:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     stop.wait(args.duration if args.duration > 0 else None)
     recorder.stop()
-    remote.close()
+    if hasattr(source, "close"):
+        source.close()
+    elif hasattr(source, "stop"):
+        source.stop()
     print("recording stopped")
 
 

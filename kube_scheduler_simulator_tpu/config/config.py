@@ -110,6 +110,8 @@ def load_config(path: str = "./config.yaml") -> SimulatorConfiguration:
     cfg.replayer_enabled = _env_bool("REPLAYER_ENABLED", cfg.replayer_enabled)
     if env.get("RECORD_FILE_PATH"):
         cfg.record_file_path = env["RECORD_FILE_PATH"]
+    if env.get("KUBE_CONFIG"):
+        cfg.kube_config = env["KUBE_CONFIG"]
     cfg.external_scheduler_enabled = _env_bool(
         "EXTERNAL_SCHEDULER_ENABLED", cfg.external_scheduler_enabled)
     if env.get("EXTRA_RESOURCES"):
