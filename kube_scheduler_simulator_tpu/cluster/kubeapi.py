@@ -89,12 +89,15 @@ def _label_selector_str(sel) -> str:
 
 def _data_or_file(data_b64: str | None, path: str | None,
                   keep: list) -> str | None:
-    """Inline base64 kubeconfig data -> temp file (ssl wants paths)."""
+    """Inline base64 kubeconfig data -> temp file (ssl wants paths).
+    Files land in `keep` and are unlinked by the caller the moment the
+    SSL context has loaded them — key material must not linger in
+    $TMPDIR."""
     if data_b64:
         f = tempfile.NamedTemporaryFile(suffix=".pem", delete=False)
         f.write(base64.b64decode(data_b64))
         f.flush()
-        keep.append(f)  # keep the handle so the file outlives the loader
+        keep.append(f)
         return f.name
     return path
 
@@ -133,21 +136,33 @@ def load_kubeconfig(path: str, context: str | None = None):
 
     sslctx = None
     if server.startswith("https"):
+        import os
+
         keep: list = []
-        if cluster.get("insecure-skip-tls-verify"):
-            sslctx = ssl.create_default_context()
-            sslctx.check_hostname = False
-            sslctx.verify_mode = ssl.CERT_NONE
-        else:
-            ca = _data_or_file(cluster.get("certificate-authority-data"),
-                               cluster.get("certificate-authority"), keep)
-            sslctx = ssl.create_default_context(cafile=ca)
-        cert = _data_or_file(user.get("client-certificate-data"),
-                             user.get("client-certificate"), keep)
-        key = _data_or_file(user.get("client-key-data"),
-                            user.get("client-key"), keep)
-        if cert and key:
-            sslctx.load_cert_chain(cert, key)
+        try:
+            if cluster.get("insecure-skip-tls-verify"):
+                sslctx = ssl.create_default_context()
+                sslctx.check_hostname = False
+                sslctx.verify_mode = ssl.CERT_NONE
+            else:
+                ca = _data_or_file(cluster.get("certificate-authority-data"),
+                                   cluster.get("certificate-authority"), keep)
+                sslctx = ssl.create_default_context(cafile=ca)
+            cert = _data_or_file(user.get("client-certificate-data"),
+                                 user.get("client-certificate"), keep)
+            key = _data_or_file(user.get("client-key-data"),
+                                user.get("client-key"), keep)
+            if cert and key:
+                sslctx.load_cert_chain(cert, key)
+        finally:
+            # ssl loads files eagerly — inline cert/key material must not
+            # outlive this call on disk
+            for f in keep:
+                f.close()
+                try:
+                    os.unlink(f.name)
+                except OSError:
+                    pass
     return server, sslctx, headers
 
 
@@ -245,6 +260,8 @@ class KubeAPICluster:
     def get(self, resource: str, name: str, namespace: str | None = None,
             **_kw) -> dict:
         namespaced = self.paths.get(resource, ("", False))[1]
+        if namespaced and not namespace:
+            namespace = "default"  # ObjectStore.get parity
         return self._json("GET", self._url(
             resource, name, namespace if namespaced else None))
 
@@ -296,8 +313,9 @@ class KubeAPICluster:
             raise NotFound(f"resource {resource!r} has no API path")
         q: queue.Queue = queue.Queue()
         with self._lock:
-            self._watchers.setdefault(resource, []).append(q)
-            if resource not in self._watch_threads:
+            start_thread = resource not in self._watch_threads
+            if start_thread:
+                self._watchers.setdefault(resource, []).append(q)
                 stop = threading.Event()
                 t = threading.Thread(target=self._watch_loop,
                                      args=(resource, stop), daemon=True,
@@ -305,6 +323,17 @@ class KubeAPICluster:
                 self._watch_stop[resource] = stop
                 self._watch_threads[resource] = t
                 t.start()
+        if not start_thread:
+            # the shared loop's initial-state replay already happened;
+            # give THIS subscriber its own ADDED replay before joining
+            # the live fanout, so every subscriber sees ListAndWatch
+            # semantics regardless of arrival order
+            items, _ = self._list_raw(resource)
+            for obj in items:
+                orv = (obj.get("metadata") or {}).get("resourceVersion")
+                q.put((self._rv_int(orv), ADDED, obj))
+            with self._lock:
+                self._watchers.setdefault(resource, []).append(q)
         return q
 
     def unwatch(self, resource: str, q: queue.Queue) -> None:
@@ -378,6 +407,8 @@ class KubeAPICluster:
                         if mapped is None:
                             continue
                         resume_rv = rv_str or resume_rv
+                        if stop.is_set():
+                            return  # superseded loop must not double-fan
                         self._fanout(resource,
                                      (self._rv_int(rv_str), mapped, obj))
             except NotFound:

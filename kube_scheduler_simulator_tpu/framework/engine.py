@@ -49,7 +49,7 @@ class SchedulerEngine:
     def __init__(self, store: ObjectStore, reflector: StoreReflector | None = None,
                  result_store: ResultStore | None = None,
                  plugin_config: PluginSetConfig | None = None,
-                 chunk: int = 512, mesh=None):
+                 chunk: int = 512, mesh=None, unroll: int = 2):
         self.store = store
         self.result_store = result_store or ResultStore()
         self.reflector = reflector or StoreReflector(store)
@@ -57,6 +57,9 @@ class SchedulerEngine:
             self.reflector.add_result_store(self.result_store, RESULT_STORE_KEY)
         self.plugin_config = plugin_config or PluginSetConfig()
         self.chunk = chunk
+        # lax.scan unroll for replay waves: the step's [N] ops are tiny,
+        # so per-iteration overhead matters (bench.py --unroll default)
+        self.unroll = unroll
         # optional jax.sharding.Mesh with a "nodes" axis: every batched
         # replay shards the node axis across it (parallel/mesh.py)
         self.mesh = mesh
@@ -422,7 +425,7 @@ class SchedulerEngine:
             # the rest — decode per pod so an aborted wave wastes nothing
             with TRACER.span("device_replay", pods=len(pending), nodes=len(nodes)):
                 rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                            mesh=mesh)
+                            mesh=mesh, unroll=self.unroll)
             all_annotations = _LazyDecode(rr)
         else:
             # stream: each chunk decodes (host, thread pool) as soon as its
@@ -431,7 +434,7 @@ class SchedulerEngine:
             with TRACER.span("replay_and_decode_stream", pods=len(pending),
                              nodes=len(nodes)):
                 rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                            mesh=mesh,
+                            mesh=mesh, unroll=self.unroll,
                             on_chunk=lambda rr_, lo, hi: decode_chunk_into(
                                 rr_, lo, hi, all_annotations))
         return self._finish_wave(cw, rr, all_annotations, pending, exclude)

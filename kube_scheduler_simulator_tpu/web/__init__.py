@@ -12,6 +12,9 @@ laid out the same way the reference splits concerns:
                   (the vue-monaco analogue), scheduling-result tables
                   from the Pod annotations
                   (reference: web/components/, lib/util.ts:30-44)
+  forms.js      — structured creation dialogs (per-kind field forms ->
+                  manifest) + the scheduler-config plugin table
+                  (reference: web/components/ per-resource dialogs)
   app.js        — navigation/drawer shell (reference: pages/index.vue)
   yaml.js       — YAML codec for the k8s-manifest subset
 

@@ -9,6 +9,7 @@ const state = {
   tab: "manifest",
   editorNew: false,
   editorFmt: "yaml",
+  formValues: null,    // structured-dialog values, kept across tab switches
   listUI: {},          // per-resource sort/filter state
 };
 
@@ -32,7 +33,10 @@ function openNew(r) {
   state.current = { resource: r, key: null,
                     obj: JSON.parse(JSON.stringify(TEMPLATES[r])) };
   state.editorNew = true;
-  state.tab = "manifest";
+  state.formValues = null;  // fresh dialog, fresh defaults
+  // structured creation dialog (reference: web/components/ per-resource
+  // dialogs); kinds without field definitions fall back to the editor
+  state.tab = FORM_FIELDS[r] ? "form" : "manifest";
   openDrawer("new " + r.replace(/s$/, ""));
 }
 function openObj(r, k) {
@@ -53,6 +57,8 @@ function closeDrawer() {
 }
 function renderDrawerTabs() {
   const tabs = [["manifest", "Manifest"]];
+  if (state.current && state.editorNew && FORM_FIELDS[state.current.resource])
+    tabs.unshift(["form", "Form"]);
   if (state.current && state.current.resource === "pods" && !state.editorNew)
     tabs.push(["results", "Scheduling results"]);
   document.getElementById("drawerTabs").innerHTML = tabs.map(([t, label]) =>
@@ -63,6 +69,12 @@ function renderDrawerBody() {
   const el = document.getElementById("drawerBody");
   const cur = state.current;
   if (!cur) return;
+  if (state.tab === "form") {
+    el.innerHTML = formHtml(cur.resource, state.formValues)
+      + `<div id="editMsg" class="msg"></div>`;
+    document.getElementById("applyBtn").style.display = "";
+    return;
+  }
   if (state.tab === "manifest") {
     el.innerHTML = `<div class="toolbar"><span class="kv">format</span>
         <select id="manFmt"><option ${state.editorFmt === "yaml" ? "selected" : ""}>yaml</option>
@@ -92,14 +104,18 @@ function renderDrawerBody() {
 async function applyEdit() {
   const msg = document.getElementById("editMsg");
   try {
-    const text = document.getElementById("editor").value;
-    const obj = state.editorFmt === "yaml" ? YAML.parse(text) : JSON.parse(text);
     const r = state.current.resource;
+    const obj = state.tab === "form"
+      ? buildManifest(r, collectForm(r))
+      : (state.editorFmt === "yaml"
+          ? YAML.parse(document.getElementById("editor").value)
+          : JSON.parse(document.getElementById("editor").value));
     if (state.editorNew) await API.create(r, obj);
     else await API.update(r, obj);
     msg.className = "msg ok";
     msg.textContent = "applied";
     state.editorNew = false;
+    state.current.obj = obj;
   } catch (e) { msg.className = "msg err"; msg.textContent = e.message; }
 }
 async function deleteCurrent() {
@@ -156,6 +172,13 @@ function boot() {
   document.getElementById("drawerTabs").addEventListener("click", (e) => {
     const a = e.target.closest("a[data-tab]");
     if (a) {
+      if (state.tab === "form" && a.dataset.tab === "manifest") {
+        // leaving the form: keep the entered values for the round-trip
+        // and seed the editor with the built manifest
+        state.formValues = collectForm(state.current.resource);
+        state.current.obj = buildManifest(
+          state.current.resource, state.formValues);
+      }
       state.tab = a.dataset.tab;
       renderDrawerTabs();
       renderDrawerBody();

@@ -278,15 +278,30 @@ async function renderSchedulerConfig(el) {
       <span class="kv">format</span>
       <select id="cfgFmt"><option>yaml</option><option>json</option></select>
       <button class="primary" id="cfgApply">Apply</button></div>
+    <h3 class="sect">plugins (structured — folded into the manifest on Apply)</h3>
+    <div id="plugPanel"></div>
+    <h3 class="sect">manifest</h3>
     ${editorHtml("schedCfg")}<div id="cfgMsg" class="msg"></div>
     <p class="kv">POST applies profiles + extenders and restarts the scheduler
       (handler/schedulerconfig.go:41-63 semantics).</p>`;
   hookEditor("schedCfg");
   let fmt = "yaml";
   let cfg = null;
+  let plugState = null;
+  const plugPanel = document.getElementById("plugPanel");
   try {
     cfg = await API.getSchedulerConfig();
     setEditorValue("schedCfg", YAML.dump(cfg));
+    plugState = pluginStateFromConfig(cfg);
+    plugPanel.innerHTML = pluginTableHtml(plugState);
+    plugPanel.addEventListener("change", (ev) => {
+      const cb = ev.target.closest("input[data-plug]");
+      if (!cb) return;
+      // keep the weight cell's enabled state in step with the checkbox
+      const w = plugPanel.querySelector(
+        `input[data-plugw="${cb.dataset.plug}"]`);
+      if (w) w.disabled = !cb.checked;
+    });
   } catch (e) { document.getElementById("cfgMsg").textContent = e.message; }
   document.getElementById("cfgFmt").addEventListener("change", (ev) => {
     const msg = document.getElementById("cfgMsg");
@@ -302,7 +317,17 @@ async function renderSchedulerConfig(el) {
     const msg = document.getElementById("cfgMsg");
     try {
       const cur = document.getElementById("schedCfg").value;
-      await API.applySchedulerConfig(fmt === "yaml" ? YAML.parse(cur) : JSON.parse(cur));
+      let obj = fmt === "yaml" ? YAML.parse(cur) : JSON.parse(cur);
+      if (plugState) {
+        // only the DIFF vs the rendered state is folded in — an
+        // untouched table leaves wildcard/per-point plugin config alone
+        const initial = pluginStateFromConfig(obj);
+        collectPluginTable(plugPanel, plugState);
+        obj = applyPluginStateToConfig(obj, plugState, initial);
+        setEditorValue("schedCfg", fmt === "yaml"
+          ? YAML.dump(obj) : JSON.stringify(obj, null, 2));
+      }
+      await API.applySchedulerConfig(obj);
       msg.className = "msg ok"; msg.textContent = "applied (scheduler restarted)";
     } catch (e) { msg.className = "msg err"; msg.textContent = e.message; }
   });

@@ -18,7 +18,8 @@ from kube_scheduler_simulator_tpu.web import index_html, static_file
 
 # ---------------------------------------------------------------- assets
 
-ASSETS = ["yaml.js", "api.js", "store.js", "components.js", "app.js"]
+ASSETS = ["yaml.js", "api.js", "store.js", "components.js", "forms.js",
+          "app.js"]
 
 
 def test_static_assets_exist_and_are_typed():
@@ -383,3 +384,58 @@ def test_mirror_matches_js_source_expectations():
         'val.endsWith("\\n") ? "|" : "|-"',
     ]:
         assert marker in js, f"yaml.js drifted from mirror: {marker!r} missing"
+
+
+# ------------------------------------------------- structured form dialogs
+
+def _forms_js() -> str:
+    src, _ = static_file("forms.js")
+    return src.decode()
+
+
+def test_form_fields_cover_creatable_kinds():
+    """Every kind with a structured creation dialog is one the server can
+    actually create (FORM_FIELDS keys are resource paths)."""
+    from kube_scheduler_simulator_tpu.cluster.store import RESOURCES
+
+    src = _forms_js()
+    kinds = re.findall(r"^  (\w+): \[", src, re.M)
+    assert set(kinds) <= set(RESOURCES), kinds
+    # the seven simulator GVRs all get a dialog
+    assert {"pods", "nodes", "namespaces", "persistentvolumes",
+            "persistentvolumeclaims", "storageclasses",
+            "priorityclasses"} <= set(kinds)
+
+
+def test_plugin_table_matches_registry():
+    """The UI's structured plugin table must not drift from the server's
+    plugin registry: same names/order, same filter/score points, same
+    default weights (plugins/registry.py DEFAULT_ORDER)."""
+    from kube_scheduler_simulator_tpu.plugins.registry import (
+        DEFAULT_ORDER, PLUGIN_REGISTRY)
+
+    src = _forms_js()
+    rows = re.findall(
+        r'\["(\w+)", (true|false), (true|false), (\d+)\]', src)
+    assert [r[0] for r in rows] == DEFAULT_ORDER
+    for name, has_f, has_s, weight in rows:
+        desc = PLUGIN_REGISTRY[name]
+        assert (has_f == "true") == desc.has_filter, name
+        assert (has_s == "true") == desc.has_score, name
+        if desc.has_score:
+            assert int(weight) == desc.default_weight, name
+
+
+def test_form_manifest_builder_paths():
+    """The JS form->manifest builder writes the spec paths the scheduler
+    engine reads (a Python mirror of buildManifest's field routing)."""
+    src = _forms_js()
+    # pod fields land under spec / container 0
+    for needle in ["spec.nodeSelector = sel", "spec.priorityClassName",
+                   "spec.schedulerName", "spec.tolerations = tol",
+                   "c0.resources.requests.cpu",
+                   "c0.resources.requests.memory"]:
+        assert needle.split(" = ")[0].split(".")[-1] in src, needle
+    assert "obj.status.capacity" in src and "obj.status.allocatable" in src
+    assert ".taints = taints" in src
+    assert "volumeBindingMode" in src and "globalDefault" in src
