@@ -39,6 +39,19 @@ def _encode_record(result_set: dict[str, str]) -> str:
     return rec if rec is not None else ann.marshal(result_set)
 
 
+def _objects_only(raw: str) -> bool:
+    """True when every element boundary in a compact JSON array is
+    object-to-object: each "}," is followed by "{".  One scan, no parse;
+    conservative — a "}," inside a string value false-positives and the
+    caller just takes the slow parsing path instead."""
+    i = raw.find("},")
+    while i != -1:
+        if i + 2 >= len(raw) or raw[i + 2] != "{":
+            return False
+        i = raw.find("},", i + 2)
+    return True
+
+
 def update_result_history(pod: dict, result_set: dict[str, str]) -> None:
     """Append result_set to the result-history annotation, trimming oldest
     entries until the encoded JSON fits the 256KiB limit.
@@ -63,11 +76,16 @@ def update_result_history(pod: dict, result_set: dict[str, str]) -> None:
     # textual-splice fast path: only for values shaped like this
     # function's own output (empty array, or array of objects) — anything
     # else falls through to the parsing path so corrupt histories raise
-    # instead of being spliced into deeper corruption.  Residual trust:
-    # a value that keeps the '[{"..."}]' shell but is internally invalid
-    # still splices (validating would mean re-parsing ~256 KiB per pod,
-    # the cost this fast path exists to avoid).
-    if raw == "[]" or (raw.startswith('[{"') and raw.endswith('"}]')):
+    # instead of being spliced into deeper corruption.  _objects_only
+    # proves every element boundary is object-to-object without a full
+    # parse (conservative: a legit value containing "}," that isn't a
+    # boundary just falls to the slow path).  Residual trust: an object
+    # element whose VALUES aren't strings (e.g. '[{"k":1,"m":"s"}]') can
+    # keep the shell and splice where the reference's map[string]string
+    # unmarshal would error — full validation would re-parse ~256 KiB
+    # per pod, the cost this fast path exists to avoid.
+    if raw == "[]" or (raw.startswith('[{"') and raw.endswith('"}]')
+                       and _objects_only(raw)):
         encoded = ("[" + rec + "]" if raw == "[]"
                    else raw[:-1] + "," + rec + "]")
         if len(encoded) <= RESULT_HISTORY_LIMIT:
@@ -84,6 +102,15 @@ def update_result_history(pod: dict, result_set: dict[str, str]) -> None:
     if not isinstance(results, list):
         raise ValueError(
             "broken result-history annotation: not a JSON array")
+    if any(not isinstance(r, dict) for r in results):
+        # the reference unmarshals into []map[string]string and errors on
+        # non-object elements ('[1,2]', '["a"]')
+        raise ValueError(
+            "broken result-history annotation: non-object element")
+    if any(not isinstance(v, str) for r in results for v in r.values()):
+        # ... and on non-string values ('[{"k":1}]')
+        raise ValueError(
+            "broken result-history annotation: non-string value")
     results.append(result_set)
     while results:
         encoded = ann.marshal(results)
@@ -224,8 +251,13 @@ class StoreReflector:
             annotations.update(result_set)
             try:
                 update_result_history(pod, result_set)
-            except ValueError:
-                pass  # log-and-continue, as the reference does
+            except ValueError as e:
+                # log-and-continue, as the reference does
+                # (storereflector.go:131-134 klog.Errorf then Update)
+                import sys
+
+                print(f"reflector: result-history not updated: {e}",
+                      file=sys.stderr)
             try:
                 # get() returned a private copy; transfer ownership (the
                 # pod dict is only read below, which the contract allows)

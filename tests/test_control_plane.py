@@ -304,6 +304,25 @@ class TestReflector:
         assert history[-1]["i"] == "5"
         assert len(p["metadata"]["annotations"][ann.RESULT_HISTORY]) <= ann.TOTAL_ANNOTATION_SIZE_LIMIT
 
+    def test_history_rejects_non_object_elements(self):
+        # the reference unmarshals into []map[string]string, which errors
+        # on valid-JSON arrays of non-objects (storereflector.go:169-171)
+        # and on non-string values; '[{"a":"b"},3,{"c":"d"}]' keeps the
+        # '[{"..."}]' shell so it exercises the splice fast path's
+        # object-boundary scan specifically
+        for raw in ('[1,2]', '["a"]', '[{"k":"v"},3]', '{"k":"v"}', 'nope[',
+                    '[{"a":"b"},3,{"c":"d"}]', '[{"k":1}]'):
+            p = {"metadata": {"annotations": {ann.RESULT_HISTORY: raw}}}
+            with pytest.raises(ValueError):
+                update_result_history(p, {"k": "v"})
+        # legit values containing "}," fall to the slow path and splice
+        # correctly
+        p = {"metadata": {"annotations":
+                          {ann.RESULT_HISTORY: '[{"a":"x},3"}]'}}}
+        update_result_history(p, {"k": "v"})
+        hist = json.loads(p["metadata"]["annotations"][ann.RESULT_HISTORY])
+        assert hist == [{"a": "x},3"}, {"k": "v"}]
+
 
 # ---------------------------------------------------------------- engine + service
 
