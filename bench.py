@@ -549,6 +549,55 @@ def _instrumented_compute_fraction(seq) -> float:
     return min(acc["t"] / total, 0.99)
 
 
+def _cpu_subprocess_json(snippet: str, prefix: str, timeout: float,
+                         tag: str, relay_stderr: bool = False):
+    """Run a CPU-forced bench snippet in a fresh subprocess and parse the
+    one `<prefix> <json>` line it prints; None on failure (logged with
+    the child's stderr tail, not the code string).  Shared by the
+    under-cliff control and the engine-wave phase; wrapped in the
+    host-phase ticker so a slow child cannot trip the hang watchdog."""
+    import os as _os
+    import subprocess as _sp
+
+    code = (
+        "import json, sys; sys.path.insert(0, '.')\n"
+        "from kube_scheduler_simulator_tpu.utils.platform import force_cpu, "
+        "tune_host_allocator\n"
+        "force_cpu(); tune_host_allocator()\n"
+        "import bench\n"
+        + snippet
+    )
+    with _host_phase_ticker():
+        try:
+            r = _sp.run([sys.executable, "-c", code], timeout=timeout,
+                        capture_output=True, text=True,
+                        env={**_os.environ, "JAX_PLATFORMS": "cpu"},
+                        cwd=str(Path(__file__).parent))
+            if relay_stderr:
+                for ln in r.stderr.splitlines():
+                    log("  " + ln)
+            return next(json.loads(ln[len(prefix) + 1:])
+                        for ln in r.stdout.splitlines()
+                        if ln.startswith(prefix + " "))
+        except _sp.TimeoutExpired as e:
+            err = (e.stderr or b"")
+            err = err.decode(errors="replace") if isinstance(err, bytes) else err
+            log(f"  {tag} subprocess timed out after {timeout:.0f}s; "
+                f"stderr tail: {err.strip()[-300:]}")
+        except StopIteration:
+            log(f"  {tag} subprocess produced no result (rc={r.returncode}); "
+                f"stderr tail: {r.stderr.strip()[-300:]}")
+        return None
+
+
+def _engine_wave_subprocess(pods: int, nodes: int, seed: int):
+    """measure_engine in a fresh CPU-forced subprocess (see call site)."""
+    return _cpu_subprocess_json(
+        f"r = bench.measure_engine({pods}, {nodes}, {seed})\n"
+        "print('EW ' + json.dumps(r))\n",
+        "EW", 1200, "engine_10k_5k", relay_stderr=True)
+
+
 def measure_cpu_baseline(idx: int, cpu_scale: float, node_scale: float,
                          seed: int, parallelism: int, cache: dict, rev: str):
     from kube_scheduler_simulator_tpu.models.workloads import baseline_config
@@ -564,7 +613,9 @@ def measure_cpu_baseline(idx: int, cpu_scale: float, node_scale: float,
 
     # instrumented sequential run: throughput + the Filter/Score compute
     # fraction (what the upstream Parallelizer fans out)
-    skey = f"seqfrac-c{idx}-s{cpu_scale}-ns{node_scale}-seed{seed}-{rev}"
+    # "2": warm-slice protocol (cold-start transients excluded) — older
+    # cached values measured a different thing and must not be reused
+    skey = f"seqfrac2-c{idx}-s{cpu_scale}-ns{node_scale}-seed{seed}-{rev}"
     if skey in cache:
         out["sequential_cps"], frac = cache[skey]
         out["compute_fraction"] = round(frac, 3)
@@ -574,6 +625,13 @@ def measure_cpu_baseline(idx: int, cpu_scale: float, node_scale: float,
         cn, cp, ccfg = baseline_config(idx, scale=cpu_scale, seed=seed,
                                        node_scale=node_scale)
         log(f"CPU sequential baseline: {len(cp)} pods x {len(cn)} nodes")
+        # warm slice first (untimed): the first big run in a process pays
+        # allocator/THP/startup transients — measured 6.5 cycles/s for the
+        # cold run vs 8.4 for the same oracle warmed, which would
+        # UNDERSTATE the divisor and flatter vs_baseline
+        wn, wp, wcfg = baseline_config(idx, scale=min(cpu_scale, 0.01),
+                                       seed=seed, node_scale=node_scale)
+        SequentialScheduler(wn, wp, wcfg).schedule_all()
         t0 = time.time()
         SequentialScheduler(cn, cp, ccfg).schedule_all()
         s = time.time() - t0
@@ -595,7 +653,7 @@ def measure_cpu_baseline(idx: int, cpu_scale: float, node_scale: float,
     # ratio > 1 means the short queue FAVORS the CPU (vs_baseline is
     # conservative); keyed without the git rev — it is a property of the
     # workload generator + oracle semantics, both frozen by parity gates
-    bkey = f"qbias-c{idx}-s{cpu_scale}-x4-ns{node_scale}-seed{seed}"
+    bkey = f"qbias2-c{idx}-s{cpu_scale}-x4-ns{node_scale}-seed{seed}"
     if bkey in cache:
         out["queue_bias_ratio"] = cache[bkey]
         log(f"CPU queue-length bias (cached): {cache[bkey]:.3f}")
@@ -814,32 +872,18 @@ def _run(args):
         # backend) so the parent's already-touched memory cannot distort
         # the control in either direction.
         log("under-cliff control (0.4x queue, full node shape, subprocess):")
-        import os as _os
-        import subprocess as _sp
-
-        code = (
-            "import json, sys; sys.path.insert(0, '.');\n"
-            "from kube_scheduler_simulator_tpu.utils.platform import force_cpu\n"
-            "force_cpu()\n"
-            "import bench\n"
+        uc = _cpu_subprocess_json(
             f"uc = bench.measure_replay({args.config}, 0.4, {args.seed}, "
             f"{args.chunk}, 0, decode_sample=0, node_scale=1.0, quick=True, "
             f"unroll={args.unroll})\n"
-            "print('UC ' + json.dumps(uc))\n"
-        )
-        try:
-            r = _sp.run([sys.executable, "-c", code], timeout=900,
-                        capture_output=True, text=True,
-                        env={**_os.environ, "JAX_PLATFORMS": "cpu"},
-                        cwd=str(Path(__file__).parent))
-            uc = next(json.loads(ln[3:]) for ln in r.stdout.splitlines()
-                      if ln.startswith("UC "))
+            "print('UC ' + json.dumps(uc))\n",
+            "UC", 900, "under-cliff control")
+        if uc is not None:
             extra["decode_inclusive_cps_undercliff"] = uc["decode_inclusive_cps"]
             extra["undercliff_shape"] = {"pods": uc["pods"], "nodes": uc["nodes"]}
             log(f"  under-cliff: {uc['decode_inclusive_cps']} cycles/s "
                 f"({uc['pods']} pods x {uc['nodes']} nodes)")
-        except (StopIteration, _sp.TimeoutExpired) as e:
-            log(f"  under-cliff control failed ({e}); omitting")
+        else:
             extra["decode_inclusive_cps_undercliff"] = None
 
     if not args.skip_engine:
@@ -856,15 +900,26 @@ def _run(args):
             # trade its headline artifact for a kernel OOM kill
             extra["engine_2k_1k"] = measure_engine(2000, 1000, args.seed)
             avail = _available_gb()
-            if avail >= 20:
-                extra["engine_10k_5k"] = measure_engine(
-                    max(int(10000 * args.scale), 100),
-                    max(int(5000 * args.scale), 50), args.seed)
-            else:
+            if avail < 20:
                 log(f"skipping engine_10k_5k: only {avail:.1f} GiB "
                     "available on this host (needs ~20 for the resident "
                     "result store)")
                 extra["engine_10k_5k"] = None
+            elif jax.default_backend() == "cpu":
+                # fresh subprocess: the wave holds the full ~13 GB product
+                # and THP allocation degrades late in a long process
+                # (fragmentation) — in-process this phase measured 200-450
+                # cycles/s vs 575 from a clean process.  A fresh process is
+                # also the representative serving shape (a server boots,
+                # then serves waves).  CPU backend only: a TPU subprocess
+                # would contend with this process's chip claim.
+                extra["engine_10k_5k"] = _engine_wave_subprocess(
+                    max(int(10000 * args.scale), 100),
+                    max(int(5000 * args.scale), 50), args.seed)
+            else:
+                extra["engine_10k_5k"] = measure_engine(
+                    max(int(10000 * args.scale), 100),
+                    max(int(5000 * args.scale), 50), args.seed)
             # the config-5 hard plugin on the serving path
             extra["engine_interpod"] = measure_engine(ep, en, args.seed,
                                                       interpod=True)
