@@ -9,7 +9,7 @@ shape with the process pushed past the host's ~8 GB page-backing cliff
               semantics) with default glibc (munmap on free -> re-fault)
   release+mallopt — plus tune_host_allocator() (arena reuse, no faults)
 
-Writes docs/bench/r04-decode-cliff.json.  Run on an idle host.
+Writes docs/bench/r05-decode-cliff.json.  Run on an idle host.
 """
 
 import json
@@ -68,6 +68,6 @@ out["mallopt_applied"] = tune_host_allocator()
 out["release_mallopt_pods_per_sec"] = run("release+mallopt", hold=False)
 out["release_mallopt_pass2"] = run("release+mallopt (pass 2)", hold=False)
 
-Path(__file__).with_name("r04-decode-cliff.json").write_text(
+Path(__file__).with_name("r05-decode-cliff.json").write_text(
     json.dumps(out, indent=1))
 print(json.dumps(out))
