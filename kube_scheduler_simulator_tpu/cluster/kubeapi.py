@@ -325,15 +325,44 @@ class KubeAPICluster:
                 t.start()
         if not start_thread:
             # the shared loop's initial-state replay already happened;
-            # give THIS subscriber its own ADDED replay before joining
-            # the live fanout, so every subscriber sees ListAndWatch
-            # semantics regardless of arrival order
-            items, _ = self._list_raw(resource)
-            for obj in items:
-                orv = (obj.get("metadata") or {}).get("resourceVersion")
-                q.put((self._rv_int(orv), ADDED, obj))
+            # give THIS subscriber its own ADDED replay so every
+            # subscriber sees ListAndWatch semantics regardless of
+            # arrival order.  A buffer queue joins the fanout BEFORE the
+            # list (no event is lost in the gap), then the handover swaps
+            # buffer -> q atomically with deliveries (_fanout puts under
+            # the lock): snapshot ADDEDs first, then buffered events
+            # filtered to those NEWER than the snapshot's resourceVersion
+            # for the same object — so a live DELETED observed during the
+            # list cannot be resurrected by a stale replayed ADDED.
+            buf: queue.Queue = queue.Queue()
             with self._lock:
-                self._watchers.setdefault(resource, []).append(q)
+                self._watchers.setdefault(resource, []).append(buf)
+            try:
+                items, _ = self._list_raw(resource)
+            except BaseException:
+                with self._lock:
+                    self._watchers[resource].remove(buf)
+                raise  # no orphan subscriber on a failed replay list
+            listed: dict = {}
+            for obj in items:
+                m = obj.get("metadata") or {}
+                listed[(m.get("namespace"), m.get("name"))] = self._rv_int(
+                    m.get("resourceVersion"))
+            with self._lock:
+                subs = self._watchers[resource]
+                subs[subs.index(buf)] = q
+                for obj in items:
+                    orv = (obj.get("metadata") or {}).get("resourceVersion")
+                    q.put((self._rv_int(orv), ADDED, obj))
+                while True:
+                    try:
+                        ev = buf.get_nowait()
+                    except queue.Empty:
+                        break
+                    m = (ev[2].get("metadata") or {})
+                    k = (m.get("namespace"), m.get("name"))
+                    if k not in listed or ev[0] > listed[k]:
+                        q.put(ev)
         return q
 
     def unwatch(self, resource: str, q: queue.Queue) -> None:
@@ -354,10 +383,12 @@ class KubeAPICluster:
             self._watch_stop.clear()
 
     def _fanout(self, resource: str, item: tuple) -> None:
+        # puts happen UNDER the lock: late-subscriber handover (watch())
+        # swaps its buffer for the real queue atomically with respect to
+        # deliveries, so no event can race past the swap
         with self._lock:
-            subs = list(self._watchers.get(resource, []))
-        for q in subs:
-            q.put(item)
+            for q in self._watchers.get(resource, []):
+                q.put(item)
 
     def _watch_loop(self, resource: str, stop: threading.Event) -> None:
         resume_rv: str | None = None  # server's exact string, for resume
