@@ -439,3 +439,101 @@ def test_form_manifest_builder_paths():
     assert "obj.status.capacity" in src and "obj.status.allocatable" in src
     assert ".taints = taints" in src
     assert "volumeBindingMode" in src and "globalDefault" in src
+
+
+def test_plugin_apply_diff_semantics_mirror():
+    """Python transcription of forms.js applyPluginStateToConfig's diff
+    algebra, checked over the wildcard/per-point cases the JS must
+    preserve: an untouched Apply is a no-op; disabling adds a multiPoint
+    disable and strips enabled entries; enabling under a wildcard lists
+    the plugin; weight changes upsert into score.enabled."""
+    src = _forms_js()
+
+    # the mirror follows the JS block-for-block; drift in the JS shows up
+    # as a failing textual anchor below before the semantics can diverge
+    for anchor in ["st.enabled !== init.enabled",
+                   "wildcardOff && !(mp.enabled || [])",
+                   "+st.weight !== +init.weight",
+                   "sc.enabled = sc.enabled || []"]:
+        assert anchor in src, anchor
+
+    def apply_diff(cfg, state, initial, table):
+        profiles = cfg.setdefault("profiles", [{"schedulerName": "d"}])
+        plugins = profiles[0].setdefault("plugins", {})
+        mp = plugins.setdefault("multiPoint", {})
+        wildcard_off = any(d.get("name") == "*"
+                           for d in mp.get("disabled", []))
+        for name, has_score in table:
+            st, init = state[name], initial[name]
+            if st["enabled"] != init["enabled"]:
+                if not st["enabled"]:
+                    for point in plugins.values():
+                        if point.get("enabled"):
+                            point["enabled"] = [
+                                e for e in point["enabled"]
+                                if e["name"] != name]
+                    if not wildcard_off and not any(
+                            d.get("name") == name
+                            for d in mp.get("disabled", [])):
+                        mp.setdefault("disabled", []).append({"name": name})
+                else:
+                    for point in plugins.values():
+                        if point.get("disabled"):
+                            point["disabled"] = [
+                                d for d in point["disabled"]
+                                if d["name"] != name]
+                    if wildcard_off and not any(
+                            e.get("name") == name
+                            for e in mp.get("enabled", [])):
+                        mp.setdefault("enabled", []).append({"name": name})
+            if has_score and st["enabled"] and st["weight"] != init["weight"]:
+                sc = plugins.setdefault("score", {})
+                entry = next((e for e in sc.setdefault("enabled", [])
+                              if e["name"] == name), None)
+                if entry:
+                    entry["weight"] = st["weight"]
+                else:
+                    sc["enabled"].append({"name": name,
+                                          "weight": st["weight"]})
+        return cfg
+
+    table = [("A", True), ("B", False), ("C", True)]
+
+    # 1) untouched Apply preserves a wildcard + enabled-list config
+    cfg = {"profiles": [{"plugins": {"multiPoint": {
+        "disabled": [{"name": "*"}], "enabled": [{"name": "A"}]}}}]}
+    init = {"A": {"enabled": True, "weight": 1},
+            "B": {"enabled": False, "weight": 0},
+            "C": {"enabled": False, "weight": 1}}
+    state = {k: dict(v) for k, v in init.items()}
+    out = apply_diff(json.loads(json.dumps(cfg)), state, init, table)
+    assert out == cfg  # byte-identical: nothing was touched
+
+    # 2) enabling C under the wildcard lists it; A stays listed
+    state["C"] = {"enabled": True, "weight": 1}
+    out = apply_diff(json.loads(json.dumps(cfg)), state, init, table)
+    mp = out["profiles"][0]["plugins"]["multiPoint"]
+    assert {"name": "*"} in mp["disabled"]
+    assert {"name": "C"} in mp["enabled"] and {"name": "A"} in mp["enabled"]
+
+    # 3) disabling A in a NON-wildcard config adds one disable and strips
+    #    its per-point enabled entry
+    cfg2 = {"profiles": [{"plugins": {"score": {
+        "enabled": [{"name": "A", "weight": 5}]}}}]}
+    init2 = {"A": {"enabled": True, "weight": 5},
+             "B": {"enabled": True, "weight": 0},
+             "C": {"enabled": True, "weight": 1}}
+    st2 = {k: dict(v) for k, v in init2.items()}
+    st2["A"]["enabled"] = False
+    out2 = apply_diff(json.loads(json.dumps(cfg2)), st2, init2, table)
+    p2 = out2["profiles"][0]["plugins"]
+    assert p2["multiPoint"]["disabled"] == [{"name": "A"}]
+    assert p2["score"]["enabled"] == []
+
+    # 4) weight change upserts into score.enabled without other edits
+    st3 = {k: dict(v) for k, v in init2.items()}
+    st3["C"]["weight"] = 7
+    out3 = apply_diff(json.loads(json.dumps(cfg2)), st3, init2, table)
+    sc = out3["profiles"][0]["plugins"]["score"]["enabled"]
+    assert {"name": "C", "weight": 7} in sc
+    assert {"name": "A", "weight": 5} in sc
