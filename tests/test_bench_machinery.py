@@ -1,0 +1,133 @@
+"""bench.py machinery the driver depends on: the streamed parity check,
+the oracle-child failure handling, the fallback command construction,
+and the stdout-owner claim protocol.  These paths decide whether the
+driver gets one honest JSON line out of every bench run (BASELINE.md),
+so they get unit coverage even though bench.py is not part of the
+package."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+import pytest
+
+import bench  # conftest.py puts the repo root on sys.path
+
+
+@pytest.fixture(autouse=True)
+def _reset_heartbeat():
+    saved = dict(bench._HEARTBEAT)
+    bench._HEARTBEAT.clear()
+    bench._HEARTBEAT["t"] = saved.get("t", 0)
+    yield
+    bench._HEARTBEAT.clear()
+    bench._HEARTBEAT.update(saved)
+
+
+def _args(**over):
+    base = dict(config=4, scale=1.0, cpu_scale=0.05, cpu_node_scale=1.0,
+                seed=0, smoke=False, skip_engine=False, skip_parity=False,
+                skip_config5=False)
+    base.update(over)
+    return argparse.Namespace(**base)
+
+
+def test_fallback_cmd_forwards_flags():
+    cmd = bench._fallback_cmd(_args(config=5, smoke=True, skip_engine=True))
+    assert cmd[0] == sys.executable
+    joined = " ".join(cmd)
+    assert "--config 5" in joined
+    assert "--assume-fallback" in joined
+    assert "--smoke" in joined and "--skip-engine" in joined
+    assert "--gate-configs 5" in joined  # one gate config bounds the cost
+    assert "--skip-parity" not in joined
+
+
+def test_stdout_claim_first_owner_wins():
+    assert bench._try_claim("run") == "run"
+    assert bench._try_claim("crash") == "run"  # first claim sticks
+    # a later "crash" claim after "run" must NOT park (the final print
+    # itself may have raised; parking would hang with no child running).
+    # Run in a helper thread with a bounded join so a parking regression
+    # shows up as a red test, not a wedged suite.
+    t = threading.Thread(target=bench._claim_stdout_or_park,
+                         args=("crash",), daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "_claim_stdout_or_park parked a crash claim"
+
+
+def test_stream_oracle_parity_ok_and_digest():
+    r = bench.stream_oracle_parity(1, 0.02, 0, want_digest=True)
+    assert r["ok"] is True
+    assert r["compared"] == r["pods"] > 0
+    assert r["keys_checked"] == 13 * r["pods"]
+    assert r["mismatches"] == 0 and r["first_mismatch"] is None
+    assert len(r["sha256"]) == 64
+    assert r["oracle_rc"] == 0
+
+
+def test_stream_oracle_parity_heartbeat_fires():
+    beats = []
+    r = bench.stream_oracle_parity(1, 0.02, 0, heartbeat=beats.append)
+    assert r["ok"] and len(beats) >= r["pods"]
+
+
+def test_oracle_child_death_is_not_a_parity_failure(monkeypatch):
+    # a dying child (the round-4 OOM shape) must be reported as an
+    # environment failure, not as mismatches
+    monkeypatch.setattr(
+        bench, "_ORACLE_CHILD",
+        "import sys\nsys.exit(137)\n" + "# {repo} {idx} {scale} {seed}\n")
+    r = bench.stream_oracle_parity(1, 0.02, 0)
+    assert r["ok"] is False
+    assert r.get("oracle_died") is True
+    assert r["mismatches"] == 0
+    assert r["oracle_rc"] == 137
+
+
+def test_run_parity_gate_retries_smaller_on_child_death(monkeypatch):
+    calls = []
+    real = bench.stream_oracle_parity
+
+    def fake(idx, scale, seed, chunk=64, want_digest=False, heartbeat=None):
+        calls.append(scale)
+        if len(calls) == 1:
+            return {"ok": False, "pods": 10, "compared": 3,
+                    "keys_checked": 39, "mismatches": 0,
+                    "first_mismatch": None, "sha256": None,
+                    "oracle_rc": -9, "oracle_err": "Killed",
+                    "oracle_died": True, "replay_seconds": 0,
+                    "oracle_seconds": 0}
+        return real(idx, scale, seed, chunk=chunk, heartbeat=heartbeat)
+
+    monkeypatch.setattr(bench, "stream_oracle_parity", fake)
+    assert bench.run_parity_gate(1, 0.08, 0) is True
+    assert calls == [0.08, 0.02]  # retried once at a quarter of the scale
+
+
+def test_run_parity_gate_mismatch_fails(monkeypatch):
+    def fake(idx, scale, seed, chunk=64, want_digest=False, heartbeat=None):
+        return {"ok": False, "pods": 10, "compared": 10, "keys_checked": 130,
+                "mismatches": 1, "sha256": None, "oracle_rc": 0,
+                "oracle_err": "", "replay_seconds": 0, "oracle_seconds": 0,
+                "first_mismatch": {"pod": 3, "key": "k", "dev": "a",
+                                   "oracle": "b"}}
+
+    monkeypatch.setattr(bench, "stream_oracle_parity", fake)
+    assert bench.run_parity_gate(1, 0.08, 0) is False
+
+
+def test_available_gb_positive():
+    assert bench._available_gb() > 0
+
+
+def test_host_phase_ticker_lifecycle():
+    with bench._host_phase_ticker() as tk:
+        assert tk._t.is_alive()
+    # exit must stop the ticker promptly (a leak would keep it alive in
+    # stop.wait(60) forever)
+    tk._t.join(timeout=5)
+    assert not tk._t.is_alive(), "ticker thread leaked past __exit__"
