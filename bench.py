@@ -468,11 +468,13 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
 
 
 def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
-                   interpod: bool = False):
+                   interpod: bool = False, pipeline: bool = True):
     """Serving-path benchmark: ObjectStore -> SchedulerEngine.schedule_pending
-    (compile -> replay -> decode -> result store -> reflector write-back),
-    with the tracer span breakdown.  interpod adds InterPodAffinity (the
-    config-5 hard plugin) to the lineup and pod specs."""
+    (compile -> replay -> decode -> commit, docs/wave-pipeline.md), with
+    the tracer span breakdown.  interpod adds InterPodAffinity (the
+    config-5 hard plugin) to the lineup and pod specs; pipeline=False
+    forces the sequential post-pass commit (the pre-change baseline the
+    commit_stream_overlap_seconds counter is measured against)."""
     from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
     from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
     from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
@@ -492,9 +494,11 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
         store.create("nodes", n)
     for p in pods:
         store.create("pods", p)
-    engine = SchedulerEngine(store, plugin_config=cfg, chunk=512)
+    engine = SchedulerEngine(store, plugin_config=cfg, chunk=512,
+                             pipeline_commit=pipeline)
     log(f"engine path: {scale_pods} pods x {scale_nodes} nodes "
-        "(store -> compile -> replay -> decode -> reflect)")
+        "(store -> compile -> replay -> decode -> commit"
+        f"{', pipelined' if pipeline else ', sequential post-pass'})")
     t0 = time.time()
     engine.schedule_pending()  # warm: XLA-compiles the wave's scan
     log(f"  warm engine wave (incl XLA compile): {time.time()-t0:.1f}s")
@@ -511,16 +515,28 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
     t0 = time.time()
     bound = engine.schedule_pending()
     total = time.time() - t0
-    spans = {
-        k: v["total_seconds"] for k, v in TRACER.summary()["spans"].items()
-    }
+    summary = TRACER.summary()
+    spans = {k: v["total_seconds"] for k, v in summary["spans"].items()}
     for name, secs in sorted(spans.items(), key=lambda kv: -kv[1]):
         log(f"  span {name}: {secs:.2f}s")
+    # the pipelined-commit win: commit time that ran DURING the replay
+    # (docs/wave-pipeline.md) — plus the batched-write volume behind it
+    counters = {
+        k: summary["counters"][k] for k in (
+            "commit_stream_overlap_seconds", "commit_stream_waves_total",
+            "store_batch_writes_total", "store_batches_total",
+            "replay_width_retries_total",
+        ) if k in summary["counters"]
+    }
+    if counters.get("commit_stream_overlap_seconds"):
+        log(f"  commit overlapped with replay: "
+            f"{counters['commit_stream_overlap_seconds']:.2f}s")
     cps = scale_pods / total
     log(f"  engine: bound {bound}/{scale_pods} in {total:.2f}s -> {cps:,.0f} cycles/s")
     return {"pods": scale_pods, "nodes": scale_nodes, "bound": bound,
             "cycles_per_sec": round(cps, 1),
-            "spans": {k: round(v, 2) for k, v in spans.items()}}
+            "spans": {k: round(v, 2) for k, v in spans.items()},
+            "counters": {k: round(v, 3) for k, v in counters.items()}}
 
 
 def _instrumented_compute_fraction(seq) -> float:

@@ -24,15 +24,20 @@ avail_gb() { awk '/MemAvailable/{printf "%d", $2/1048576}' /proc/meminfo; }
 kill_leftover() {
   if [ -f "$PIDFILE" ]; then
     oldpid=$(cat "$PIDFILE")
-    if kill -0 "$oldpid" 2>/dev/null; then
+    # probe the GROUP as well as the leader: an OOM-killed timeout
+    # wrapper leaves grandchildren alive in the group, and those are
+    # exactly the orphans this sweep exists to reap
+    if kill -0 "$oldpid" 2>/dev/null || kill -0 -- -"$oldpid" 2>/dev/null; then
       echo "$(date -u +%FT%TZ) killing leftover bench pid $oldpid" >> "$LOG"
-      # $oldpid is the timeout(1) wrapper: TERM is forwarded to the bench
-      # child; escalate to KILL on wrapper AND children (a SIGKILLed
-      # wrapper alone would orphan the bench, which keeps holding memory
-      # and its open fd to the .tmp artifact)
-      kill "$oldpid" 2>/dev/null
+      # the bench runs in its own process group (setsid at spawn, so
+      # PGID == $oldpid): kill the GROUP, not just the timeout(1)
+      # wrapper — pkill -P only reached direct children, and bench.py's
+      # own subprocesses (the RLIMIT-capped oracle child, under-cliff /
+      # engine-wave subprocesses) are grandchildren that survived the
+      # sweep while holding the memory this script protects against
+      kill -- -"$oldpid" 2>/dev/null || kill "$oldpid" 2>/dev/null
       sleep 10
-      pkill -9 -P "$oldpid" 2>/dev/null
+      kill -9 -- -"$oldpid" 2>/dev/null
       kill -9 "$oldpid" 2>/dev/null
       sleep 2
     fi
@@ -55,7 +60,12 @@ while true; do
     # already-captured artifact pair; a failed attempt's stderr is kept
     # separately for diagnosis.  Hard 2h cap: bench's internal hang
     # watchdog should re-exec its own fallback long before this fires.
-    timeout -k 60 7200 python bench.py \
+    # setsid: the bench (timeout wrapper + python + its grandchildren)
+    # gets its OWN process group, so kill_leftover can sweep the whole
+    # tree with one group kill.  Backgrounded from a script the child is
+    # not a group leader, so setsid execs in place without forking and
+    # $! is the group leader (PGID == $!).
+    setsid timeout -k 60 7200 python bench.py \
       > docs/bench/r05-tpu-bench.json.tmp \
       2> docs/bench/r05-tpu-bench.err.tmp &
     echo $! > "$PIDFILE"

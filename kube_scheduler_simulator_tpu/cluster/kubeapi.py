@@ -312,6 +312,7 @@ class KubeAPICluster:
         if resource not in self.paths:
             raise NotFound(f"resource {resource!r} has no API path")
         q: queue.Queue = queue.Queue()
+        buf: queue.Queue | None = None
         with self._lock:
             start_thread = resource not in self._watch_threads
             if start_thread:
@@ -323,26 +324,34 @@ class KubeAPICluster:
                 self._watch_stop[resource] = stop
                 self._watch_threads[resource] = t
                 t.start()
-        if not start_thread:
-            # the shared loop's initial-state replay already happened;
-            # give THIS subscriber its own ADDED replay so every
-            # subscriber sees ListAndWatch semantics regardless of
-            # arrival order.  A buffer queue joins the fanout BEFORE the
-            # list (no event is lost in the gap), then the handover swaps
-            # buffer -> q atomically with deliveries (_fanout puts under
-            # the lock): snapshot ADDEDs first, then buffered events
+            else:
+                # the shared loop's initial-state replay already
+                # happened; give THIS subscriber its own ADDED replay so
+                # every subscriber sees ListAndWatch semantics regardless
+                # of arrival order.  The buffer joins the fan-out UNDER
+                # THE SAME LOCK HOLD as the _watch_threads check: were it
+                # registered in a second acquisition, the last existing
+                # subscriber could unwatch() in the window, stopping the
+                # loop thread and leaving this subscriber attached to a
+                # dead fan-out (one ADDED replay, then silence).  With
+                # the buffer already in the subscriber list, unwatch()
+                # sees it and keeps the loop alive.
+                buf = queue.Queue()
+                self._watchers.setdefault(resource, []).append(buf)
+        if buf is not None:
+            # handover: snapshot ADDEDs first, then buffered events
             # filtered to those NEWER than the snapshot's resourceVersion
             # for the same object — so a live DELETED observed during the
-            # list cannot be resurrected by a stale replayed ADDED.
-            buf: queue.Queue = queue.Queue()
-            with self._lock:
-                self._watchers.setdefault(resource, []).append(buf)
+            # list cannot be resurrected by a stale replayed ADDED.  The
+            # swap buffer -> q is atomic with deliveries (_fanout puts
+            # under the lock).
             try:
                 items, _ = self._list_raw(resource)
             except BaseException:
-                with self._lock:
-                    self._watchers[resource].remove(buf)
-                raise  # no orphan subscriber on a failed replay list
+                # no orphan subscriber on a failed replay list; unwatch
+                # also stops the loop thread if buf was the last one
+                self.unwatch(resource, buf)
+                raise
             listed: dict = {}
             for obj in items:
                 m = obj.get("metadata") or {}

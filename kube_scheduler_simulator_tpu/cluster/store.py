@@ -248,21 +248,7 @@ class ObjectStore:
                     "the object has been modified"
                 )
             if resource == "pods":
-                # apiserver validation: spec.nodeName is write-once (only
-                # the empty->set transition of binding is allowed); this
-                # is what actually protects the simulator's placement
-                # authority from synced source-cluster updates
-                cur_node = (cur.get("spec") or {}).get("nodeName") or ""
-                new_node = (obj.get("spec") or {}).get("nodeName") or ""
-                if cur_node and new_node != cur_node:
-                    e = ApiError(
-                        f'Pod "{key}" is invalid: spec: Forbidden: pod '
-                        "updates may not change fields other than allowed ones "
-                        f"(spec.nodeName {cur_node!r} -> {new_node!r})"
-                    )
-                    e.status = 422
-                    e.reason = "Invalid"
-                    raise e
+                self._validate_pod_update(key, cur, obj)
             rv = self._next_rv()
             meta["uid"] = cur["metadata"]["uid"]
             meta["resourceVersion"] = str(rv)
@@ -325,6 +311,83 @@ class ObjectStore:
                 items.append(copy.deepcopy(obj) if copy_objects else obj)
             return items, self._last_rv
 
+    def _validate_pod_update(self, key: str, cur: dict, obj: dict) -> None:
+        """apiserver validation: spec.nodeName is write-once (only the
+        empty->set transition of binding is allowed); this is what
+        actually protects the simulator's placement authority from synced
+        source-cluster updates."""
+        cur_node = (cur.get("spec") or {}).get("nodeName") or ""
+        new_node = (obj.get("spec") or {}).get("nodeName") or ""
+        if cur_node and new_node != cur_node:
+            e = ApiError(
+                f'Pod "{key}" is invalid: spec: Forbidden: pod '
+                "updates may not change fields other than allowed ones "
+                f"(spec.nodeName {cur_node!r} -> {new_node!r})"
+            )
+            e.status = 422
+            e.reason = "Invalid"
+            raise e
+
+    def apply_batch(self, resource: str, mutations) -> int:
+        """Apply many read-modify-write updates under ONE lock hold — the
+        scheduling engine's wave-commit write path: a wave's binds, status
+        marks and reflector write-backs cost one lock acquisition and one
+        contiguous resourceVersion range instead of N get+update round
+        trips (each a lock acquisition plus a conflict-retry risk against
+        concurrent writers).
+
+        mutations: iterable of (name, namespace, mutate).  Each mutate
+        callback receives a copy-on-write view of the CURRENT object (top
+        level and the metadata/spec/status dicts are fresh; anything
+        deeper is SHARED with the stored object and must be replaced, not
+        mutated in place — the same contract as the engine's
+        _update_pod).  A mutate returning False skips the write (no
+        resourceVersion bump, no event); objects missing from the store
+        are skipped, matching the per-pod path's NotFound no-op.  Per
+        object the semantics are update(owned=True): rv stamp, uid/kind
+        preservation, pod nodeName write-once validation (a validation
+        failure raises mid-batch; earlier writes stand, exactly as the
+        sequential loop would have left them).  Watch events fire in
+        mutation order under the same lock hold, so subscribers observe
+        the batch as one contiguous rv run.  Returns #objects written."""
+        from ..utils.tracing import TRACER
+
+        if resource not in self.resources:
+            raise NotFound(f"unknown resource {resource}")
+        _, namespaced = self.resources[resource]
+        written = 0
+        try:
+            with self._lock:
+                for name, namespace, mutate in mutations:
+                    key = (f"{namespace or 'default'}/{name}"
+                           if namespaced else name)
+                    cur = self._objects[resource].get(key)
+                    if cur is None:
+                        continue
+                    obj = dict(cur)
+                    for part in ("metadata", "spec", "status"):
+                        if part in obj:
+                            obj[part] = dict(obj[part])
+                    if mutate(obj) is False:
+                        continue
+                    if resource == "pods":
+                        self._validate_pod_update(key, cur, obj)
+                    meta = obj.setdefault("metadata", {})
+                    rv = self._next_rv()
+                    meta["uid"] = cur["metadata"]["uid"]
+                    meta["resourceVersion"] = str(rv)
+                    meta.setdefault("creationTimestamp",
+                                    cur["metadata"].get("creationTimestamp"))
+                    self._stamp_kind(resource, obj)
+                    self._objects[resource][key] = obj
+                    self._notify(resource, MODIFIED, obj, rv)
+                    written += 1
+        finally:
+            if written:
+                TRACER.count("store_batch_writes_total", written)
+                TRACER.count("store_batches_total")
+        return written
+
     # ----------------------------------------------------------- watch
 
     def watch(self, resource: str, since_rv: int = 0) -> queue.Queue:
@@ -339,6 +402,22 @@ class ObjectStore:
                     q.put(ev)
             self._watchers[resource].append(q)
         return q
+
+    def list_and_watch(self, resource: str) -> tuple[list[dict], int, queue.Queue]:
+        """Atomic list + watch registration: -> (items, rv, queue) where
+        the queue carries exactly the events AFTER rv — the informer
+        ListAndWatch contract without the ring-buffer race a separate
+        list() then watch(since_rv=rv) pair has under heavy concurrent
+        write traffic.  Items are the STORED objects (no deep copies,
+        the list_shared contract: callers must not mutate them); call
+        unwatch() when done with the queue."""
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            if resource not in self.resources:
+                raise NotFound(f"unknown resource {resource}")
+            items = [obj for _, obj in sorted(self._objects[resource].items())]
+            self._watchers[resource].append(q)
+            return items, self._last_rv, q
 
     def unwatch(self, resource: str, q: queue.Queue) -> None:
         with self._lock:

@@ -45,12 +45,193 @@ class _LazyDecode:
         return decode_pod_result(self.rr, i)
 
 
+class _ReflectBatcher:
+    """Chunked async reflect write-backs, shared by the sequential
+    post-pass and the pipelined committer so their batching and error
+    semantics cannot diverge: ~batch_n pods per pool future; every pod
+    in a batch is attempted even if an earlier one fails, and the first
+    error surfaces from drain().
+
+    use_batch routes through StoreReflector.reflect_batch (the
+    apply_batch surface) — the committer's mode; the sequential
+    post-pass keeps per-pod reflect() (its pre-change mechanism, and
+    the parity baseline)."""
+
+    def __init__(self, engine: "SchedulerEngine", n_pending: int,
+                 use_batch: bool):
+        self._pool = engine._reflector_pool()
+        # small waves still fan across the pool; 10k-pod waves cost ~150
+        # futures instead of 10k
+        self._batch_n = max(1, min(64, n_pending // 8))
+        self._batch: list[tuple[str, str, str | None]] = []
+        self._futs: list = []
+        fn = getattr(engine.reflector, "reflect_batch", None) if use_batch \
+            else None
+        if fn is None:
+            reflect_one = engine.reflector.reflect
+
+            def fn(batch):
+                first_err = None
+                for bns, bname, buid in batch:
+                    try:
+                        reflect_one(bns, bname, uid=buid)
+                    except Exception as e:  # noqa: BLE001
+                        first_err = first_err or e
+                if first_err is not None:
+                    raise first_err
+        self._fn = fn
+
+    def submit(self, ns: str, name: str, uid: str | None) -> None:
+        self._batch.append((ns, name, uid))
+        if len(self._batch) >= self._batch_n:
+            self._futs.append(self._pool.submit(self._fn, self._batch[:]))
+            self._batch.clear()
+
+    def drain(self) -> None:
+        if self._batch:
+            self._futs.append(self._pool.submit(self._fn, self._batch[:]))
+            self._batch.clear()
+        for f in self._futs:
+            f.result()
+
+
+class _WaveCommitter:
+    """Chunk-pipelined commit consumer for a streaming wave.
+
+    replay(on_chunk=...) delivers decoded chunks in ascending pod order
+    while the device scans later chunks; on_chunk (replay thread) decodes
+    the chunk and hands it to a single worker thread that runs the commit
+    phase — result-store puts, batched binds / unschedulable marks
+    (ObjectStore.apply_batch), reflect submissions — in pod order.  The
+    single worker preserves the sequential path's per-pod ordering, so
+    annotations, bind order and result-history are bit-identical to the
+    post-pass (tests/test_golden_annotations.py parity gate).
+
+    Width-tier reruns: a score overflow makes replay() re-deliver chunks
+    from index 0 at a wider dtype.  Chunks that were ingested WITHOUT the
+    overflow flag are bit-identical across tiers (pipeline.py compares
+    the full-precision scores against the narrowed transfer before
+    setting the flag), so the worker keeps a committed-up-to watermark
+    and skips re-delivered pods instead of double-committing them.
+
+    The commit time spent while the device was still scanning is
+    reported as the commit_stream_overlap_seconds counter; the
+    commit_and_reflect span covers only the post-replay tail (what the
+    wave still serializes on)."""
+
+    def __init__(self, engine: "SchedulerEngine", node_names, pending):
+        import queue
+        import threading
+
+        self.engine = engine
+        self.node_names = node_names
+        self.pending = pending
+        self.annotations: list = [None] * len(pending)
+        self.n_bound = 0
+        self._upto = 0          # pods [0, _upto) already committed
+        self._busy: list[tuple[float, float]] = []
+        self._exc: BaseException | None = None
+        self._stop = False      # abort(): drop queued chunks uncommitted
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._reflects = _ReflectBatcher(engine, len(pending), use_batch=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="commit-stream")
+        self._thread.start()
+
+    # ---------------------------------------------- replay-thread side
+
+    def on_chunk(self, rr, lo: int, hi: int) -> None:
+        from ..store.decode import decode_chunk_into
+
+        decode_chunk_into(rr, lo, hi, self.annotations)
+        import numpy as np
+
+        self._q.put((lo, hi, np.asarray(rr.selected[lo:hi]).copy()))
+
+    def finish(self) -> tuple[int, None]:
+        """Replay drained: commit the remaining chunks, settle reflects,
+        surface worker errors.  -> (#bound, None)."""
+        replay_end = time.perf_counter()
+        self._q.put(None)
+        with TRACER.span("commit_and_reflect", pods=len(self.pending)):
+            self._thread.join()
+            if self._exc is None:
+                self._reflects.drain()
+        overlap = sum(max(0.0, min(t1, replay_end) - t0)
+                      for t0, t1 in self._busy if t0 < replay_end)
+        TRACER.count("commit_stream_overlap_seconds", round(overlap, 6))
+        TRACER.count("commit_stream_waves_total")
+        if self._exc is not None:
+            raise self._exc
+        return self.n_bound, None
+
+    def abort(self) -> None:
+        """Replay failed: stop the worker without raising again.  Commits
+        that already landed stand (like a mid-pass sequential failure);
+        chunks still queued are DROPPED — _stop makes the worker's drain
+        branch skip them, so an interrupt isn't serviced through the
+        whole backlog and no binds land after the wave has failed."""
+        self._stop = True
+        self._q.put(None)
+        self._thread.join()
+        try:
+            self._reflects.drain()
+        except Exception:
+            pass
+
+    # ---------------------------------------------- worker-thread side
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self._exc is not None or self._stop:
+                continue  # keep draining so finish() never blocks
+            try:
+                t0 = time.perf_counter()
+                self._commit(*item)
+                self._busy.append((t0, time.perf_counter()))
+            except BaseException as e:  # noqa: BLE001 — re-raised in finish()
+                self._exc = e
+
+    def _commit(self, lo: int, hi: int, selected) -> None:
+        if hi <= self._upto:
+            return  # width-tier re-delivery of an already-committed chunk
+        eng = self.engine
+        names = self.node_names
+        put_decoded = eng.result_store.put_decoded
+        items: list[tuple[str, str, str | None]] = []
+        uids: list[str | None] = []
+        for i in range(max(lo, self._upto), hi):
+            meta = self.pending[i].get("metadata") or {}
+            ns, name = meta.get("namespace") or "default", meta.get("name", "")
+            put_decoded(ns, name, self.annotations[i])
+            sel = int(selected[i - lo])
+            items.append((ns, name, names[sel] if sel >= 0 else None))
+            uids.append(meta.get("uid"))
+        self.n_bound += eng._commit_pod_batch(items)
+        for (ns, name, _node), uid in zip(items, uids):
+            self._reflects.submit(ns, name, uid)
+        self._upto = hi
+
+
 class SchedulerEngine:
     def __init__(self, store: ObjectStore, reflector: StoreReflector | None = None,
                  result_store: ResultStore | None = None,
                  plugin_config: PluginSetConfig | None = None,
-                 chunk: int = 512, mesh=None, unroll: int = 2):
+                 chunk: int = 512, mesh=None, unroll: int = 2,
+                 pipeline_commit: bool = True):
         self.store = store
+        # chunk-pipelined commit (docs/wave-pipeline.md): commit each
+        # decoded chunk on a worker thread while the device scans later
+        # chunks.  False forces the sequential post-pass on every wave
+        # (the parity baseline, and the path the conflict-retry tests pin)
+        self.pipeline_commit = pipeline_commit
+        # per-wave node count for the unschedulable condition message
+        # (was a full deepcopy store.list per unschedulable pod)
+        self._wave_node_count: int | None = None
+        self._pending_idx = None
         self.result_store = result_store or ResultStore()
         self.reflector = reflector or StoreReflector(store)
         if RESULT_STORE_KEY not in self.reflector.result_stores:
@@ -184,9 +365,31 @@ class SchedulerEngine:
         less() when one is enabled (upstream allows exactly one,
         wrappedplugin.go:754-771), else PrioritySort.
 
+        PrioritySort order comes from the incremental pending index when
+        the store supports it (framework/pending.py: O(events) per wave
+        instead of re-listing and re-sorting every pod); a custom
+        QueueSort or an index-less store (the remote HTTP client) takes
+        the legacy list+sort path.
+
         Returns SHARED store manifests (the informer-cache contract) —
         callers must not mutate them; take a deepcopy before handing one
         to anything that might."""
+        qs = self._queue_sort_plugin()
+        if qs is None:
+            idx = self._pending_index()
+            if idx is not None:
+                if not self.waiting_pods:
+                    return idx.pending()
+                waiting = self.waiting_pods
+                from .pending import _key
+
+                return [p for p in idx.pending() if _key(p) not in waiting]
+        elif self._pending_idx is not None:
+            # a custom QueueSort bypasses the index permanently: drop the
+            # subscription so every store write stops paying the fan-out
+            # tax into a queue nothing will ever drain
+            self._pending_idx.close()
+            self._pending_idx = None
         pods = self._list_shared("pods")
         pending = [
             p for p in pods
@@ -194,19 +397,46 @@ class SchedulerEngine:
             and ((p.get("metadata") or {}).get("namespace") or "default",
                  (p.get("metadata") or {}).get("name", "")) not in self.waiting_pods
         ]
-        qs = self._queue_sort_plugin()
         if qs is not None:
             pending.sort(key=functools.cmp_to_key(
                 lambda a, b: -1 if qs.less(a, b) else (1 if qs.less(b, a) else 0)))
             return pending
-        # PrioritySort: priority desc, FIFO (creation resourceVersion) within
-        pending.sort(
-            key=lambda p: (
-                -int((p.get("spec") or {}).get("priority") or 0),
-                int((p.get("metadata") or {}).get("resourceVersion") or 0),
-            )
-        )
+        # PrioritySort: priority desc, FIFO (resourceVersion) within —
+        # the SAME key function the incremental index orders by, so the
+        # two paths cannot drift
+        from .pending import _sort_key
+
+        pending.sort(key=_sort_key)
         return pending
+
+    def close(self) -> None:
+        """Release engine-held resources: the pending index's watch
+        subscription and the reflect pool.  Engines are long-lived in
+        the simulator (the service reconfigures in place), but an
+        application that discards an engine while its store lives on
+        must call this — otherwise every subsequent store write keeps
+        feeding the orphaned index queue.  The engine lazily re-creates
+        both if used again."""
+        if self._pending_idx is not None:
+            self._pending_idx.close()
+            self._pending_idx = None
+        pool = getattr(self, "_reflect_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=True)
+            self._reflect_pool = None
+
+    def _pending_index(self):
+        """Lazily built PendingPodIndex, or None when the store has no
+        atomic list_and_watch surface (remote HTTP client)."""
+        idx = self._pending_idx
+        if idx is None:
+            if not hasattr(self.store, "list_and_watch"):
+                return None
+            from .pending import PendingPodIndex
+
+            idx = PendingPodIndex(self.store)
+            self._pending_idx = idx
+        return idx
 
     def _queue_sort_plugin(self):
         """The enabled custom QueueSort plugin, if any.  Upstream allows
@@ -352,6 +582,7 @@ class SchedulerEngine:
         if not pending:
             return 0, None
         nodes = self._list_shared("nodes")
+        self._wave_node_count = len(nodes)
         pods_all = self._list_shared("pods")
         bound = [
             (p, p["spec"]["nodeName"]) for p in pods_all
@@ -427,17 +658,50 @@ class SchedulerEngine:
                 rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
                             mesh=mesh, unroll=self.unroll)
             all_annotations = _LazyDecode(rr)
-        else:
-            # stream: each chunk decodes (host, thread pool) as soon as its
-            # transfer lands, overlapping the device's later chunks
-            all_annotations = [None] * len(pending)
-            with TRACER.span("replay_and_decode_stream", pods=len(pending),
-                             nodes=len(nodes)):
-                rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                            mesh=mesh, unroll=self.unroll,
-                            on_chunk=lambda rr_, lo, hi: decode_chunk_into(
-                                rr_, lo, hi, all_annotations))
+            return self._finish_wave(cw, rr, all_annotations, pending, exclude)
+
+        if self._can_stream_commit():
+            # chunk-pipelined commit (docs/wave-pipeline.md): a worker
+            # thread runs the commit phase for each decoded chunk (result
+            # -store puts, batched binds/unschedulable marks, reflect
+            # submissions, pod order preserved) while the device scans
+            # later chunks — instead of the whole wave idling through a
+            # sequential post-pass after the replay drains
+            committer = _WaveCommitter(self, cw.node_table.names, pending)
+            try:
+                with TRACER.span("replay_and_decode_stream",
+                                 pods=len(pending), nodes=len(nodes)):
+                    rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
+                                mesh=mesh, unroll=self.unroll,
+                                on_chunk=committer.on_chunk)
+            except BaseException:
+                committer.abort()
+                raise
+            return committer.finish()
+
+        # stream: each chunk decodes (host, thread pool) as soon as its
+        # transfer lands, overlapping the device's later chunks
+        all_annotations = [None] * len(pending)
+        with TRACER.span("replay_and_decode_stream", pods=len(pending),
+                         nodes=len(nodes)):
+            rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
+                        mesh=mesh, unroll=self.unroll,
+                        on_chunk=lambda rr_, lo, hi: decode_chunk_into(
+                            rr_, lo, hi, all_annotations))
         return self._finish_wave(cw, rr, all_annotations, pending, exclude)
+
+    def _can_stream_commit(self) -> bool:
+        """True when nothing in the configuration forces the sequential
+        post-pass: no plugin-extender observers (after_cycle sees each
+        pod's annotations in order), no custom lifecycle (Reserve/Permit/
+        PreBind can reject and abort the wave), and no PostFilter
+        (preemption mutates the store mid-commit and requests retry
+        waves).  Extender webhooks already forced the host path before
+        this point."""
+        return (self.pipeline_commit
+                and not self._extenders_map()
+                and not self._custom_lifecycle_plugins()
+                and not self.plugin_config.postfilters())
 
     def _finish_wave(self, cw, rr, all_annotations, pending,
                      exclude: set[tuple[str, str]] | None
@@ -451,41 +715,9 @@ class SchedulerEngine:
         # write-backs are independent per pod (upstream's reflector runs
         # on informer callbacks, async from scheduleOne): fan them over a
         # small pool — the native escape pass releases the GIL — and
-        # settle before the wave returns.  Submissions are chunked so a
-        # 10k-pod wave costs ~150 futures, not 10k.
-        reflect_futs: list = []
-        reflect_batch: list[tuple[str, str, str | None]] = []
-        pool = self._reflector_pool()
-        reflect_one = self.reflector.reflect
-        # small waves still fan across the pool; 10k-pod waves cost ~150
-        # futures instead of 10k
-        batch_n = max(1, min(64, len(pending) // 8))
-
-        def run_batch(batch):
-            # every pod's write-back is attempted even if an earlier one
-            # fails (matching the one-future-per-pod behavior); the first
-            # error still surfaces from drain_reflects()
-            first_err = None
-            for bns, bname, buid in batch:
-                try:
-                    reflect_one(bns, bname, uid=buid)
-                except Exception as e:  # noqa: BLE001
-                    first_err = first_err or e
-            if first_err is not None:
-                raise first_err
-
-        def submit_reflect(bns, bname, buid):
-            reflect_batch.append((bns, bname, buid))
-            if len(reflect_batch) >= batch_n:
-                reflect_futs.append(pool.submit(run_batch, reflect_batch[:]))
-                reflect_batch.clear()
-
-        def drain_reflects():
-            if reflect_batch:
-                reflect_futs.append(pool.submit(run_batch, reflect_batch[:]))
-                reflect_batch.clear()
-            for f in reflect_futs:
-                f.result()
+        # settle before the wave returns.  Per-pod reflect (use_batch=
+        # False) keeps this post-pass on its pre-change write mechanism.
+        reflects = _ReflectBatcher(self, len(pending), use_batch=False)
 
         emap = self._extenders_map()
         has_lc = bool(self._custom_lifecycle_plugins())
@@ -520,7 +752,7 @@ class SchedulerEngine:
                         # without this pod so later pods see true (unbound)
                         # state
                         self._mark_unschedulable(ns, name)
-                        drain_reflects()
+                        reflects.drain()
                         self.reflector.reflect(ns, name, uid=meta.get("uid"))
                         if exclude is not None:
                             exclude.add((ns, name))
@@ -539,8 +771,8 @@ class SchedulerEngine:
                                 cw, rr.codes_of(i), i, pod, ns, name):
                             retry = "preempted"
                     self._mark_unschedulable(ns, name)
-                submit_reflect(ns, name, meta.get("uid"))
-            drain_reflects()
+                reflects.submit(ns, name, meta.get("uid"))
+            reflects.drain()
         return n_bound, retry
 
     def _reflector_pool(self):
@@ -739,7 +971,9 @@ class SchedulerEngine:
         finally:
             try:
                 if outcome == "rejected":
-                    self._mark_unschedulable(ns, name)
+                    # waiter threads resolve after the wave: the cached
+                    # per-wave node count may be stale, re-count fresh
+                    self._mark_unschedulable(ns, name, fresh_node_count=True)
                 self.reflector.reflect(
                     ns, name, uid=(pod.get("metadata") or {}).get("uid"))
             except Exception:
@@ -1219,7 +1453,8 @@ class SchedulerEngine:
 
         retry_with_exponential_backoff(attempt, sleep=self._retry_sleep)
 
-    def _bind(self, ns: str, name: str, node_name: str) -> None:
+    @staticmethod
+    def _bind_mutation(node_name: str):
         def mutate(pod: dict) -> None:
             pod.setdefault("spec", {})["nodeName"] = node_name
             status = pod.setdefault("status", {})
@@ -1228,7 +1463,63 @@ class SchedulerEngine:
             conds.append({"type": "PodScheduled", "status": "True"})
             status["conditions"] = conds
 
-        self._update_pod(ns, name, mutate)
+        return mutate
+
+    def _bind(self, ns: str, name: str, node_name: str) -> None:
+        self._update_pod(ns, name, self._bind_mutation(node_name))
+
+    def _node_count(self, fresh: bool = False) -> int:
+        """#nodes for the unschedulable condition message, cached per
+        wave — _mark_unschedulable used to pay a full deepcopy
+        store.list("nodes") per unschedulable pod just to render it.
+        fresh=True re-counts (copy-free) for writes that land OUTSIDE
+        the wave that cached it (Permit-waiter threads)."""
+        n = None if fresh else self._wave_node_count
+        if n is None:
+            n = len(self._list_shared("nodes"))
+        return n
+
+    def _unschedulable_mutation(self, fresh_node_count: bool = False):
+        n_nodes = self._node_count(fresh=fresh_node_count)
+
+        def mutate(pod: dict) -> None:
+            status = pod.setdefault("status", {})
+            status["phase"] = "Pending"
+            conds = [c for c in status.get("conditions") or [] if c.get("type") != "PodScheduled"]
+            conds.append({
+                "type": "PodScheduled", "status": "False",
+                "reason": "Unschedulable",
+                "message": "0/%d nodes are available" % n_nodes,
+            })
+            status["conditions"] = conds
+
+        return mutate
+
+    def _commit_pod_batch(self, items) -> int:
+        """Commit a run of scheduled/unschedulable outcomes: one
+        ObjectStore.apply_batch call (single lock hold, contiguous rv
+        range, pod order preserved — so watch subscribers see the same
+        bind order as the sequential path); per-pod _update_pod fallback
+        for stores without the batch surface (the remote HTTP client).
+
+        items: [(ns, name, node_name or None)] in pod order.  Returns
+        #bound."""
+        if not items:
+            return 0
+        bound = sum(1 for _, _, node in items if node)
+        if getattr(self.store, "apply_batch", None) is None:
+            for ns, name, node in items:
+                if node:
+                    self._bind(ns, name, node)
+                else:
+                    self._mark_unschedulable(ns, name)
+            return bound
+        unsched = None if bound == len(items) else self._unschedulable_mutation()
+        self.store.apply_batch("pods", [
+            (name, ns, self._bind_mutation(node) if node else unsched)
+            for ns, name, node in items
+        ])
+        return bound
 
     def _mark_gated(self, ns: str, name: str) -> None:
         """upstream SchedulingGates PreEnqueue rejection condition."""
@@ -1253,16 +1544,7 @@ class SchedulerEngine:
 
         self._update_pod(ns, name, mutate)
 
-    def _mark_unschedulable(self, ns: str, name: str) -> None:
-        def mutate(pod: dict) -> None:
-            status = pod.setdefault("status", {})
-            status["phase"] = "Pending"
-            conds = [c for c in status.get("conditions") or [] if c.get("type") != "PodScheduled"]
-            conds.append({
-                "type": "PodScheduled", "status": "False",
-                "reason": "Unschedulable",
-                "message": "0/%d nodes are available" % len(self.store.list("nodes")[0]),
-            })
-            status["conditions"] = conds
-
-        self._update_pod(ns, name, mutate)
+    def _mark_unschedulable(self, ns: str, name: str,
+                            fresh_node_count: bool = False) -> None:
+        self._update_pod(
+            ns, name, self._unschedulable_mutation(fresh_node_count))

@@ -1,0 +1,108 @@
+"""Incremental pending-pod index for the scheduling engine.
+
+The engine's queue used to be rebuilt every wave: list every pod in the
+store (10k+ manifests at cluster scale), filter the unbound ones and
+re-sort the survivors — O(P log P) work per wave even when a wave binds
+a handful of pods.  This index maintains the PrioritySort order
+(descending .spec.priority, FIFO by resourceVersion within equal
+priority — the engine's documented queue contract) incrementally from
+store watch events: a bind/create/delete/update costs O(log P) here, so
+a steady-state wave pays O(events) instead of O(P log P).
+
+Consistency: the index seeds from ObjectStore.list_and_watch (atomic
+list + subscription, so no event is lost in the gap) and drains its
+event queue synchronously inside pending() — ObjectStore delivers
+events under its write lock, so by the time a wave asks for the queue
+every completed store write is visible.  Manifests are the STORED
+objects (the informer-cache contract shared with list_shared): callers
+must not mutate them.
+
+The engine only routes through the index for stores exposing
+list_and_watch (the in-process ObjectStore) and when no custom
+QueueSort plugin is enabled (an arbitrary less() defeats incremental
+ordering); everything else falls back to the legacy list+sort path.
+"""
+
+from __future__ import annotations
+
+import bisect
+import queue
+
+
+def _key(pod: dict) -> tuple[str, str]:
+    meta = pod.get("metadata") or {}
+    return (meta.get("namespace") or "default", meta.get("name", ""))
+
+
+def _sort_key(pod: dict) -> tuple[int, int]:
+    # PrioritySort: priority desc, FIFO (resourceVersion) within — must
+    # stay bit-compatible with the engine's legacy sort key
+    return (
+        -int((pod.get("spec") or {}).get("priority") or 0),
+        int((pod.get("metadata") or {}).get("resourceVersion") or 0),
+    )
+
+
+def _is_pending(pod: dict) -> bool:
+    return not ((pod.get("spec") or {}).get("nodeName"))
+
+
+# an idle engine on a busy store accumulates events between waves; past
+# this backlog a fresh list_and_watch seed is cheaper than draining, and
+# it reclaims the queue's memory in one shot
+_REBUILD_BACKLOG = 8192
+
+
+class PendingPodIndex:
+    """Priority-ordered set of unscheduled pods, updated from watch
+    events.  Single-consumer (the engine's wave loop); not thread-safe —
+    waves already serialize on the engine."""
+
+    def __init__(self, store):
+        self.store = store
+        self._seed()
+
+    def _seed(self) -> None:
+        items, _rv, self._q = self.store.list_and_watch("pods")
+        self._by_key: dict[tuple[str, str], tuple[tuple[int, int], dict]] = {}
+        # sorted [(sort_key, key)]: unique because key is unique, so
+        # bisect can find exact entries for O(log P) removal
+        self._order: list[tuple[tuple[int, int], tuple[str, str]]] = []
+        for pod in items:
+            self._apply(pod, pending=_is_pending(pod))
+
+    def _apply(self, pod: dict, pending: bool) -> None:
+        k = _key(pod)
+        old = self._by_key.pop(k, None)
+        if old is not None:
+            i = bisect.bisect_left(self._order, (old[0], k))
+            del self._order[i]
+        if pending:
+            sk = _sort_key(pod)
+            self._by_key[k] = (sk, pod)
+            bisect.insort(self._order, (sk, k))
+
+    def refresh(self) -> None:
+        """Drain buffered store events into the index; a backlog beyond
+        _REBUILD_BACKLOG (the engine sat idle through heavy store churn)
+        re-seeds from a fresh atomic list instead."""
+        if self._q.qsize() > _REBUILD_BACKLOG:
+            self.store.unwatch("pods", self._q)
+            self._seed()
+            return
+        while True:
+            try:
+                _rv, event_type, obj = self._q.get_nowait()
+            except queue.Empty:
+                return
+            self._apply(obj, pending=(event_type != "DELETED")
+                        and _is_pending(obj))
+
+    def pending(self) -> list[dict]:
+        """Unscheduled pods in queue order (SHARED store manifests)."""
+        self.refresh()
+        by_key = self._by_key
+        return [by_key[k][1] for _, k in self._order]
+
+    def close(self) -> None:
+        self.store.unwatch("pods", self._q)

@@ -35,6 +35,7 @@ import numpy as np
 
 from .pipeline import build_step
 from ..state.compile import CompiledWorkload
+from ..utils.tracing import TRACER
 
 
 class _CompactChunks:
@@ -384,9 +385,14 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
     must divide by the mesh's "nodes" extent.
     on_chunk: optional callback (rr, lo, hi) fired as each chunk's host
     fetch lands, while the device runs later chunks — stream consumers
-    (the engine's decode) overlap host work with device compute.  May
-    re-fire from the first chunk if a score width tier overflows, so
-    per-pod writes must be idempotent.
+    (the engine's decode + pipelined commit) overlap host work with
+    device compute.  Chunks are delivered in ascending, contiguous
+    [lo, hi) order (the engine's commit worker relies on this to
+    preserve pod order).  May re-fire from the first chunk if a score
+    width tier overflows, so per-pod writes must be idempotent; chunks
+    that were already delivered (i.e. passed the overflow check) carry
+    bit-identical values on the wider re-run, which is what lets a
+    commit consumer keep a watermark and skip re-delivered pods.
     """
     if mesh is not None:
         from ..parallel.mesh import shard_workload
@@ -412,6 +418,7 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
                              on_chunk=on_chunk)
         if result is not None:
             return result
+        TRACER.count("replay_width_retries_total")
     raise AssertionError("unreachable: i64 replay cannot overflow")
 
 

@@ -65,6 +65,12 @@ def _build_and_load():
         P(ctypes.c_char_p), P(ctypes.c_char_p),
         P(ctypes.c_longlong), ctypes.c_longlong,
     ]
+    lib.encode_string_map_sized.restype = ctypes.c_void_p
+    lib.encode_string_map_sized.argtypes = [
+        P(ctypes.c_char_p), P(ctypes.c_char_p),
+        P(ctypes.c_longlong), ctypes.c_longlong,
+        P(ctypes.c_longlong), P(ctypes.c_int32),
+    ]
     lib.codec_ctx_new.restype = ctypes.c_void_p
     lib.codec_ctx_new.argtypes = [
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,

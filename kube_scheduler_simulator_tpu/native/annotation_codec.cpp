@@ -129,6 +129,40 @@ char* encode_string_map(const char* const* keys,
     return dup_string(out);
 }
 
+// encode_string_map with the output length returned (out_len) so the
+// caller can build the str in one sized copy instead of a NUL-scan +
+// bytes round-trip — the history-record encode runs once per pod per
+// wave and its values are ~250KB of blobs, so the extra pass is real.
+// ascii_only is set when every emitted byte is ASCII (escaping only
+// ever emits ASCII for ASCII input; a non-ASCII input byte is copied
+// through verbatim), letting the caller skip UTF-8 validation.
+char* encode_string_map_sized(const char* const* keys,
+                              const char* const* vals,
+                              const long long* val_lens,
+                              long long n,
+                              long long* out_len,
+                              int32_t* ascii_only) {
+    size_t cap = 2;
+    for (long long i = 0; i < n; ++i) cap += (size_t)val_lens[i] + 48;
+    std::string out;
+    out.reserve(cap);
+    out.push_back('{');
+    for (long long i = 0; i < n; ++i) {
+        if (i) out.push_back(',');
+        append_escaped(out, keys[i]);
+        out.push_back(':');
+        append_escaped_n(out, vals[i], (size_t)val_lens[i]);
+    }
+    out.push_back('}');
+    if (out_len) *out_len = (long long)out.size();
+    if (ascii_only) {
+        int32_t ascii = 1;
+        for (unsigned char c : out) if (c >= 0x80) { ascii = 0; break; }
+        *ascii_only = ascii;
+    }
+    return dup_string(out);
+}
+
 // filter-result: {"node":{"Plugin":"passed"|msg,...},...}
 //
 // codes:        [F*N] int32, 0 == pass (plugin-skip already zeroed)
@@ -349,6 +383,11 @@ struct FilterCache {
 
 void build_filter_frags(const Ctx& ctx, const uint8_t* active, FilterFrags& ff) {
     const int32_t f = ctx.f;
+    // reset alongside all_pass/frag: FilterFrags lives inside reused
+    // FilterCache slots (round-robin eviction, and the f>64 thread_local),
+    // so a stale true would make an empty-active pod emit per-node {}
+    // objects instead of "{}" — and cache the wrong blob
+    ff.any_active = false;
     ff.all_pass = "{";
     bool first = true;
     for (int32_t k = 0; k < f; ++k) {

@@ -13,8 +13,7 @@ import ctypes
 
 import numpy as np
 
-from ..native import (get_lib, take_sized_string, take_sized_string_ascii,
-                      take_string)
+from ..native import get_lib, take_sized_string, take_sized_string_ascii
 from ..plugins import (
     affinity, interpod, nodevolumelimits, ports, taints, topologyspread,
     volumebinding, volumerestrictions, volumezone,
@@ -306,7 +305,12 @@ def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
 def encode_string_map(d: dict[str, str]) -> str | None:
     """marshal(d) for a flat str->str dict via the native escape pass —
     the result-history record encoder.  None when the codec is
-    unavailable (caller falls back to the Python marshal)."""
+    unavailable (caller falls back to the Python marshal).
+
+    The str is built in ONE sized copy (memmove when the C side proves
+    the output pure ASCII): the record is re-encoded once per pod per
+    wave over ~250KB of blob values, so the NUL-scan + bytes round-trip
+    of the plain take_string path was a real slice of commit time."""
     lib = get_lib()
     if lib is None:
         return None
@@ -315,5 +319,10 @@ def encode_string_map(d: dict[str, str]) -> str | None:
     vals_b = [v.encode() for _, v in items]
     vals = _c_str_array(vals_b)
     lens = (ctypes.c_longlong * len(items))(*[len(b) for b in vals_b])
-    ptr = lib.encode_string_map(keys, vals, lens, len(items))
-    return take_string(lib, ptr)
+    out_len = ctypes.c_longlong()
+    ascii_only = ctypes.c_int32()
+    ptr = lib.encode_string_map_sized(keys, vals, lens, len(items),
+                                      ctypes.byref(out_len),
+                                      ctypes.byref(ascii_only))
+    take = take_sized_string_ascii if ascii_only.value else take_sized_string
+    return take(lib, ptr, out_len.value)

@@ -52,27 +52,39 @@ def _objects_only(raw: str) -> bool:
     return True
 
 
-def update_result_history(pod: dict, result_set: dict[str, str]) -> None:
+def encode_history_record(result_set: dict[str, str]) -> str:
+    """The encoded history record for result_set — precomputable OUTSIDE
+    any store lock (it depends only on the result set, not the pod), so
+    batched reflectors can pay the escape pass of ~250KB of blobs per
+    pod off-lock.  Raises ValueError when the record alone cannot fit:
+    JSON encoding never shrinks a string, so sum(len(k)+len(v))+syntax
+    is a lower bound on the encoded record — when even that exceeds the
+    limit (every pod at >=1k-node scale), raise before building and
+    escaping hundreds of KB per pod."""
+    lower_bound = 1 + sum(len(k) + len(v) + 6 for k, v in result_set.items())
+    if lower_bound > RESULT_HISTORY_LIMIT:
+        raise ValueError(
+            "result record alone exceeds the annotation size limit"
+        )
+    return _encode_record(result_set)
+
+
+def update_result_history(pod: dict, result_set: dict[str, str],
+                          rec: str | None = None) -> None:
     """Append result_set to the result-history annotation, trimming oldest
     entries until the encoded JSON fits the 256KiB limit.
 
     Fast path: the existing history is this function's own output (a JSON
     array), so the new record is spliced in textually — no re-parse and
     no re-escape of the accumulated records.  The trim branch (only once
-    the limit is hit) falls back to parse + drop-oldest."""
+    the limit is hit) falls back to parse + drop-oldest.
+
+    rec: the precomputed encode_history_record(result_set), when the
+    caller already paid for it (the batched reflector encodes off-lock)."""
     annotations = pod.setdefault("metadata", {}).setdefault("annotations", {})
     raw = annotations.get(ann.RESULT_HISTORY, "[]")
-    # JSON encoding never shrinks a string, so sum(len(k)+len(v))+syntax
-    # is a lower bound on the encoded record: when even that exceeds the
-    # limit (every pod at >=1k-node scale), raise before encoding — the
-    # caller logs and continues exactly as on the trim path's exhaustion,
-    # without building and escaping hundreds of KB per pod first
-    lower_bound = 1 + sum(len(k) + len(v) + 6 for k, v in result_set.items())
-    if lower_bound > RESULT_HISTORY_LIMIT:
-        raise ValueError(
-            "result record alone exceeds the annotation size limit"
-        )
-    rec = _encode_record(result_set)
+    if rec is None:
+        rec = encode_history_record(result_set)
     # textual-splice fast path: only for values shaped like this
     # function's own output (empty array, or array of objects) — anything
     # else falls through to the parsing path so corrupt histories raise
@@ -275,3 +287,100 @@ class StoreReflector:
         if last_pod:
             for rs in self.result_stores.values():
                 rs.delete_data(last_pod)
+
+    def reflect_batch(self, items) -> None:
+        """reflect() for many pods through one ObjectStore.apply_batch
+        call (conflict-free by construction, so no retry loop), then the
+        result-store entries of the pods actually written are deleted —
+        the engine's batched wave-commit surface.  items: iterable of
+        (namespace, name, uid).  Stores without apply_batch (the remote
+        HTTP client) fall back to per-pod reflect().
+
+        Two phases so the expensive work stays OFF the store lock: the
+        result-set merge and the history-record encode (the escape pass
+        over ~250KB of blobs per pod — the dominant reflect cost at
+        cluster scale) depend only on the result stores, so they run
+        before apply_batch; the mutate callbacks then only splice and
+        stamp under the lock, and a concurrent wave's binds never queue
+        behind a batch of record encodes."""
+        if getattr(self.store, "apply_batch", None) is None:
+            # attempt every pod even if an earlier one fails (the
+            # engine's one-future-per-pod semantics); first error wins
+            first_err = None
+            for ns, name, uid in items:
+                try:
+                    self.reflect(ns, name, uid=uid)
+                except Exception as e:  # noqa: BLE001
+                    first_err = first_err or e
+            if first_err is not None:
+                raise first_err
+            return
+        prepared: list[tuple] = []
+        for ns, name, uid in items:
+            key_pod = {"metadata": {"namespace": ns, "name": name}}
+            result_set: dict[str, str] = {}
+            for rs in self.result_stores.values():
+                m = rs.get_stored_result(key_pod) or {}
+                result_set.update(m)
+            if not result_set:
+                continue
+            rec = None
+            skip_history = False
+            try:
+                rec = encode_history_record(result_set)
+            except ValueError as e:
+                # log-and-continue (reference storereflector.go:131-134)
+                # HERE, off-lock — at >=1k-node scale every record
+                # overflows and a per-pod stderr write under the store
+                # lock would serialize the whole batch against binds
+                skip_history = True
+                import sys
+
+                print(f"reflector: result-history not updated: {e}",
+                      file=sys.stderr)
+            prepared.append((ns, name, uid, result_set, rec, skip_history))
+        if not prepared:
+            return
+        written: list[dict] = []
+        self.store.apply_batch("pods", [
+            (name, ns, self._reflect_mutation(ns, name, uid, result_set,
+                                              rec, skip_history, written))
+            for ns, name, uid, result_set, rec, skip_history in prepared
+        ])
+        for pod in written:
+            for rs in self.result_stores.values():
+                rs.delete_data(pod)
+
+    def _reflect_mutation(self, namespace: str, name: str, uid: str | None,
+                          result_set: dict[str, str], rec: str | None,
+                          skip_history: bool, written: list):
+        """apply_batch mutate callback with reflect()'s per-pod logic:
+        UID guard (purge-and-skip on a recreated pod), annotation merge,
+        history append (log-and-continue on ValueError) using the
+        pre-encoded record; skip_history marks an oversize record the
+        prepare phase already logged."""
+
+        def mutate(pod: dict):
+            meta = pod.get("metadata") or {}
+            if uid and meta.get("uid") not in (None, uid):
+                stale = {"metadata": {"namespace": namespace, "name": name}}
+                for rs in self.result_stores.values():
+                    rs.delete_data(stale)
+                return False
+            # metadata is already copy-on-write fresh (the apply_batch
+            # contract); the annotations dict below it is still shared
+            annotations = dict(meta.get("annotations") or {})
+            meta["annotations"] = annotations
+            annotations.update(result_set)
+            if not skip_history:
+                try:
+                    update_result_history(pod, result_set, rec=rec)
+                except ValueError as e:
+                    import sys
+
+                    print(f"reflector: result-history not updated: {e}",
+                          file=sys.stderr)
+            written.append(pod)
+            return True
+
+        return mutate

@@ -131,3 +131,22 @@ def test_host_phase_ticker_lifecycle():
     # stop.wait(60) forever)
     tk._t.join(timeout=5)
     assert not tk._t.is_alive(), "ticker thread leaked past __exit__"
+
+
+def test_measure_engine_reports_pipeline_spans():
+    """measure_engine surfaces the wave-pipeline observability bench.py
+    reports (docs/wave-pipeline.md): the commit_and_reflect span plus the
+    commit_stream_overlap_seconds / store_batch_writes_total counters on
+    a pipelined wave — and no stream counters when the sequential
+    post-pass is forced."""
+    r = bench.measure_engine(24, 6, seed=0)
+    assert r["bound"] > 0
+    assert "commit_and_reflect" in r["spans"]
+    assert "replay_and_decode_stream" in r["spans"]
+    assert r["counters"]["commit_stream_waves_total"] >= 1
+    assert "commit_stream_overlap_seconds" in r["counters"]
+    assert r["counters"]["store_batch_writes_total"] >= 48  # binds + reflects
+
+    r_seq = bench.measure_engine(24, 6, seed=0, pipeline=False)
+    assert r_seq["bound"] == r["bound"]
+    assert "commit_stream_waves_total" not in r_seq["counters"]

@@ -151,3 +151,58 @@ def test_engine_soak_dp_mesh(seed):
     mesh_out = run(make_mesh(4, dp=2))
     base_out = run(None)
     assert mesh_out == base_out
+
+
+@pytest.mark.parametrize("seed", [5, 23])
+def test_engine_soak_streaming_commit(seed):
+    """The randomized soak on the chunk-pipelined commit path: a
+    no-postfilter lineup with chunk=8 forces multi-chunk streaming waves
+    (the commit worker runs while the device scans), across creation /
+    priority-churn / deletion rounds.  End state must satisfy the same
+    invariants as the sequential engine, and a pipelined run must land
+    the exact same placement as a sequential run of the same rounds."""
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    rng = np.random.default_rng(seed)
+    nodes = make_nodes(int(rng.integers(6, 14)), seed=seed,
+                       taint_fraction=0.25)
+    pod_rounds = []
+    for r in range(3):
+        pods = make_pods(int(rng.integers(8, 20)), seed=seed * 10 + r,
+                         with_affinity=True, with_tolerations=True,
+                         with_spread=True)
+        for p in pods:
+            p["spec"]["priority"] = int(rng.integers(0, 3)) * 50
+        pod_rounds.append(pods)
+    cfg_kw = dict(enabled=[
+        "NodeResourcesFit", "NodeResourcesBalancedAllocation",
+        "NodeAffinity", "TaintToleration", "PodTopologySpread",
+    ])
+
+    def run(pipeline):
+        store = ObjectStore()
+        for n in nodes:
+            store.create("nodes", n)
+        engine = SchedulerEngine(
+            store, plugin_config=PluginSetConfig(**cfg_kw), chunk=8,
+            pipeline_commit=pipeline)
+        assert engine._can_stream_commit() == pipeline
+        for r, pods in enumerate(pod_rounds):
+            for p in pods:
+                q = {"metadata": dict(p["metadata"]), "spec": dict(p["spec"])}
+                q["metadata"]["name"] = f"r{r}-{p['metadata']['name']}"
+                store.create("pods", q)
+            engine.schedule_pending()
+            check_invariants(store)
+            # deterministic deletions free capacity for the next round
+            bound = sorted(
+                p["metadata"]["name"] for p in store.list("pods")[0]
+                if (p.get("spec") or {}).get("nodeName"))
+            for name in bound[: len(bound) // 3]:
+                store.delete("pods", name, "default")
+        engine.schedule_pending()
+        check_invariants(store)
+        return {p["metadata"]["name"]: (p.get("spec") or {}).get("nodeName")
+                for p in store.list("pods")[0]}
+
+    assert run(True) == run(False)

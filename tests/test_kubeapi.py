@@ -336,3 +336,40 @@ def test_recorder_from_real_apiserver(api, tmp_path):
                for r in lines}
     rec.stop()
     assert want <= got, got
+
+
+def test_watch_survives_last_unwatch_during_late_subscribe(api, monkeypatch):
+    """The late-subscriber buffer registration happens under the same
+    lock hold as the loop-thread check: if the only existing subscriber
+    unwatches while the newcomer is doing its ADDED-replay list, the
+    shared loop thread must stay alive (the newcomer's buffer already
+    holds the fan-out slot) and live events keep flowing — the two-lock
+    version left the newcomer attached to a dead fan-out (ADVICE
+    round-5)."""
+    api.objects["pods"] = [_pod("pre", rv="50")]
+    script = api.watch_script["pods"] = queue.Queue()
+    c = KubeAPICluster(base_url=api.url)
+    q1 = c.watch("pods")
+    _drain(q1, 1)  # initial ADDED replay
+
+    # second subscriber: drop the FIRST subscriber during the newcomer's
+    # replay list — exactly the window where the old code's second lock
+    # acquisition registered the buffer after the loop had been stopped
+    real_list = c._list_raw
+
+    def racing_list(resource, namespace=None, label_selector=None):
+        c.unwatch("pods", q1)
+        return real_list(resource, namespace, label_selector)
+
+    monkeypatch.setattr(c, "_list_raw", racing_list)
+    q2 = c.watch("pods")
+    monkeypatch.setattr(c, "_list_raw", real_list)
+    (rv0, t0, o0), = _drain(q2, 1)  # the newcomer's own ADDED replay
+    assert t0 == ADDED and o0["metadata"]["name"] == "pre"
+
+    # live events must still arrive: the shared loop was not stopped
+    script.put({"type": "MODIFIED", "object": _pod("pre", rv="1300")})
+    (rv1, t1, o1), = _drain(q2, 1)
+    assert t1 == MODIFIED and rv1 == 1300
+    c.unwatch("pods", q2)
+    c.stop()

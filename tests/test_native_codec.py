@@ -178,3 +178,35 @@ def test_decode_release_batches_aligns_to_compact_chunks():
     assert sorted(got) == list(range(len(pods)))
     for i in (0, 9, 10, len(pods) - 1):
         assert got[i] == decode_pod_result(rr, i)
+
+
+def test_empty_active_mask_on_reused_cache_slot():
+    """build_filter_frags must reset any_active per call: FilterFrags
+    lives inside reused FilterCache slots (round-robin eviction at 8
+    entries), so an empty-active-mask pod that lands on a reused slot
+    used to inherit any_active=true, emit {"node":{},...} instead of {}
+    — and cache the wrong blob for every later empty-mask pod of that
+    ctx on that thread (ADVICE round-5 medium)."""
+    import numpy as np
+
+    from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+    from kube_scheduler_simulator_tpu.store.native_decode import (
+        build_context, encode_filter)
+
+    nodes = make_nodes(3, seed=1)
+    pods = make_pods(2, seed=2)
+    cfg = PluginSetConfig(enabled=[
+        "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity"])
+    cw = compile_workload(nodes, pods, cfg)
+    ctx = build_context(cw)
+    f = len(cw.config.filters())
+    codes = np.zeros((f, cw.node_table.n), np.int32)
+    # churn 8 distinct non-empty masks (fills the thread-local cache),
+    # so the 9th — the empty mask — lands on a round-robin-evicted slot
+    for m in range(1, 9):
+        active = np.array([(m >> b) & 1 for b in range(f)], np.uint8)
+        assert encode_filter(ctx, codes, active).startswith("{\"")
+    assert encode_filter(ctx, codes, np.zeros(f, np.uint8)) == "{}"
+    # the (now-correct) cached entry serves later empty-mask pods too
+    assert encode_filter(ctx, codes, np.zeros(f, np.uint8)) == "{}"
