@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: codec test bench smoke clean parity-fullscale \
+.PHONY: codec native-asan test test-asan bench smoke clean parity-fullscale \
         parity-fullscale-device multichip-scaling host-probe tpu-watch
 
 # measurement artifacts (committed under docs/bench/; see BASELINE.md)
@@ -36,8 +36,17 @@ host-probe:
 codec:
 	$(PY) -c "from kube_scheduler_simulator_tpu.native import build_codec; print(build_codec())"
 
+# sanitizer build of the codec (address+undefined); the slow test in
+# tests/test_native_asan.py runs the codec suite against it via
+# KSS_TPU_NATIVE_SO + LD_PRELOAD of the ASan runtime
+native-asan:
+	$(PY) -c "from kube_scheduler_simulator_tpu.native import build_codec, ASAN_FLAGS; print(build_codec('kube_scheduler_simulator_tpu/native/_annotation_codec_asan.so', extra_flags=ASAN_FLAGS))"
+
+test-asan:
+	$(PY) -m pytest tests/test_native_asan.py -q -m slow
+
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q -m "not slow"
 
 bench:
 	$(PY) bench.py
@@ -46,5 +55,6 @@ smoke:
 	$(PY) bench.py --smoke
 
 clean:
-	rm -f kube_scheduler_simulator_tpu/native/_annotation_codec.so
+	rm -f kube_scheduler_simulator_tpu/native/_annotation_codec.so \
+	    kube_scheduler_simulator_tpu/native/_annotation_codec_asan.so
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -526,11 +526,17 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
             "commit_stream_overlap_seconds", "commit_stream_waves_total",
             "store_batch_writes_total", "store_batches_total",
             "replay_width_retries_total",
+            "decode_chunk_calls_total", "decode_native_thread_seconds",
         ) if k in summary["counters"]
     }
     if counters.get("commit_stream_overlap_seconds"):
         log(f"  commit overlapped with replay: "
             f"{counters['commit_stream_overlap_seconds']:.2f}s")
+    if counters.get("decode_chunk_calls_total"):
+        log(f"  native chunk decode: "
+            f"{counters['decode_chunk_calls_total']:.0f} calls, "
+            f"{counters.get('decode_native_thread_seconds', 0.0):.2f}s of "
+            f"C worker time")
     cps = scale_pods / total
     log(f"  engine: bound {bound}/{scale_pods} in {total:.2f}s -> {cps:,.0f} cycles/s")
     return {"pods": scale_pods, "nodes": scale_nodes, "bound": bound,

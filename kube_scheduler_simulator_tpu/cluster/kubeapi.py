@@ -196,6 +196,11 @@ class KubeAPICluster:
         self._watch_threads: dict[str, threading.Thread] = {}
         self._watch_stop: dict[str, threading.Event] = {}
         self._rv_counter = 0
+        # own lock: _rv_int's synthesized-counter branch (non-integer
+        # server rvs) is reached from paths already holding self._lock
+        # (the late-subscriber handover replay) — sharing the watch lock
+        # deadlocked there
+        self._rv_lock = threading.Lock()
 
     # ---------------- HTTP plumbing -------------------------------------
 
@@ -251,7 +256,7 @@ class KubeAPICluster:
         try:
             return int(rv_str)
         except (TypeError, ValueError):
-            with self._lock:
+            with self._rv_lock:
                 self._rv_counter += 1
                 return self._rv_counter
 
@@ -339,10 +344,16 @@ class KubeAPICluster:
                 buf = queue.Queue()
                 self._watchers.setdefault(resource, []).append(buf)
         if buf is not None:
-            # handover: snapshot ADDEDs first, then buffered events
-            # filtered to those NEWER than the snapshot's resourceVersion
-            # for the same object — so a live DELETED observed during the
-            # list cannot be resurrected by a stale replayed ADDED.  The
+            # handover: snapshot ADDEDs first, then buffered events minus
+            # the state the snapshot already carries — a buffered event
+            # whose resourceVersion EQUALS the listed object's is the very
+            # update the list reflected, so replaying it would double-
+            # deliver.  The comparison is on the server's EXACT rv
+            # strings: resourceVersions are opaque (only equality is
+            # defined), and the synthesized _rv_int counters are assigned
+            # in arrival order, which is meaningless for non-integer rvs
+            # (ADVICE r5 #3).  An event for a key the list doesn't carry
+            # (e.g. a DELETE racing the list) always goes through.  The
             # swap buffer -> q is atomic with deliveries (_fanout puts
             # under the lock).
             try:
@@ -353,25 +364,82 @@ class KubeAPICluster:
                 self.unwatch(resource, buf)
                 raise
             listed: dict = {}
+            listed_uid: dict = {}
             for obj in items:
                 m = obj.get("metadata") or {}
-                listed[(m.get("namespace"), m.get("name"))] = self._rv_int(
-                    m.get("resourceVersion"))
+                k = (m.get("namespace"), m.get("name"))
+                listed[k] = m.get("resourceVersion")
+                listed_uid[k] = m.get("uid")
             with self._lock:
                 subs = self._watchers[resource]
                 subs[subs.index(buf)] = q
                 for obj in items:
                     orv = (obj.get("metadata") or {}).get("resourceVersion")
                     q.put((self._rv_int(orv), ADDED, obj))
+                buffered: list[tuple] = []
                 while True:
                     try:
-                        ev = buf.get_nowait()
+                        buffered.append(buf.get_nowait())
                     except queue.Empty:
                         break
+                # the buffer is FIFO per key: the buffered event whose rv
+                # EQUALS the listed object's marks the point the snapshot
+                # already reflects — drop it and everything before it for
+                # that key (older intermediates would regress the
+                # subscriber's cache AFTER the newer ADDED), deliver only
+                # what came after.  An equal-rv DELETED still goes
+                # through: a pre-list delete can't appear in the list, so
+                # an equal-rv DELETED is a real post-list deletion.
+                # When NO buffered event matches the listed rv (the list
+                # raced ahead of the fan-out) opaque rvs are undecidable;
+                # events are then DELIVERED — a transiently stale
+                # re-delivery self-heals on the next live event, whereas
+                # dropping a genuinely newer update loses it forever
+                # (the at-least-once bias of the ADVICE r5 #3 contract).
+                cut: dict = {}
+                for idx, ev in enumerate(buffered):
                     m = (ev[2].get("metadata") or {})
                     k = (m.get("namespace"), m.get("name"))
-                    if k not in listed or ev[0] > listed[k]:
+                    if (k in listed and ev[1] != DELETED
+                            and m.get("resourceVersion") == listed[k]):
+                        cut[k] = idx + 1
+                dead_listed: set = set()
+                for idx, ev in enumerate(buffered):
+                    m = (ev[2].get("metadata") or {})
+                    k = (m.get("namespace"), m.get("name"))
+                    if k not in listed:
                         q.put(ev)
+                        continue
+                    if idx < cut.get(k, 0):
+                        continue  # at-or-before the snapshot's state
+                    buid, luid = m.get("uid"), listed_uid.get(k)
+                    if buid and luid and buid != luid:
+                        # a different uid is another incarnation of the
+                        # key.  BEFORE the listed incarnation's own
+                        # DELETED it can only be an older one (a pre-list
+                        # delete can't be listed): stale MODIFIEDs, and a
+                        # DELETED that must not remove the live object.
+                        # AFTER it, it's a post-list recreate — deliver,
+                        # or the subscriber never learns the new object
+                        # exists.
+                        if k in dead_listed:
+                            q.put(ev)
+                        continue
+                    if ev[1] == DELETED and buid and luid:
+                        # the LISTED incarnation died post-list (a
+                        # pre-list delete can't appear in the list)
+                        dead_listed.add(k)
+                    brv, lrv = m.get("resourceVersion"), listed[k]
+                    if brv == lrv:
+                        if ev[1] != DELETED:
+                            continue  # duplicate of the snapshot's state
+                    else:
+                        try:
+                            if int(brv) < int(lrv):
+                                continue  # provably older than the snapshot
+                        except (TypeError, ValueError):
+                            pass  # opaque rvs: only equality is defined
+                    q.put(ev)
         return q
 
     def unwatch(self, resource: str, q: queue.Queue) -> None:

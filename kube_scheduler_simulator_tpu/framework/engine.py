@@ -68,17 +68,12 @@ class _ReflectBatcher:
         fn = getattr(engine.reflector, "reflect_batch", None) if use_batch \
             else None
         if fn is None:
+            from ..store.reflector import reflect_each
+
             reflect_one = engine.reflector.reflect
 
             def fn(batch):
-                first_err = None
-                for bns, bname, buid in batch:
-                    try:
-                        reflect_one(bns, bname, uid=buid)
-                    except Exception as e:  # noqa: BLE001
-                        first_err = first_err or e
-                if first_err is not None:
-                    raise first_err
+                reflect_each(reflect_one, batch)
         self._fn = fn
 
     def submit(self, ns: str, name: str, uid: str | None) -> None:
@@ -141,6 +136,9 @@ class _WaveCommitter:
     # ---------------------------------------------- replay-thread side
 
     def on_chunk(self, rr, lo: int, hi: int) -> None:
+        # the WHOLE chunk goes down in one call: decode_chunk_into routes
+        # it through the chunk-granular native decode (one GIL-released C
+        # call per chunk, C-side worker pool) when available
         from ..store.decode import decode_chunk_into
 
         decode_chunk_into(rr, lo, hi, self.annotations)
@@ -679,7 +677,8 @@ class SchedulerEngine:
                 raise
             return committer.finish()
 
-        # stream: each chunk decodes (host, thread pool) as soon as its
+        # stream: each chunk decodes (chunk-granular native call, or the
+        # host thread pool on the fallback ladder) as soon as its
         # transfer lands, overlapping the device's later chunks
         all_annotations = [None] * len(pending)
         with TRACER.span("replay_and_decode_stream", pods=len(pending),

@@ -17,31 +17,45 @@ _lib = None
 _tried = False
 
 
-BUILD_CMD = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+BUILD_CMD = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread"]
+
+# `make native-asan` recipe: a sanitizer build of the same source for the
+# slow codec-suite-under-ASan test (tests/test_native_asan.py)
+ASAN_FLAGS = ["-g", "-fsanitize=address,undefined",
+              "-fno-sanitize-recover=undefined"]
 
 
-def build_codec(so: str | None = None) -> str:
+def build_codec(so: str | None = None,
+                extra_flags: list[str] | tuple[str, ...] = ()) -> str:
     """Compile annotation_codec.cpp -> _annotation_codec.so (the recipe
     `make codec` runs); returns the .so path."""
     here = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(here, "annotation_codec.cpp")
     so = so or os.path.join(here, "_annotation_codec.so")
-    subprocess.run([*BUILD_CMD, "-o", so, src], check=True, capture_output=True)
+    subprocess.run([*BUILD_CMD, *extra_flags, "-o", so, src], check=True,
+                   capture_output=True)
     return so
 
 
 def _build_and_load():
     here = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(here, "annotation_codec.cpp")
-    so = os.path.join(here, "_annotation_codec.so")
-    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
-        build_codec(so)
-    try:
-        lib = ctypes.CDLL(so)
-    except OSError:
-        # stale or foreign-platform binary: rebuild from source
-        build_codec(so)
-        lib = ctypes.CDLL(so)
+    # KSS_TPU_NATIVE_SO points the loader at a prebuilt library (the
+    # sanitizer harness runs the suite against the ASan build this way);
+    # no rebuild-if-stale in that mode — the harness owns the artifact
+    override = os.environ.get("KSS_TPU_NATIVE_SO")
+    if override:
+        lib = ctypes.CDLL(override)
+    else:
+        so = os.path.join(here, "_annotation_codec.so")
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            build_codec(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            # stale or foreign-platform binary: rebuild from source
+            build_codec(so)
+            lib = ctypes.CDLL(so)
     P = ctypes.POINTER
     lib.encode_filter_result.restype = ctypes.c_void_p
     lib.encode_filter_result.argtypes = [
@@ -89,6 +103,20 @@ def _build_and_load():
         ctypes.c_int32,
         P(ctypes.c_void_p), P(ctypes.c_int64),
     ]
+    lib.ctx_decode_chunk.restype = ctypes.c_void_p
+    lib.ctx_decode_chunk.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        P(ctypes.c_uint8), P(ctypes.c_uint8),
+        P(ctypes.c_void_p), P(ctypes.c_int64), P(ctypes.c_int32),
+        P(ctypes.c_uint8), P(ctypes.c_uint8), P(ctypes.c_uint8),
+        ctypes.c_int32,
+        P(ctypes.c_int64), P(ctypes.c_int64),
+        P(ctypes.c_double),
+    ]
+    lib.chunk_arena_free.restype = None
+    lib.chunk_arena_free.argtypes = [ctypes.c_void_p]
     lib.codec_ctx_free.restype = None
     lib.codec_ctx_free.argtypes = [ctypes.c_void_p]
     lib.ctx_all_ascii.restype = ctypes.c_int32
@@ -147,14 +175,23 @@ try:
         if length == 0:
             return ""  # PyUnicode_New(0, ...) returns the shared singleton
         s = _PyUnicode_New(length, 127)
-        # C buffers are NUL-terminated; copy the NUL along with the data
-        ctypes.memmove(id(s) + _ASCII_DATA_OFF, ptr, length + 1)
+        # copy exactly `length` bytes: PyUnicode_New already wrote the
+        # NUL terminator at data[length], so the source needn't be
+        # NUL-terminated (the old length+1 memmove silently imposed that
+        # on every C buffer crossing this boundary — and read one byte
+        # past buffers that weren't)
+        ctypes.memmove(id(s) + _ASCII_DATA_OFF, ptr, length)
         return s
 
+    # probe with trailing GARBAGE (not NUL) after the payload: proves both
+    # the content copy and that PyUnicode_New supplied the terminator
     _probe = b"probe{\"x\":\"1\"}"
-    _buf = ctypes.create_string_buffer(_probe)  # NUL-terminated
-    _ASCII_TAKE_OK = (_ascii_take(ctypes.addressof(_buf), len(_probe))
-                      == _probe.decode())
+    _buf = (ctypes.c_char * (len(_probe) + 1)).from_buffer_copy(_probe + b"X")
+    _out = _ascii_take(ctypes.addressof(_buf), len(_probe))
+    _ASCII_TAKE_OK = (
+        _out == _probe.decode()
+        and ctypes.string_at(id(_out) + _ASCII_DATA_OFF, len(_probe) + 1)
+        == _probe + b"\x00")
 except Exception:
     _ASCII_TAKE_OK = False
 
@@ -167,6 +204,24 @@ def take_sized_string_ascii(lib, ptr, length: int) -> str:
         return _ascii_take(ptr, length)
     finally:
         lib.codec_free(ptr)
+
+
+# Arena string takers — str from an (address, length) pair WITHOUT
+# freeing: ctx_decode_chunk's blobs live in a per-call arena released by
+# ONE chunk_arena_free after every pod's strs are built, so the takers
+# only copy.  peek_string_ascii is the plain-memcpy path for contexts
+# proven pure-ASCII; peek_string is the UTF-8-validating fallback.
+
+def peek_string(addr: int, length: int) -> str:
+    if _PyUnicode_DecodeUTF8 is not None:
+        return _PyUnicode_DecodeUTF8(addr, length, b"strict")
+    return ctypes.string_at(addr, length).decode()
+
+
+def peek_string_ascii(addr: int, length: int) -> str:
+    if not _ASCII_TAKE_OK:
+        return peek_string(addr, length)
+    return _ascii_take(addr, length)
 
 
 def get_lib():

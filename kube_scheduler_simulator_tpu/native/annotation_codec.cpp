@@ -21,11 +21,16 @@
 // workload.
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <charconv>
 #include <cstring>
 #include <cstdlib>
+#include <functional>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <algorithm>
 #include <vector>
 
@@ -697,11 +702,12 @@ inline int64_t read_score(const void* col, int32_t elem, int32_t j) {
     }
 }
 
-}  // namespace
-
-
-int32_t ctx_decode_pod(
-    void* p,
+// decode_one: the per-pod body shared by ctx_decode_pod (one C call per
+// pod, the legacy fused path) and ctx_decode_chunk (one C call per replay
+// chunk, pods iterated by the worker pool).  Runs on any thread; all
+// scratch state is thread_local.
+int32_t decode_one(
+    const Ctx& ctx,
     const void* packed, int32_t pack_elem, int32_t code_bits,
     const uint8_t* active,
     const uint8_t* sskip,
@@ -709,7 +715,6 @@ int32_t ctx_decode_pod(
     const uint8_t* ignored,
     int32_t want_scores,
     char** out_blobs, int64_t* out_lens) {
-    const Ctx& ctx = *(const Ctx*)p;
     const int32_t n = ctx.n, f = ctx.f, s = ctx.s;
     const uint64_t code_mask = (code_bits >= 64) ? ~0ull : ((1ull << code_bits) - 1);
 
@@ -967,6 +972,202 @@ int32_t ctx_decode_pod(
     out_lens[2] = (int64_t)(fw - fbuf);
     return 0;
 }
+
+// ---------------------------------------------------------------------------
+// Chunk-granular decode (ctx_decode_chunk): one GIL-released C call per
+// replay chunk.  A small persistent worker pool iterates the chunk's pods
+// (work-stealing atomic counter); each pod's three blobs land in a
+// per-call arena whose addresses/lengths are written into caller arrays,
+// so Python builds the result strs with zero per-pod C calls and frees
+// everything with ONE chunk_arena_free.  Pool threads persist across
+// calls so their thread_local FilterCaches (the ~1 MB per-active-set
+// `cat` concatenations) survive from chunk to chunk.
+
+class WorkerPool {
+public:
+    // fn(worker_idx) on n workers total; the calling thread is worker 0,
+    // pool threads are 1..n-1.  Concurrent callers (parallel chunk
+    // decodes from several Python threads) don't queue: whoever finds
+    // the pool busy just runs inline — the work-stealing loop makes a
+    // single worker complete the whole chunk correctly.
+    void run(int n, const std::function<void(int)>& fn) {
+        if (n <= 1) {  // inline, WITHOUT claiming the pool: a small
+            fn(0);     // chunk must not degrade a concurrent big one
+            return;
+        }
+        std::unique_lock<std::mutex> busy(busy_m_, std::try_to_lock);
+        if (!busy.owns_lock()) {
+            fn(0);
+            return;
+        }
+        {
+            std::lock_guard<std::mutex> lk(m_);
+            while ((int)threads_.size() < n - 1) {
+                int idx = (int)threads_.size() + 1;
+                threads_.emplace_back([this, idx] { loop(idx); });
+            }
+            job_ = &fn;
+            target_ = n - 1;
+            remaining_ = n - 1;
+            ++gen_;
+        }
+        cv_.notify_all();
+        fn(0);
+        std::unique_lock<std::mutex> lk(m_);
+        done_cv_.wait(lk, [&] { return remaining_ == 0; });
+        job_ = nullptr;
+    }
+
+private:
+    void loop(int idx) {
+        uint64_t seen = 0;
+        std::unique_lock<std::mutex> lk(m_);
+        for (;;) {
+            cv_.wait(lk, [&] { return gen_ != seen; });
+            seen = gen_;
+            if (idx > target_) continue;  // sized out of this round
+            const std::function<void(int)>* j = job_;
+            lk.unlock();
+            (*j)(idx);
+            lk.lock();
+            if (--remaining_ == 0) done_cv_.notify_one();
+        }
+    }
+
+    std::mutex busy_m_;  // one chunk in the pool at a time
+    std::mutex m_;
+    std::condition_variable cv_, done_cv_;
+    std::vector<std::thread> threads_;
+    const std::function<void(int)>* job_ = nullptr;
+    uint64_t gen_ = 0;
+    int target_ = 0, remaining_ = 0;
+};
+
+// leaked on purpose: joining detached-for-life workers from a static
+// destructor would std::terminate at interpreter exit
+WorkerPool& decode_pool() {
+    static WorkerPool* p = new WorkerPool();
+    return *p;
+}
+
+struct ChunkArena {
+    std::vector<char*> blobs;
+    ~ChunkArena() {
+        for (char* b : blobs) std::free(b);
+    }
+};
+
+}  // namespace
+
+int32_t ctx_decode_pod(
+    void* p,
+    const void* packed, int32_t pack_elem, int32_t code_bits,
+    const uint8_t* active,
+    const uint8_t* sskip,
+    const void* const* score_cols, const int32_t* score_elem,
+    const uint8_t* ignored,
+    int32_t want_scores,
+    char** out_blobs, int64_t* out_lens) {
+    return decode_one(*(const Ctx*)p, packed, pack_elem, code_bits, active,
+                      sskip, score_cols, score_elem, ignored, want_scores,
+                      out_blobs, out_lens);
+}
+
+// One call per replay chunk; the GIL is released for the whole call.
+//
+//   c:            pods in this range
+//   packed:       [c, N] packed first-fail words, C-contiguous
+//   active_rows:  [c, F] uint8 plugin-ran masks (per-pod rows)
+//   sskip_rows:   [c, S] uint8 score-skip masks
+//   col_base:     [S] pointer to pod 0's raw column (NULL when unused)
+//   col_stride:   [S] BYTES between consecutive pods' columns
+//   col_elem:     [S] column element size (1/2/4/8, signed)
+//   ignored:      [c, N] TSP score-ignore rows, or NULL
+//   want_scores:  [c] uint8, feasible_count > 1
+//   skip_pod:     [c] uint8 (or NULL): 1 = leave the pod's slots 0 —
+//                 Python's prefilter-reject early-out owns it
+//   n_threads:    workers incl. the caller (clamped to [1, 16])
+//   out_ptrs/out_lens: [c*3] blob addresses/lengths (0 = absent); valid
+//                 until chunk_arena_free of the returned arena
+//   thread_seconds: out, summed worker busy time (tracer counter)
+void* ctx_decode_chunk(
+    void* p,
+    int32_t c,
+    const void* packed, int32_t pack_elem, int32_t code_bits,
+    const uint8_t* active_rows,
+    const uint8_t* sskip_rows,
+    const void* const* col_base,
+    const int64_t* col_stride,
+    const int32_t* col_elem,
+    const uint8_t* ignored,
+    const uint8_t* want_scores,
+    const uint8_t* skip_pod,
+    int32_t n_threads,
+    int64_t* out_ptrs,
+    int64_t* out_lens,
+    double* thread_seconds) {
+    const Ctx& ctx = *(const Ctx*)p;
+    const int32_t n = ctx.n, f = ctx.f, s = ctx.s;
+    ChunkArena* arena = new ChunkArena();
+    arena->blobs.reserve((size_t)c * 3);
+    std::memset(out_ptrs, 0, (size_t)c * 3 * sizeof(int64_t));
+    std::memset(out_lens, 0, (size_t)c * 3 * sizeof(int64_t));
+
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > 16) n_threads = 16;
+    if (c < 2 * n_threads) n_threads = 1;  // not worth waking the pool
+
+    std::atomic<int32_t> next{0};
+    std::atomic<long long> busy_ns{0};
+    std::mutex merge_m;
+
+    auto work = [&](int) {
+        auto t0 = std::chrono::steady_clock::now();
+        std::vector<char*> local;
+        std::vector<const void*> cols((size_t)(s > 0 ? s : 1), nullptr);
+        for (;;) {
+            int32_t i = next.fetch_add(1, std::memory_order_relaxed);
+            if (i >= c) break;
+            if (skip_pod && skip_pod[i]) continue;
+            for (int32_t q = 0; q < s; ++q)
+                cols[q] = col_base[q]
+                    ? (const char*)col_base[q] + (int64_t)i * col_stride[q]
+                    : nullptr;
+            char* blobs[3];
+            int64_t lens[3];
+            decode_one(ctx,
+                       (const char*)packed + (size_t)i * n * pack_elem,
+                       pack_elem, code_bits,
+                       active_rows + (size_t)i * f,
+                       sskip_rows + (size_t)i * s,
+                       cols.data(), col_elem,
+                       ignored ? ignored + (size_t)i * n : nullptr,
+                       want_scores[i] ? 1 : 0,
+                       blobs, lens);
+            for (int b = 0; b < 3; ++b) {
+                if (!blobs[b]) continue;
+                // emit caps are upper bounds (21 bytes per numeric
+                // field); trim so the arena holds ~actual blob bytes
+                // for the whole chunk, not the slack
+                char* t = (char*)std::realloc(blobs[b], (size_t)lens[b] + 1);
+                if (t) blobs[b] = t;
+                local.push_back(blobs[b]);
+                out_ptrs[(size_t)i * 3 + b] = (int64_t)(intptr_t)blobs[b];
+                out_lens[(size_t)i * 3 + b] = lens[b];
+            }
+        }
+        busy_ns.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - t0).count());
+        std::lock_guard<std::mutex> lg(merge_m);
+        arena->blobs.insert(arena->blobs.end(), local.begin(), local.end());
+    };
+
+    decode_pool().run(n_threads, work);
+    if (thread_seconds) *thread_seconds = busy_ns.load() / 1e9;
+    return arena;
+}
+
+void chunk_arena_free(void* a) { delete (ChunkArena*)a; }
 
 char* ctx_encode_scores(void* p, const int64_t* values,
                         const uint8_t* sskip, const uint8_t* feasible,

@@ -23,6 +23,7 @@ import numpy as np
 
 from . import annotations as ann
 from ..utils.platform import effective_cpu_count
+from ..utils.tracing import TRACER
 from ..framework.replay import ReplayResult
 from ..plugins import (
     affinity, interpod, noderesources, nodevolumelimits, ports, taints,
@@ -298,14 +299,110 @@ def _decode_pool():
     return _DECODE_POOL
 
 
+def _chunk_skip_mask(rr, lo: int, hi: int):
+    """[hi-lo] uint8 marking prefilter-rejected pods (the Python
+    early-out owns them — their cycle aborted before Filter, so there
+    are no blobs to decode), or None when the range has none.
+
+    Mirrors prefilter_reject_message's not-None condition exactly: a
+    static (PVC-lister) reject for the pod, or the dynamic
+    ReadWriteOncePod conflict bit with VolumeRestrictions enabled.  The
+    static part is a pure function of the workload, so it's vectorized
+    once per cw — no per-pod Python on the chunk-decode hot path."""
+    cw = rr.cw
+    static = cw.host.get("prefilter_reject", {})
+    dyn = np.asarray(rr.prefilter_reject[lo:hi])
+    if not static and not dyn.any():
+        return None
+    mask = cw.host.get("_static_reject_any")
+    if mask is None:
+        mask = np.zeros(cw.n_pods, bool)
+        for msgs in static.values():
+            mask |= np.asarray([m is not None for m in msgs], bool)
+        cw.host["_static_reject_any"] = mask
+    skip = mask[lo:hi].copy()
+    if "VolumeRestrictions" in cw.config.prefilters():
+        skip |= (dyn & 1).astype(bool)
+    if not skip.any():
+        return None
+    return np.ascontiguousarray(skip, np.uint8)
+
+
+def _assemble_chunk(rr, lo: int, hi: int, triples, out: list,
+                    base: int) -> None:
+    """Per-pod tail of the chunk decode: blob strs -> the 13-key dicts."""
+    cw = rr.cw
+    cfg = cw.config
+    names = cw.node_table.names
+    fskip = cw.host["filter_skip"]
+    sskip = cw.host["score_skip"]
+    prefilters = cfg.prefilters()
+    prescorers = cfg.prescorers()
+    feasible_count = rr.feasible_count
+    for i in range(lo, hi):
+        t = triples[i - lo]
+        if t is None:  # prefilter reject: the early-out path owns it
+            out[i - base] = decode_pod_result(rr, i)
+            continue
+        filter_json, score_json, final_json = t
+        prefilter_status = {
+            name: "" if fskip[name][i] else ann.SUCCESS_MESSAGE
+            for name in prefilters
+        }
+        prescore = {}
+        if int(feasible_count[i]) > 1:
+            for name in prescorers:
+                prescore[name] = "" if sskip[name][i] else ann.SUCCESS_MESSAGE
+        out[i - base] = _assemble(cw, cfg, names, rr, i, prefilter_status,
+                                  prescore, filter_json, score_json,
+                                  final_json)
+
+
+def _decode_chunk_native(rr, lo: int, hi: int, out: list, base: int) -> bool:
+    """Pods lo..hi (a range within ONE compact chunk) through the
+    chunk-granular native call: one GIL-released ctx_decode_chunk runs
+    the C worker pool over the whole range and hands back arena blob
+    addresses; Python keeps only the prefilter-reject early-out and the
+    13-key _assemble.  False -> caller falls back (no native ctx)."""
+    ctx = _native_ctx(rr.cw)
+    if ctx is None:
+        return False
+    from . import native_decode
+
+    triples, thread_s = native_decode.decode_chunk_fused(
+        ctx, rr, lo, hi, skip=_chunk_skip_mask(rr, lo, hi))
+    TRACER.count("decode_chunk_calls_total")
+    TRACER.count("decode_native_thread_seconds", round(thread_s, 6))
+    _assemble_chunk(rr, lo, hi, triples, out, base)
+    return True
+
+
 def decode_chunk_into(rr, lo: int, hi: int, out: list, base: int = 0) -> None:
     """Decode pods lo..hi of one replay chunk into out[lo-base:hi-base] —
     the replay(on_chunk=...) streaming consumer: runs on the dispatch
     thread while the device executes later chunks.  Idempotent per index
     (a width-tier rerun re-delivers chunks).  base: offset for callers
     passing a chunk-local sink (out[i-base]) instead of a queue-length
-    list."""
+    list.
+
+    Decoder ladder (docs/wave-pipeline.md): chunk-granular native call
+    (one GIL-released C call per compact chunk, C-side worker pool) ->
+    per-pod fused native decode on the Python thread pool -> pure-Python
+    encoder (KSS_TPU_DISABLE_NATIVE=1, or no toolchain)."""
     cc = getattr(rr, "_compact", None)
+    if cc is not None:
+        # chunk-granular native decode; ranges spanning several compact
+        # chunks (full-queue callers) split on chunk boundaries
+        s0, routed = lo, True
+        while s0 < hi:
+            s1 = min(hi, (s0 // cc.chunk + 1) * cc.chunk)
+            if not _decode_chunk_native(rr, s0, s1, out, base):
+                routed = False
+                break
+            s0 = s1
+        if routed:
+            return
+        lo = s0  # keep anything the native path already decoded
     if hi - lo < 16 or effective_cpu_count() < 2:
         # single-core hosts: the pool's dispatch + recon-lock traffic
         # costs more than the GIL-released C calls can win back
@@ -332,39 +429,80 @@ def decode_release_batches(rr, lo: int, hi: int, on_pod=None,
     reflector-style consumer (holds nothing, BASELINE.md): holding a
     whole replay chunk's strings before releasing pays ~1.3 GB of
     first-touch page faults at the 5k-node shape, a harness transient
-    rather than decoder cost.  Batches never straddle a compact chunk so
-    pool workers share one recon-cache slot; chunk-clamped tail batches
-    (>=16 pods) still ride decode_chunk_into's pool on multi-core hosts."""
+    rather than decoder cost.  Batches never straddle a compact chunk.
+
+    On the chunk-granular native path the batches PIPELINE: batch k+1's
+    GIL-released C decode runs on a pool thread while this thread builds
+    batch k's strs and fires on_pod — on a 2-core host that hides most
+    of the C wall time behind the (GIL-bound) str assembly.  Pod order
+    of on_pod calls is preserved."""
     cc = getattr(rr, "_compact", None)
+    ranges: list[tuple[int, int]] = []
     s0 = lo
     while s0 < hi:
         s1 = min(s0 + batch, hi)
         if cc is not None:
             s1 = min(s1, (s0 // cc.chunk + 1) * cc.chunk)
-        sink: list = [None] * (s1 - s0)
-        decode_chunk_into(rr, s0, s1, sink, base=s0)
+        ranges.append((s0, s1))
+        s0 = s1
+
+    ctx = _native_ctx(rr.cw) if cc is not None else None
+    if ctx is not None:
+        from . import native_decode
+
+        pool = _decode_pool()
+
+        def start(r):
+            return pool.submit(
+                native_decode.decode_chunk_start, ctx, rr, r[0], r[1],
+                _chunk_skip_mask(rr, *r))
+
+        fut = start(ranges[0]) if ranges else None
+        try:
+            for k, (b0, b1) in enumerate(ranges):
+                handle = fut.result()
+                fut = start(ranges[k + 1]) if k + 1 < len(ranges) else None
+                triples = native_decode.decode_chunk_take(handle)
+                TRACER.count("decode_chunk_calls_total")
+                TRACER.count("decode_native_thread_seconds",
+                             round(handle.thread_seconds, 6))
+                sink: list = [None] * (b1 - b0)
+                _assemble_chunk(rr, b0, b1, triples, sink, b0)
+                if on_pod is not None:
+                    for j, a in enumerate(sink):
+                        if a is not None:
+                            on_pod(b0 + j, a)
+        except BaseException:
+            if fut is not None:  # don't leak the in-flight arena
+                try:
+                    fut.result().discard()
+                except Exception:
+                    pass
+            raise
+        return
+
+    for b0, b1 in ranges:
+        sink = [None] * (b1 - b0)
+        decode_chunk_into(rr, b0, b1, sink, base=b0)
         if on_pod is not None:
             for j, a in enumerate(sink):
                 if a is not None:
-                    on_pod(s0 + j, a)
-        s0 = s1
+                    on_pod(b0 + j, a)
 
 
 def decode_all_parallel(rr: ReplayResult,
                         n: int | None = None) -> list[dict[str, str]]:
     """Decode pods 0..n across a thread pool, chunk by chunk.
 
-    The native codec runs outside the GIL (ctypes releases it for the C
-    call), so threads give real parallelism on the JSON encoding — the
-    dominant cost at cluster scale.  Chunks are reconstructed on the main
-    thread first so the workers share one cached reconstruction instead of
-    thrashing ReplayResult's single-slot cache.  Falls back to the serial
-    loop when the ReplayResult holds full arrays (host path) or the
-    workload is small."""
+    The native codec runs outside the GIL — one ctx_decode_chunk call per
+    compact chunk drives the C-side worker pool (decode_chunk_into's
+    ladder), so the JSON encoding — the dominant cost at cluster scale —
+    parallelizes without per-pod Python dispatch.  Falls back to the
+    serial loop when the ReplayResult holds full arrays (host path)."""
     if n is None:
         n = rr.cw.n_pods
     cc = getattr(rr, "_compact", None)
-    if cc is None or n < 64 or effective_cpu_count() < 2:
+    if cc is None:
         return [decode_pod_result(rr, i) for i in range(n)]
     out: list = [None] * n
     for lo in range(0, n, cc.chunk):

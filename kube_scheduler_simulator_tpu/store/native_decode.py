@@ -13,7 +13,10 @@ import ctypes
 
 import numpy as np
 
-from ..native import get_lib, take_sized_string, take_sized_string_ascii
+from ..native import (
+    get_lib, peek_string, peek_string_ascii, take_sized_string,
+    take_sized_string_ascii,
+)
 from ..plugins import (
     affinity, interpod, nodevolumelimits, ports, taints, topologyspread,
     volumebinding, volumerestrictions, volumezone,
@@ -166,7 +169,7 @@ class _NativeCtx:
     """Owns one C-side codec context; freed with the workload."""
 
     __slots__ = ("lib", "ptr", "n", "active_rows", "sskip_rows",
-                 "has_tsp_score", "take", "__weakref__")
+                 "has_tsp_score", "take", "peek", "__weakref__")
 
     def __init__(self, lib, ptr, n):
         self.lib = lib
@@ -177,8 +180,12 @@ class _NativeCtx:
         self.has_tsp_score = False
         # blob -> str builder: plain memcpy when the ctx proves every
         # emitted byte ASCII, else the UTF-8-validating decode
-        self.take = (take_sized_string_ascii if lib.ctx_all_ascii(ptr)
+        all_ascii = lib.ctx_all_ascii(ptr)
+        self.take = (take_sized_string_ascii if all_ascii
                      else take_sized_string)
+        # arena variant (no free; ctx_decode_chunk's arena is released
+        # in one chunk_arena_free after the whole chunk's strs exist)
+        self.peek = peek_string_ascii if all_ascii else peek_string
 
     def __del__(self):
         if self.ptr:
@@ -216,6 +223,180 @@ def encode_scores(ctx: _NativeCtx, values: np.ndarray, sskip: np.ndarray,
     ptr = ctx.lib.ctx_encode_scores(ctx.ptr, _i64p(values), _u8p(sskip),
                                     _u8p(feasible), ctypes.byref(out_len))
     return ctx.take(ctx.lib, ptr, out_len.value)
+
+
+def _tsp_ignored_cached(rr, ci: int, c: int):
+    """PodTopologySpread's [C, N] score-ignore mask for compact chunk ci,
+    cached on the ReplayResult (shared by the per-pod fused path and the
+    chunk call; double-checked under the recon lock so a chunk boundary
+    doesn't make every pool worker recompute the O(C*N) mask at once)."""
+    cache = getattr(rr, "_fused_ignored", None)
+    if cache is None or cache[0] != ci:
+        with rr._recon_lock:
+            cache = getattr(rr, "_fused_ignored", None)
+            if cache is None or cache[0] != ci:
+                ig = np.ascontiguousarray(
+                    rr._tsp_ignored_chunk(ci, c, rr.cw.n_nodes), np.uint8)
+                cache = (ci, ig)
+                rr._fused_ignored = cache
+    return cache[1]
+
+
+class _ChunkHandle:
+    """An in-flight ctx_decode_chunk result: the arena pointer plus the
+    per-pod blob address/length arrays.  decode_chunk_take() turns it
+    into strs and frees the arena; dropping it without take leaks the
+    arena (callers always pair the two)."""
+
+    __slots__ = ("ctx", "arena", "out_ptrs", "out_lens", "skip", "c",
+                 "thread_seconds", "_keep")
+
+    def __init__(self, ctx, arena, out_ptrs, out_lens, skip, c,
+                 thread_seconds, keep):
+        self.ctx = ctx
+        self.arena = arena
+        self.out_ptrs = out_ptrs
+        self.out_lens = out_lens
+        self.skip = skip
+        self.c = c
+        self.thread_seconds = thread_seconds
+        self._keep = keep
+
+    def discard(self) -> None:
+        """Free the arena without building any strings — the error-path
+        cleanup (decode_chunk_take does this in its finally on the
+        normal path)."""
+        if self.arena is not None:
+            self.ctx.lib.chunk_arena_free(self.arena)
+            self.arena = None
+
+
+def decode_chunk_start(ctx: _NativeCtx, rr, lo: int, hi: int,
+                       skip=None, n_threads: int | None = None) -> _ChunkHandle:
+    """The GIL-released half of the chunk decode: one ctx_decode_chunk
+    call covering pods lo..hi (a range inside ONE compact replay chunk).
+    The C side iterates the pods with its worker pool and emits every
+    pod's three heavy blobs into a per-call arena.  Runs fine on a helper
+    thread (ctypes drops the GIL for the call) — decode_release_batches
+    pipelines the NEXT batch's C decode under the current batch's
+    str-building this way.
+
+    skip: optional [hi-lo] uint8 — pods Python's prefilter-reject
+    early-out owns; the C side leaves their slots empty."""
+    from ..framework.pipeline import PACK_MODES
+    from ..utils.platform import effective_cpu_count
+
+    cc = rr._compact
+    c = hi - lo
+    ci, r_lo = divmod(lo, cc.chunk)
+    packed = cc.packed[ci]
+    if not packed.flags["C_CONTIGUOUS"]:
+        # device-layout fetch (TPU backends can return strided host
+        # arrays); the C codec walks raw pointers in C order
+        packed = cc.packed[ci] = np.ascontiguousarray(packed)
+    code_bits = PACK_MODES[cc.pack_mode][1]
+    n = ctx.n
+    elem = packed.dtype.itemsize
+    packed_ptr = packed.ctypes.data + r_lo * n * elem
+
+    active = ctx.active_rows[lo:hi]   # [c, F], contiguous row slice
+    sskip = ctx.sskip_rows[lo:hi]     # [c, S]
+    want = np.ascontiguousarray(
+        np.asarray(rr.feasible_count[lo:hi]) > 1, np.uint8)
+
+    s = len(cc.score_cols)
+    col_base = (ctypes.c_void_p * max(s, 1))()
+    col_stride = (ctypes.c_int64 * max(s, 1))()
+    col_elem = (ctypes.c_int32 * max(s, 1))()
+    keep_alive = [packed, active, sskip, want]
+    any_scores = bool(want.any())
+    if any_scores and s:
+        static_rows = rr.cw.host.get("static_score_rows", {})
+        for q, (group, row) in enumerate(cc.score_cols):
+            if group == "host":
+                # precompiled host-resident raw ([P, N] C-contiguous);
+                # sskip'd scorers are never read by the C codec, so the
+                # unmasked rows are safe to hand over
+                src = static_rows[row]
+                if not src.flags["C_CONTIGUOUS"]:
+                    src = static_rows[row] = np.ascontiguousarray(src)
+                keep_alive.append(src)
+                e = src.dtype.itemsize
+                col_base[q] = src.ctypes.data + lo * n * e
+                col_stride[q] = n * e
+                col_elem[q] = e
+            else:
+                arr = getattr(cc, group)[ci]   # [C, S_g, N]
+                if not arr.flags["C_CONTIGUOUS"]:
+                    arr = np.ascontiguousarray(arr)
+                    getattr(cc, group)[ci] = arr
+                keep_alive.append(arr)
+                e = arr.dtype.itemsize
+                col_base[q] = arr.ctypes.data + (r_lo * arr.shape[1] + row) * n * e
+                col_stride[q] = arr.shape[1] * n * e
+                col_elem[q] = e
+
+    ig_ptr = None
+    if (any_scores and ctx.has_tsp_score
+            and rr.cw.host.get("tsp_ignore") is not None):
+        ig = _tsp_ignored_cached(rr, ci, packed.shape[0])
+        ig_rows = ig[r_lo:r_lo + c]
+        keep_alive.append(ig_rows)
+        ig_ptr = _u8p(ig_rows)
+
+    out_ptrs = np.zeros(c * 3, np.int64)
+    out_lens = np.zeros(c * 3, np.int64)
+    tsec = ctypes.c_double()
+    if n_threads is None:
+        n_threads = min(8, effective_cpu_count())
+    if skip is not None:
+        keep_alive.append(skip)
+    arena = ctx.lib.ctx_decode_chunk(
+        ctx.ptr, c,
+        ctypes.c_void_p(packed_ptr), elem, code_bits,
+        _u8p(active), _u8p(sskip),
+        col_base, col_stride, col_elem,
+        ig_ptr, _u8p(want), _u8p(skip) if skip is not None else None,
+        n_threads,
+        _i64p(out_ptrs), _i64p(out_lens), ctypes.byref(tsec))
+    return _ChunkHandle(ctx, arena, out_ptrs, out_lens, skip, c,
+                        float(tsec.value), keep_alive)
+
+
+def decode_chunk_take(handle: _ChunkHandle) -> list:
+    """Blob strs from a decode_chunk_start handle; frees the arena.
+    triples[i] is (filter_json, score_json | None, finalscore_json |
+    None), or None where the skip mask was set."""
+    ctx = handle.ctx
+    peek = ctx.peek
+    skip = handle.skip
+    out_ptrs, out_lens = handle.out_ptrs, handle.out_lens
+    try:
+        triples: list = []
+        for i in range(handle.c):
+            if skip is not None and skip[i]:
+                triples.append(None)
+                continue
+            b = 3 * i
+            fj = peek(int(out_ptrs[b]), int(out_lens[b]))
+            sj = (peek(int(out_ptrs[b + 1]), int(out_lens[b + 1]))
+                  if out_ptrs[b + 1] else None)
+            fnj = (peek(int(out_ptrs[b + 2]), int(out_lens[b + 2]))
+                   if out_ptrs[b + 2] else None)
+            triples.append((fj, sj, fnj))
+    finally:
+        handle.discard()
+    return triples
+
+
+def decode_chunk_fused(ctx: _NativeCtx, rr, lo: int, hi: int,
+                       skip=None, n_threads: int | None = None):
+    """decode_chunk_start + decode_chunk_take in one call.
+
+    Returns (triples, native_thread_seconds)."""
+    handle = decode_chunk_start(ctx, rr, lo, hi, skip=skip,
+                                n_threads=n_threads)
+    return decode_chunk_take(handle), handle.thread_seconds
 
 
 def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
@@ -268,20 +449,7 @@ def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
 
     ignored_ptr = None
     if want_scores and ctx.has_tsp_score and rr.cw.host.get("tsp_ignore") is not None:
-        cache = getattr(rr, "_fused_ignored", None)
-        if cache is None or cache[0] != ci:
-            # double-checked under the recon lock: at a chunk boundary the
-            # pool's workers would otherwise all miss at once and each
-            # recompute the O(C*N) mask
-            with rr._recon_lock:
-                cache = getattr(rr, "_fused_ignored", None)
-                if cache is None or cache[0] != ci:
-                    c = packed.shape[0]
-                    ig = np.ascontiguousarray(
-                        rr._tsp_ignored_chunk(ci, c, rr.cw.n_nodes), np.uint8)
-                    cache = (ci, ig)
-                    rr._fused_ignored = cache
-        ig_row = cache[1][r]
+        ig_row = _tsp_ignored_cached(rr, ci, packed.shape[0])[r]
         ignored_ptr = _u8p(ig_row)
 
     out_blobs = (ctypes.c_void_p * 3)()

@@ -135,6 +135,22 @@ def update_result_history(pod: dict, result_set: dict[str, str],
     )
 
 
+def reflect_each(reflect_fn, items) -> None:
+    """reflect_fn(ns, name, uid=uid) for EVERY (ns, name, uid) item even
+    if an earlier one fails; the first error surfaces after the sweep —
+    the per-pod fallback contract shared by reflect_batch and the
+    engine's _ReflectBatcher (one place, so the wave-parity semantics
+    cannot drift between them)."""
+    first_err = None
+    for ns, name, uid in items:
+        try:
+            reflect_fn(ns, name, uid=uid)
+        except Exception as e:  # noqa: BLE001
+            first_err = first_err or e
+    if first_err is not None:
+        raise first_err
+
+
 class StoreReflector:
     def __init__(self, store: ObjectStore, sleep=None):
         self.store = store
@@ -304,16 +320,7 @@ class StoreReflector:
         stamp under the lock, and a concurrent wave's binds never queue
         behind a batch of record encodes."""
         if getattr(self.store, "apply_batch", None) is None:
-            # attempt every pod even if an earlier one fails (the
-            # engine's one-future-per-pod semantics); first error wins
-            first_err = None
-            for ns, name, uid in items:
-                try:
-                    self.reflect(ns, name, uid=uid)
-                except Exception as e:  # noqa: BLE001
-                    first_err = first_err or e
-            if first_err is not None:
-                raise first_err
+            reflect_each(self.reflect, items)
             return
         prepared: list[tuple] = []
         for ns, name, uid in items:

@@ -13,3 +13,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from kube_scheduler_simulator_tpu.utils.platform import force_cpu  # noqa: E402
 
 force_cpu(n_virtual_devices=8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running / tooling-heavy tests (excluded from tier-1, "
+        "which runs -m 'not slow'); e.g. the codec-suite-under-ASan run")

@@ -373,3 +373,107 @@ def test_watch_survives_last_unwatch_during_late_subscribe(api, monkeypatch):
     assert t1 == MODIFIED and rv1 == 1300
     c.unwatch("pods", q2)
     c.stop()
+
+
+def test_late_subscriber_dedup_uses_exact_rv_strings(api, monkeypatch):
+    """Late-subscriber handover dedup compares the server's EXACT
+    resourceVersion strings, not the synthesized _rv_int counters: with
+    non-integer rvs the counters are assigned in arrival order — the
+    listed snapshot's counters are minted AFTER the buffered events', so
+    every buffered event compared "older" and a legitimately NEWER update
+    (different rv string) was silently dropped (ADVICE round-5 #3).  A
+    buffered event whose rv EQUALS the listed object's is the very state
+    the snapshot carries and stays deduped; unlisted keys pass through."""
+    api.objects["pods"] = [_pod("pre", rv="rv-snapshot")]
+    api.watch_script["pods"] = queue.Queue()
+    c = KubeAPICluster(base_url=api.url)
+    q1 = c.watch("pods")
+    _drain(q1, 1)  # initial ADDED replay
+
+    # second subscriber: while its replay list is in flight, three events
+    # land in its handover buffer (the fan-out already carries it)
+    real_list = c._list_raw
+
+    def racing_list(resource, namespace=None, label_selector=None):
+        out = real_list(resource, namespace, label_selector)
+        c._fanout("pods", (c._rv_int("rv-mid"), MODIFIED,
+                           _pod("pre", rv="rv-mid")))       # pre-snapshot
+        c._fanout("pods", (c._rv_int("rv-snapshot"), MODIFIED,
+                           _pod("pre", rv="rv-snapshot")))  # = snapshot
+        c._fanout("pods", (c._rv_int("rv-newer"), MODIFIED,
+                           _pod("pre", rv="rv-newer")))     # newer update
+        c._fanout("pods", (c._rv_int("rv-ghost"), ADDED,
+                           _pod("ghost", rv="rv-ghost")))   # unlisted key
+        return out
+
+    monkeypatch.setattr(c, "_list_raw", racing_list)
+    q2 = c.watch("pods")
+    monkeypatch.setattr(c, "_list_raw", real_list)
+
+    got = _drain(q2, 3, timeout=5.0)
+    seen = [(t, o["metadata"]["name"], o["metadata"]["resourceVersion"])
+            for _, t, o in got]
+    assert (ADDED, "pre", "rv-snapshot") in seen        # the snapshot
+    assert (MODIFIED, "pre", "rv-newer") in seen        # NOT dropped
+    assert (ADDED, "ghost", "rv-ghost") in seen         # unlisted key
+    # the equal-rv buffered event was deduped against the snapshot, and
+    # so was the OLDER intermediate that preceded it in the buffer —
+    # re-delivering it would regress the subscriber behind the ADDED
+    assert (MODIFIED, "pre", "rv-snapshot") not in seen
+    assert (MODIFIED, "pre", "rv-mid") not in seen
+    c.unwatch("pods", q1)
+    c.unwatch("pods", q2)
+    c.stop()
+
+
+def test_late_subscriber_handover_delete_recreate_incarnations(api, monkeypatch):
+    """Delete+recreate racing the handover, discriminated by uid: events
+    of an incarnation OLDER than the listed object (different uid before
+    the listed one's DELETED) are dropped — their DELETED must not remove
+    the live object — while a post-list recreate (different uid AFTER the
+    listed incarnation's DELETED) is delivered, or the subscriber never
+    learns the new object exists."""
+    def _upod(name, rv, uid):
+        p = _pod(name, rv=rv)
+        p["metadata"]["uid"] = uid
+        return p
+
+    api.objects["pods"] = [_upod("pre", "rv-snapshot", "uid-A")]
+    api.watch_script["pods"] = queue.Queue()
+    c = KubeAPICluster(base_url=api.url)
+    q1 = c.watch("pods")
+    _drain(q1, 1)
+
+    from kube_scheduler_simulator_tpu.cluster.store import DELETED
+
+    real_list = c._list_raw
+
+    def racing_list(resource, namespace=None, label_selector=None):
+        out = real_list(resource, namespace, label_selector)
+        # an OLDER incarnation's tail (uid-Z predates the listed uid-A)
+        c._fanout("pods", (c._rv_int("rv-z1"), MODIFIED,
+                           _upod("pre", "rv-z1", "uid-Z")))
+        c._fanout("pods", (c._rv_int("rv-z2"), DELETED,
+                           _upod("pre", "rv-z2", "uid-Z")))
+        # the listed incarnation dies post-list, then a recreate
+        c._fanout("pods", (c._rv_int("rv-del"), DELETED,
+                           _upod("pre", "rv-del", "uid-A")))
+        c._fanout("pods", (c._rv_int("rv-new"), ADDED,
+                           _upod("pre", "rv-new", "uid-B")))
+        return out
+
+    monkeypatch.setattr(c, "_list_raw", racing_list)
+    q2 = c.watch("pods")
+    monkeypatch.setattr(c, "_list_raw", real_list)
+
+    got = _drain(q2, 3, timeout=5.0)
+    seen = [(t, o["metadata"]["uid"], o["metadata"]["resourceVersion"])
+            for _, t, o in got]
+    assert seen[0] == (ADDED, "uid-A", "rv-snapshot")      # the snapshot
+    assert (DELETED, "uid-A", "rv-del") in seen            # real deletion
+    assert (ADDED, "uid-B", "rv-new") in seen              # the recreate
+    # the older incarnation's events never reach the subscriber
+    assert not any(uid == "uid-Z" for _, uid, _rv in seen)
+    c.unwatch("pods", q1)
+    c.unwatch("pods", q2)
+    c.stop()
