@@ -51,6 +51,12 @@ test:
 bench:
 	$(PY) bench.py
 
+# gang-workload shape (docs/gang-scheduling.md): PodGroup co-scheduling
+# through the vectorized quorum pass, printing the gang_* counters so
+# BENCH rounds can track gang throughput
+bench-gang:
+	$(PY) bench.py --gang
+
 smoke:
 	$(PY) bench.py --smoke
 

@@ -468,13 +468,18 @@ def measure_replay(idx: int, scale: float, seed: int, chunk: int, mesh_n: int,
 
 
 def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
-                   interpod: bool = False, pipeline: bool = True):
+                   interpod: bool = False, pipeline: bool = True,
+                   gang_groups: int = 0, gang_members: int = 8):
     """Serving-path benchmark: ObjectStore -> SchedulerEngine.schedule_pending
     (compile -> replay -> decode -> commit, docs/wave-pipeline.md), with
     the tracer span breakdown.  interpod adds InterPodAffinity (the
     config-5 hard plugin) to the lineup and pod specs; pipeline=False
     forces the sequential post-pass commit (the pre-change baseline the
-    commit_stream_overlap_seconds counter is measured against)."""
+    commit_stream_overlap_seconds counter is measured against);
+    gang_groups > 0 mixes that many PodGroups of gang_members pods into
+    the queue with the Coscheduling plugin enabled, so the wave pays
+    (and reports) the vectorized gang-quorum pass
+    (docs/gang-scheduling.md)."""
     from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
     from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
     from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
@@ -482,16 +487,39 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
     from kube_scheduler_simulator_tpu.utils.tracing import TRACER
 
     nodes = make_nodes(scale_nodes, seed=seed, taint_fraction=0.1)
-    pods = make_pods(scale_pods, seed=seed + 1, with_affinity=True,
-                     with_tolerations=True, with_spread=True,
-                     with_interpod=interpod)
-    cfg = PluginSetConfig(enabled=[
+
+    def _queue():
+        pods = make_pods(scale_pods, seed=seed + 1, with_affinity=True,
+                         with_tolerations=True, with_spread=True,
+                         with_interpod=interpod)
+        if gang_groups:
+            from kube_scheduler_simulator_tpu.models.workloads import (
+                make_gang_workload)
+
+            pgs, gpods = make_gang_workload(gang_groups, gang_members,
+                                            seed=seed + 4)
+            return pods + gpods, pgs
+        return pods, []
+
+    pods, pgs = _queue()
+    custom = {}
+    enabled = [
         "NodeResourcesFit", "NodeResourcesBalancedAllocation", "NodeAffinity",
         "TaintToleration", "PodTopologySpread",
-    ] + (["InterPodAffinity"] if interpod else []))
+    ] + (["InterPodAffinity"] if interpod else [])
     store = ObjectStore()
+    if gang_groups:
+        from kube_scheduler_simulator_tpu.plugins.coscheduling import (
+            Coscheduling, ensure_podgroup_resource)
+
+        ensure_podgroup_resource(store)
+        custom["Coscheduling"] = Coscheduling()
+        enabled.append("Coscheduling")
+    cfg = PluginSetConfig(enabled=enabled, custom=custom)
     for n in nodes:
         store.create("nodes", n)
+    for pg in pgs:
+        store.create("podgroups", pg)
     for p in pods:
         store.create("pods", p)
     engine = SchedulerEngine(store, plugin_config=cfg, chunk=512,
@@ -507,9 +535,8 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
     for p in pods:
         meta = p["metadata"]
         store.delete("pods", meta["name"], meta.get("namespace"))
-    for p in make_pods(scale_pods, seed=seed + 1, with_affinity=True,
-                       with_tolerations=True, with_spread=True,
-                       with_interpod=interpod):
+    fresh, _ = _queue()
+    for p in fresh:
         store.create("pods", p)
     TRACER.reset()
     t0 = time.time()
@@ -527,6 +554,8 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
             "store_batch_writes_total", "store_batches_total",
             "replay_width_retries_total",
             "decode_chunk_calls_total", "decode_native_thread_seconds",
+            "gang_groups_admitted_total", "gang_quorum_rollbacks_total",
+            "gang_timeout_rejects_total", "gang_quorum_pass_seconds",
         ) if k in summary["counters"]
     }
     if counters.get("commit_stream_overlap_seconds"):
@@ -543,6 +572,84 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
             "cycles_per_sec": round(cps, 1),
             "spans": {k: round(v, 2) for k, v in spans.items()},
             "counters": {k: round(v, 3) for k, v in counters.items()}}
+
+
+def measure_gang(n_groups: int, members: int, scale_nodes: int, seed: int,
+                 plain_pods: int = 0, park_groups: int = 0,
+                 pipeline: bool = True):
+    """Gang-workload serving benchmark (make bench-gang,
+    docs/gang-scheduling.md): n_groups PodGroups of `members` pods
+    (minMember == members, strict all-or-nothing) admitted through the
+    vectorized quorum pass, optionally mixed with plain pods and
+    `park_groups` below-quorum groups (one member made infeasible) that
+    roll back to waiting.  Prints and returns the gang tracer counters
+    so BENCH rounds can track gang throughput."""
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.models.workloads import (
+        make_gang_workload, make_nodes, make_pods)
+    from kube_scheduler_simulator_tpu.plugins.coscheduling import (
+        Coscheduling, ensure_podgroup_resource)
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+    from kube_scheduler_simulator_tpu.utils.tracing import TRACER
+
+    def _build():
+        store = ObjectStore()
+        ensure_podgroup_resource(store)
+        for n in make_nodes(scale_nodes, seed=seed):
+            store.create("nodes", n)
+        pgs, pods = make_gang_workload(n_groups, members, seed=seed + 1)
+        if park_groups:
+            ppgs, ppods = make_gang_workload(
+                park_groups, members, seed=seed + 2, name_prefix="parked")
+            for p in ppods:
+                if p["metadata"]["name"].endswith("-member-000"):
+                    # one infeasible member keeps the group below quorum
+                    p["spec"]["containers"][0]["resources"]["requests"]["cpu"] \
+                        = "9999999m"
+            pgs += ppgs
+            pods += ppods
+        if plain_pods:
+            pods += make_pods(plain_pods, seed=seed + 3)
+        for pg in pgs:
+            store.create("podgroups", pg)
+        for p in pods:
+            store.create("pods", p)
+        cfg = PluginSetConfig(
+            enabled=["NodeResourcesFit", "NodeResourcesBalancedAllocation",
+                     "Coscheduling"],
+            custom={"Coscheduling": Coscheduling()},
+        )
+        return pods, SchedulerEngine(store, plugin_config=cfg, chunk=512,
+                                     pipeline_commit=pipeline)
+    log(f"gang path: {n_groups} gangs x {members} members "
+        f"(+{park_groups} below-quorum gangs, +{plain_pods} plain pods) "
+        f"on {scale_nodes} nodes")
+    _, warm = _build()
+    t0 = time.time()
+    warm.schedule_pending()  # warm: XLA-compiles the scan + quorum pass
+    log(f"  warm gang wave (incl XLA compile): {time.time()-t0:.1f}s")
+    warm.close()
+    pods, engine = _build()
+    TRACER.reset()
+    t0 = time.time()
+    bound = engine.schedule_pending()
+    total = time.time() - t0
+    summary = TRACER.summary()
+    counters = {k: round(v, 6) for k, v in summary["counters"].items()
+                if k.startswith("gang_")}
+    for k, v in sorted(counters.items()):
+        log(f"  {k}: {v}")
+    pods_per_sec = len(pods) / total if total else 0.0
+    log(f"  gang engine: bound {bound}/{len(pods)} in {total:.2f}s -> "
+        f"{pods_per_sec:,.0f} pods/s ({len(engine.gang_parked)} parked)")
+    return {
+        "groups": n_groups, "members": members, "nodes": scale_nodes,
+        "park_groups": park_groups, "plain_pods": plain_pods,
+        "bound": bound, "pods": len(pods), "parked": len(engine.gang_parked),
+        "pods_per_sec": round(pods_per_sec, 1),
+        "counters": counters,
+    }
 
 
 def _instrumented_compute_fraction(seq) -> float:
@@ -748,12 +855,26 @@ def main():
                          "(0: unsharded single-chip)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true", help="tiny sizes, fast")
+    ap.add_argument("--gang", action="store_true",
+                    help="run ONLY the gang-workload bench shape "
+                         "(make bench-gang) and print its counters")
     ap.add_argument("--skip-parity", action="store_true")
     ap.add_argument("--skip-config5", action="store_true")
     ap.add_argument("--skip-engine", action="store_true")
     ap.add_argument("--assume-fallback", action="store_true",
                     help=argparse.SUPPRESS)  # set by the crash re-exec
     args = ap.parse_args()
+    if args.gang:
+        # standalone gang shape (make bench-gang): no THP/forkserver
+        # machinery needed — the workload is far under the page cliff
+        fig = (measure_gang(8, 4, 32, args.seed, plain_pods=20,
+                            park_groups=2) if args.smoke else
+               measure_gang(100, 8, 500, args.seed, plain_pods=400,
+                            park_groups=10))
+        print(json.dumps({"metric": "gang_bench",
+                          "value": fig["pods_per_sec"],
+                          "unit": "pods/s", "extra": fig}))
+        return
     # THP for the malloc arenas (re-execs once, before anything heavy):
     # the annotation product is ~13 GB of live strings at full scale and
     # 4 KiB-page first-touch faults dominate past this host's ~8 GB

@@ -20,6 +20,8 @@ import copy
 import functools
 import time
 
+import numpy as np
+
 from .replay import replay
 from ..cluster.store import Conflict, NotFound, ObjectStore
 from ..utils.tracing import TRACER
@@ -90,6 +92,98 @@ class _ReflectBatcher:
             f.result()
 
 
+class _GangParked:
+    """A gang member parked by the vectorized quorum pass: its assumed
+    node (the speculative assignment rolled back to waiting), the
+    group it waits for, and the timeout that rejects the whole gang."""
+
+    __slots__ = ("ns", "name", "uid", "node", "group", "deadline",
+                 "timeout_str", "seq")
+
+    def __init__(self, ns, name, uid, node, group, deadline, timeout_str, seq):
+        self.ns = ns
+        self.name = name
+        self.uid = uid
+        self.node = node
+        self.group = group
+        self.deadline = deadline
+        self.timeout_str = timeout_str
+        self.seq = seq
+
+
+class _GangCtx:
+    """Per-wave gang state for the vectorized admission pass
+    (docs/gang-scheduling.md): the pod→group id vector the quorum
+    segment-reduction runs over, per-group specs, and the
+    waiting+bound counts frozen at wave start."""
+
+    __slots__ = ("gp_name", "keys", "gid", "min_member", "already",
+                 "timeout_s", "timeout_str", "start", "last",
+                 "admitted_before", "counted", "pending")
+
+    def __init__(self, gp_name: str, pending: list[dict], directory,
+                 parked_counts: dict):
+        import numpy as np
+
+        from .gang import group_key_of
+
+        self.gp_name = gp_name
+        self.pending = pending
+        self.keys: list[tuple[str, str]] = []
+        self.timeout_s: list[float] = []
+        self.timeout_str: list[str] = []
+        n = len(pending)
+        self.gid = np.full(n, -1, dtype=np.int32)
+        ids: dict[tuple[str, str], int] = {}
+        start: list[int] = []
+        last: list[int] = []
+        mins: list[int] = []
+        already: list[int] = []
+        for i, p in enumerate(pending):
+            key = group_key_of(p)
+            if key is None:
+                continue
+            spec = directory.specs.get(key)
+            if spec is None:
+                continue  # label without a PodGroup: ordinary pod
+            g = ids.get(key)
+            if g is None:
+                g = ids[key] = len(self.keys)
+                self.keys.append(key)
+                self.timeout_s.append(spec.timeout_seconds)
+                self.timeout_str.append(spec.timeout_str)
+                mins.append(spec.min_member)
+                already.append(parked_counts.get(key, 0)
+                               + directory.bound.get(key, 0))
+                start.append(i)
+                last.append(i)
+            self.gid[i] = g
+            last[g] = i
+        self.min_member = np.asarray(mins, dtype=np.int32)
+        self.already = np.asarray(already, dtype=np.int32)
+        self.start = np.asarray(start, dtype=np.int32)
+        self.last = np.asarray(last, dtype=np.int32)
+        self.admitted_before = [directory.bound.get(k, 0) > 0
+                                for k in self.keys]
+        self.counted: set[int] = set()
+
+    def __bool__(self) -> bool:
+        return bool(self.keys)
+
+
+class _NoGang:
+    """Falsy wave sentinel: the gang plugin is enabled and handled by
+    the engine this wave (so the custom-lifecycle set excludes it), but
+    no group has members in the wave — every commit path runs its
+    plain, gang-free code."""
+
+    def __bool__(self) -> bool:
+        return False
+
+
+_GANG_NONE = _NoGang()
+
+
 class _WaveCommitter:
     """Chunk-pipelined commit consumer for a streaming wave.
 
@@ -114,7 +208,8 @@ class _WaveCommitter:
     commit_and_reflect span covers only the post-replay tail (what the
     wave still serializes on)."""
 
-    def __init__(self, engine: "SchedulerEngine", node_names, pending):
+    def __init__(self, engine: "SchedulerEngine", node_names, pending,
+                 gang: "_GangCtx | None" = None):
         import queue
         import threading
 
@@ -123,6 +218,13 @@ class _WaveCommitter:
         self.pending = pending
         self.annotations: list = [None] * len(pending)
         self.n_bound = 0
+        # gang-atomic streaming (docs/gang-scheduling.md): commit ranges
+        # are cut on gang boundaries — a gang straddling the chunk edge
+        # defers to the next chunk's commit (or the wave's tail), so the
+        # quorum decision always sees the whole gang
+        self.gang = gang if gang else None
+        self._selected = (np.full(len(pending), -2, dtype=np.int32)
+                          if self.gang is not None else None)
         self._upto = 0          # pods [0, _upto) already committed
         self._busy: list[tuple[float, float]] = []
         self._exc: BaseException | None = None
@@ -196,6 +298,13 @@ class _WaveCommitter:
     def _commit(self, lo: int, hi: int, selected) -> None:
         if hi <= self._upto:
             return  # width-tier re-delivery of an already-committed chunk
+        if self.gang is not None:
+            self._selected[lo:hi] = selected
+            cut = self._gang_cut(hi)
+            if cut > self._upto:
+                self._commit_gang_range(self._upto, cut)
+                self._upto = cut
+            return
         eng = self.engine
         names = self.node_names
         put_decoded = eng.result_store.put_decoded
@@ -212,6 +321,59 @@ class _WaveCommitter:
         for (ns, name, _node), uid in zip(items, uids):
             self._reflects.submit(ns, name, uid)
         self._upto = hi
+
+    def _gang_cut(self, hi: int) -> int:
+        """Largest commit boundary <= hi that splits no gang: when the
+        pods on either side of hi share a group (gangs are contiguous
+        in pending order), pull the cut back to the group's first
+        index so the straddling gang commits whole with the next
+        chunk."""
+        gid = self.gang.gid
+        if hi >= len(self.pending):
+            return len(self.pending)
+        g = int(gid[hi])
+        if g >= 0 and gid[hi - 1] == g:
+            return int(self.gang.start[g])
+        return hi
+
+    def _commit_gang_range(self, lo: int, hi: int) -> None:
+        """Gang-atomic commit of pending[lo:hi) (every gang inside is
+        whole): the vectorized quorum pass decides allow/park per
+        group; admitted members bind in pod order (parked siblings
+        released right after the group's last wave member), below-
+        quorum members park instead of binding — the same ordering
+        rules as the sequential post-pass, so the parity gate holds."""
+        eng = self.engine
+        gang = self.gang
+        names = self.node_names
+        put_decoded = eng.result_store.put_decoded
+        admit, wait_mask = eng._gang_decide(gang, self._selected, lo, hi)
+        items: list[tuple[str, str, str | None]] = []
+        uids: list[str | None] = []
+        for i in range(lo, hi):
+            meta = self.pending[i].get("metadata") or {}
+            ns, name = meta.get("namespace") or "default", meta.get("name", "")
+            put_decoded(ns, name, self.annotations[i])
+            sel = int(self._selected[i])
+            g = int(gang.gid[i])
+            parked = False
+            if g >= 0 and sel >= 0:
+                if admit[g]:
+                    eng._gang_record_permit(gang, ns, name, g,
+                                            waited=bool(wait_mask[i - lo]))
+                else:
+                    eng._gang_park(gang, self.pending[i], g, names[sel])
+                    parked = True
+            if not parked:
+                items.append((ns, name, names[sel] if sel >= 0 else None))
+                uids.append(meta.get("uid"))
+            if g >= 0 and i == int(gang.last[g]) and admit[g]:
+                for rec in eng._gang_take_parked(gang.keys[g]):
+                    items.append((rec.ns, rec.name, rec.node))
+                    uids.append(rec.uid)
+        self.n_bound += eng._commit_pod_batch(items)
+        for (ns, name, _node), uid in zip(items, uids):
+            self._reflects.submit(ns, name, uid)
 
 
 class SchedulerEngine:
@@ -251,6 +413,17 @@ class SchedulerEngine:
         # pods parked by Permit "wait" (upstream waitingPods map analogue),
         # keyed (namespace, name); external threads may allow()/reject()
         self.waiting_pods: dict[tuple[str, str], "WaitingPod"] = {}
+        # gang scheduling (docs/gang-scheduling.md): members parked by
+        # the vectorized quorum pass, keyed (ns, name); each also holds
+        # a WaitingPod handle in waiting_pods so pending_pods skips it.
+        # Resolution is quorum completion (a later wave binds the gang
+        # at the assumed nodes), scheduleTimeoutSeconds expiry (the
+        # whole gang rejects), or a PodGroup update (reconciled at the
+        # next schedule_pending)
+        self.gang_parked: dict[tuple[str, str], _GangParked] = {}
+        self._gang_wave: _GangCtx | None = None  # vectorized-mode wave ctx
+        self._gang_dir = None                    # per-wave GangDirectory
+        self._gang_seq = 0                       # park FIFO order
         # async waiter bookkeeping: one daemon thread per parked pod
         # finishes its binding cycle on resolution (upstream's binding
         # cycle goroutine blocking in WaitOnPermit)
@@ -389,23 +562,26 @@ class SchedulerEngine:
             self._pending_idx.close()
             self._pending_idx = None
         pods = self._list_shared("pods")
-        pending = [
-            p for p in pods
-            if not ((p.get("spec") or {}).get("nodeName"))
-            and ((p.get("metadata") or {}).get("namespace") or "default",
-                 (p.get("metadata") or {}).get("name", "")) not in self.waiting_pods
+        unbound = [
+            p for p in pods if not ((p.get("spec") or {}).get("nodeName"))
         ]
         if qs is not None:
+            pending = [
+                p for p in unbound
+                if ((p.get("metadata") or {}).get("namespace") or "default",
+                    (p.get("metadata") or {}).get("name", ""))
+                not in self.waiting_pods
+            ]
             pending.sort(key=functools.cmp_to_key(
                 lambda a, b: -1 if qs.less(a, b) else (1 if qs.less(b, a) else 0)))
             return pending
-        # PrioritySort: priority desc, FIFO (resourceVersion) within —
-        # the SAME key function the incremental index orders by, so the
-        # two paths cannot drift
-        from .pending import _sort_key
+        # PrioritySort with gang-contiguous grouping: the SAME composite
+        # key the incremental index orders by, so the two paths cannot
+        # drift (group min keys count parked members, hence the
+        # unfiltered unbound list)
+        from .pending import gang_sorted
 
-        pending.sort(key=_sort_key)
-        return pending
+        return gang_sorted(unbound, skip=self.waiting_pods)
 
     def close(self) -> None:
         """Release engine-held resources: the pending index's watch
@@ -465,8 +641,16 @@ class SchedulerEngine:
         Pods parked by Permit "wait" do NOT stall the wave: their binding
         cycle finishes on a waiter thread when allowed/rejected/timed out
         (upstream runs binding cycles as goroutines), and this call drains
-        all waiters before returning so the result is settled."""
-        n_bound = 0
+        all waiters before returning so the result is settled.
+
+        Gang members parked by the vectorized quorum pass are the
+        exception: they hold NO thread and survive across calls (their
+        gang may complete in a later call's wave); expired ones are
+        timeout-rejected — whole gangs at a time — at the top of every
+        call (docs/gang-scheduling.md)."""
+        n_bound = self._gang_maintain()
+        if n_bound:
+            TRACER.count("pods_scheduled_total", n_bound)
         rejected: set[tuple[str, str]] = set()
         max_waves = 8 + len(self.pending_pods())
         for _ in range(max_waves):
@@ -582,10 +766,23 @@ class SchedulerEngine:
         nodes = self._list_shared("nodes")
         self._wave_node_count = len(nodes)
         pods_all = self._list_shared("pods")
+        self._gang_wave = None
+        gp = self._gang_plugin()
+        gang_dir = None
+        if gp is not None:
+            pending, gang_dir = self._gang_prescreen(pending, gp, pods_all,
+                                                     nodes)
+            if not pending:
+                return 0, None
         bound = [
             (p, p["spec"]["nodeName"]) for p in pods_all
             if (p.get("spec") or {}).get("nodeName")
         ]
+        if self.gang_parked:
+            # parked gang members keep their speculative assignments as
+            # assumed binds: their resources stay reserved while the
+            # gang waits for quorum (docs/gang-scheduling.md)
+            bound += self._gang_assumed_bound()
         # volume manifests for the VolumeBinding/Zone/Restrictions/Limits
         # family; CSINode is not one of the simulator's 7 synced GVRs
         # (reference: recorder/recorder.go:45-53), so limits come only from
@@ -605,7 +802,19 @@ class SchedulerEngine:
             )
             self._last_cw = NodeTableReuse(cw)
         if self._needs_host_path():
+            # gangs route through the per-pod Permit machinery here
+            # (the Coscheduling plugin stays in the lifecycle set)
             return self._schedule_host_path(cw, pending)
+
+        if gp is not None and self._gang_vectorized():
+            # setting the wave ctx removes the gang plugin from the
+            # custom-lifecycle set: the quorum pass below replaces its
+            # per-pod Permit calls on both batched commit paths (the
+            # falsy sentinel keeps gang-free waves on the plain code)
+            ctx = (_GangCtx(gp.name, pending, gang_dir,
+                            self._gang_parked_counts())
+                   if gang_dir is not None else None)
+            self._gang_wave = ctx if ctx else _GANG_NONE
 
         # a live cluster's node count need not divide the mesh's "nodes"
         # extent; shard only waves where it does and run the rest
@@ -665,7 +874,8 @@ class SchedulerEngine:
             # submissions, pod order preserved) while the device scans
             # later chunks — instead of the whole wave idling through a
             # sequential post-pass after the replay drains
-            committer = _WaveCommitter(self, cw.node_table.names, pending)
+            committer = _WaveCommitter(self, cw.node_table.names, pending,
+                                       gang=self._gang_wave)
             try:
                 with TRACER.span("replay_and_decode_stream",
                                  pods=len(pending), nodes=len(nodes)):
@@ -720,6 +930,14 @@ class SchedulerEngine:
 
         emap = self._extenders_map()
         has_lc = bool(self._custom_lifecycle_plugins())
+        gang = self._gang_wave if self._gang_wave else None
+        gang_admit = gang_wait = None
+        if gang is not None:
+            # gang-atomic commit: one vectorized quorum pass over the
+            # whole wave decides allow/park per group before any write
+            gang_admit, gang_wait = self._gang_decide(
+                gang, np.asarray(rr.selected, dtype=np.int32), 0,
+                len(pending))
         with TRACER.span("commit_and_reflect", pods=len(pending)):
             for i, pod in enumerate(pending):
                 meta = pod.get("metadata") or {}
@@ -733,6 +951,18 @@ class SchedulerEngine:
                     for hook in emap.values():
                         hook.after_cycle(priv, annotations, self.result_store)
                 sel = int(rr.selected[i])
+                g = int(gang.gid[i]) if gang is not None else -1
+                if g >= 0 and sel >= 0:
+                    if gang_admit[g]:
+                        self._gang_record_permit(gang, ns, name, g,
+                                                 waited=bool(gang_wait[i]))
+                    else:
+                        # below quorum: the speculative assignment rolls
+                        # back to waiting — no bind, no status write, no
+                        # reflect until the gang resolves
+                        self._gang_park(gang, pod, g,
+                                        cw.node_table.names[sel])
+                        continue
                 if sel >= 0:
                     lc = self._run_custom_lifecycle(
                         priv, ns, name, cw.node_table.names[sel],
@@ -771,6 +1001,15 @@ class SchedulerEngine:
                             retry = "preempted"
                     self._mark_unschedulable(ns, name)
                 reflects.submit(ns, name, meta.get("uid"))
+                if g >= 0 and i == int(gang.last[g]) and gang_admit[g]:
+                    # the group's last wave member landed: release its
+                    # parked members (earlier waves) at their assumed
+                    # nodes, in park order — the same position the
+                    # streaming committer releases them at
+                    for rec in self._gang_take_parked(gang.keys[g]):
+                        self._bind(rec.ns, rec.name, rec.node)
+                        n_bound += 1
+                        reflects.submit(rec.ns, rec.name, rec.uid)
             reflects.drain()
         return n_bound, retry
 
@@ -786,10 +1025,259 @@ class SchedulerEngine:
         return pool
 
     def _custom_lifecycle_plugins(self) -> list:
-        return [
+        plugins = [
             p for n, p in self.plugin_config.custom.items()
             if n in self.plugin_config.enabled and getattr(p, "has_lifecycle", False)
         ]
+        if self._gang_wave is not None:
+            # the vectorized quorum pass replaces the gang plugin's
+            # per-pod Permit calls for this wave (docs/gang-scheduling.md)
+            plugins = [p for p in plugins
+                       if not getattr(p, "is_gang_plugin", False)]
+        return plugins
+
+    # ------------------------------------------------------------ gangs
+
+    def _gang_plugin(self):
+        """The enabled gang-admission (Coscheduling) plugin, attached to
+        this engine, or None."""
+        cfg = self.plugin_config
+        for n in cfg.enabled:
+            if n in cfg.custom:
+                p = cfg.custom[n]
+                if getattr(p, "is_gang_plugin", False):
+                    attach = getattr(p, "attach", None)
+                    if attach is not None and getattr(p, "_engine", None) is not self:
+                        attach(self)
+                    return p
+        return None
+
+    def _gang_vectorized(self) -> bool:
+        """True when gang admission can use the vectorized quorum pass:
+        the gang plugin is the ONLY enabled custom lifecycle plugin and
+        the queue keeps the default PrioritySort order.  Any other
+        lifecycle plugin — or a custom QueueSort, whose arbitrary
+        less() defeats the gang-contiguity invariant the pass and the
+        streaming cuts rely on — routes gangs through the per-pod
+        Permit machinery instead (fallback matrix in
+        docs/gang-scheduling.md)."""
+        cfg = self.plugin_config
+        for n, p in cfg.custom.items():
+            if (n in cfg.enabled and getattr(p, "has_lifecycle", False)
+                    and not getattr(p, "is_gang_plugin", False)):
+                return False
+        try:
+            return self._queue_sort_plugin() is None
+        except ValueError:
+            return False  # invalid multi-QueueSort config: stay safe
+
+    def _gang_parked_counts(self) -> dict[tuple[str, str], int]:
+        counts: dict[tuple[str, str], int] = {}
+        for rec in self.gang_parked.values():
+            counts[rec.group] = counts.get(rec.group, 0) + 1
+        return counts
+
+    def _gang_assumed_bound(self) -> list[tuple[dict, str]]:
+        """Parked members' speculative assignments as assumed binds for
+        compile_workload's bound_pods: their resources stay reserved
+        while the gang waits for quorum — the upstream assumed-pod
+        state a WaitOnPermit parker holds in the scheduler cache."""
+        out: list[tuple[dict, str]] = []
+        for (ns, name), rec in list(self.gang_parked.items()):
+            try:
+                pod = self.store.get("pods", name, ns, copy_object=False)
+            except NotFound:
+                continue
+            except TypeError:  # store without the no-copy fast path
+                try:
+                    pod = self.store.get("pods", name, ns)
+                except NotFound:
+                    continue
+            out.append((pod, rec.node))
+        return out
+
+    def _gang_take_parked(self, group_key: tuple[str, str]) -> list[_GangParked]:
+        """Pop every parked member of group_key in park (FIFO) order."""
+        recs = [r for r in self.gang_parked.values() if r.group == group_key]
+        recs.sort(key=lambda r: r.seq)
+        for r in recs:
+            self.gang_parked.pop((r.ns, r.name), None)
+            self.waiting_pods.pop((r.ns, r.name), None)
+        return recs
+
+    def _gang_park(self, ctx: _GangCtx, pod: dict, g: int, node: str) -> None:
+        """Roll a below-quorum member's speculative assignment back to
+        waiting: permit-result "wait" is recorded (reflected at
+        resolution), the pod parks in waiting_pods (so pending_pods
+        skips it) and gang_parked keeps the assumed node + deadline.
+        No store write happens until the gang resolves."""
+        from .waiting import WaitingPod
+
+        meta = pod.get("metadata") or {}
+        ns, name = meta.get("namespace") or "default", meta.get("name", "")
+        self.result_store.add_permit_result(
+            ns, name, ctx.gp_name, ann.WAIT_MESSAGE, ctx.timeout_str[g])
+        key = (ns, name)
+        self.waiting_pods[key] = WaitingPod(pod, {ctx.gp_name: ctx.timeout_s[g]})
+        self._gang_seq += 1
+        self.gang_parked[key] = _GangParked(
+            ns, name, meta.get("uid"), node, ctx.keys[g],
+            deadline=time.monotonic() + ctx.timeout_s[g],
+            timeout_str=ctx.timeout_str[g], seq=self._gang_seq)
+
+    def _gang_record_permit(self, ctx: _GangCtx, ns: str, name: str, g: int,
+                            waited: bool) -> None:
+        """Permit record for an admitted member: "wait" (+ the group
+        timeout) for members whose rank was below quorum when they
+        reached Permit — the ones a group-wide allow() released —
+        "success" for the quorum-completing member and every later one."""
+        if waited:
+            self.result_store.add_permit_result(
+                ns, name, ctx.gp_name, ann.WAIT_MESSAGE, ctx.timeout_str[g])
+        else:
+            self.result_store.add_permit_result(
+                ns, name, ctx.gp_name, ann.SUCCESS_MESSAGE, "0s")
+
+    def _gang_decide(self, ctx: _GangCtx, selected, lo: int, hi: int):
+        """The vectorized gang-quorum pass over pending[lo:hi) (gangs
+        inside are whole): ONE jnp segment-reduction computes per-group
+        placed-member counts and the allow/park decision — no per-pod
+        Python loop.  Returns (admit [G] bool, wait_mask [hi-lo] bool)
+        and maintains the gang tracer counters."""
+        from .gang import quorum_slice
+
+        t0 = time.perf_counter()
+        admit, wave_counts, wait_mask = quorum_slice(
+            ctx.gid[lo:hi], np.asarray(selected[lo:hi], dtype=np.int32),
+            ctx.already, ctx.min_member)
+        TRACER.count("gang_quorum_pass_seconds",
+                     round(time.perf_counter() - t0, 6))
+        for g in np.unique(ctx.gid[lo:hi]):
+            g = int(g)
+            if g < 0:
+                continue
+            if admit[g]:
+                if not ctx.admitted_before[g] and g not in ctx.counted:
+                    ctx.counted.add(g)
+                    TRACER.count("gang_groups_admitted_total")
+            elif int(wave_counts[g]) > 0:
+                TRACER.count("gang_quorum_rollbacks_total")
+        return admit, wait_mask
+
+    def _gang_prescreen(self, pending: list[dict], gp, pods_all: list[dict],
+                        nodes: list[dict]):
+        """The Coscheduling PreFilter: reject members whose group can
+        never reach quorum from current cluster state (fewer than
+        minMember member pods exist, or minResources exceeds free
+        cluster capacity) — recorded under prefilter-result-status like
+        an in-tree PreFilter rejection, before the wave compiles.
+        Returns (surviving pending, GangDirectory or None)."""
+        from .gang import GangDirectory, group_key_of
+
+        directory = GangDirectory(self.store)
+        if not directory:
+            return pending, None
+        directory.scan_members(pods_all)
+        free_cache: dict = {}
+
+        def free_fn():
+            if "v" not in free_cache:
+                free_cache["v"] = self._cluster_free(nodes, pods_all)
+            return free_cache["v"]
+
+        keep: list[dict] = []
+        for p in pending:
+            key = group_key_of(p)
+            msg = directory.prefilter_reason(key, free_fn) if key else None
+            if msg is None:
+                keep.append(p)
+                continue
+            meta = p.get("metadata") or {}
+            ns, name = meta.get("namespace") or "default", meta.get("name", "")
+            self.result_store.add_pre_filter_result(ns, name, gp.name, msg)
+            self._mark_unschedulable(ns, name)
+            self.reflector.reflect(ns, name, uid=meta.get("uid"))
+        return keep, directory
+
+    @staticmethod
+    def _cluster_free(nodes: list[dict], pods_all: list[dict]) -> dict:
+        """Cluster-wide free capacity (allocatable minus bound
+        requests) for the minResources PreFilter check — a documented
+        simplification of the upstream coscheduling quota check."""
+        from ..utils.quantity import parse_cpu_milli, parse_memory_bytes
+
+        cpu = mem = 0
+        for n in nodes:
+            alloc = (n.get("status") or {}).get("allocatable") or {}
+            cpu += parse_cpu_milli(alloc.get("cpu") or 0)
+            mem += parse_memory_bytes(alloc.get("memory") or 0)
+        for p in pods_all:
+            if not ((p.get("spec") or {}).get("nodeName")):
+                continue
+            for c in (p.get("spec") or {}).get("containers") or []:
+                req = ((c.get("resources") or {}).get("requests")) or {}
+                cpu -= parse_cpu_milli(req.get("cpu") or 0)
+                mem -= parse_memory_bytes(req.get("memory") or 0)
+        return {"cpu": cpu, "memory": mem}
+
+    def _gang_maintain(self) -> int:
+        """Cross-call gang housekeeping, run at the top of every
+        schedule_pending: timeout expiry rejects whole gangs (the
+        deterministic trigger member — earliest deadline, then
+        (ns, name) — records "timeout", siblings record the gang
+        rejection), then parked groups whose quorum is already
+        satisfied by waiting+bound members alone (e.g. a PodGroup
+        minMember update) bind at their assumed nodes, and parked
+        members whose PodGroup vanished are released back to the
+        queue as ordinary pods.  Returns #bound."""
+        if not self.gang_parked:
+            return 0
+        gp = self._gang_plugin()
+        pname = gp.name if gp is not None else "Coscheduling"
+        now = time.monotonic()
+        triggers: dict[tuple[str, str], _GangParked] = {}
+        for rec in self.gang_parked.values():
+            if rec.deadline <= now:
+                cur = triggers.get(rec.group)
+                if cur is None or ((rec.deadline, rec.ns, rec.name)
+                                   < (cur.deadline, cur.ns, cur.name)):
+                    triggers[rec.group] = rec
+        for gkey in sorted(triggers):
+            t = triggers[gkey]
+            for rec in self._gang_take_parked(gkey):
+                msg = ("timeout" if rec is t else
+                       f'rejected: gang "{gkey[0]}/{gkey[1]}" timed out '
+                       "before reaching quorum")
+                self.result_store.add_permit_result(
+                    rec.ns, rec.name, pname, msg, rec.timeout_str)
+                self._mark_unschedulable(rec.ns, rec.name,
+                                         fresh_node_count=True)
+                self.reflector.reflect(rec.ns, rec.name, uid=rec.uid)
+            TRACER.count("gang_timeout_rejects_total")
+        bound = 0
+        if self.gang_parked:
+            from .gang import GangDirectory
+
+            directory = GangDirectory(self.store)
+            directory.scan_members(self._list_shared("pods"))
+            parked_counts = self._gang_parked_counts()
+            for gkey in sorted({r.group for r in self.gang_parked.values()}):
+                spec = directory.specs.get(gkey)
+                if spec is None:
+                    # PodGroup deleted while members waited: release the
+                    # park — the members reschedule as ordinary pods
+                    for rec in self._gang_take_parked(gkey):
+                        self.result_store.delete_data(
+                            {"metadata": {"namespace": rec.ns,
+                                          "name": rec.name}})
+                    continue
+                if (parked_counts.get(gkey, 0)
+                        + directory.bound.get(gkey, 0)) >= spec.min_member:
+                    for rec in self._gang_take_parked(gkey):
+                        self._bind(rec.ns, rec.name, rec.node)
+                        self.reflector.reflect(rec.ns, rec.name, uid=rec.uid)
+                        bound += 1
+        return bound
 
     def _run_custom_lifecycle(self, pod, ns: str, name: str, node_name: str,
                               allow_async: bool = False,

@@ -246,6 +246,13 @@ class Preemptor:
         except KeyError:
             self._pdbs = []
         self._namespaces = _shared("namespaces")
+        # gang quorum guard (docs/gang-scheduling.md): bound PodGroup
+        # members whose eviction would drop a running group below its
+        # minMember are never preemption victims
+        from .gang import GangDirectory, preemption_protected
+
+        self._gang_protected = preemption_protected(
+            self._pods_all, GangDirectory(self.store))
         evaluated = [n for n, _ in failed]
         out = PreemptionOutcome(evaluated_nodes=evaluated)
 
@@ -374,7 +381,11 @@ class Preemptor:
         the violating ones FIRST (so budget-covered pods are preferred as
         the ones actually evicted), and count the violating pods that
         could not be reprieved."""
-        lower = [p for p in node_pods if _priority(p) < pod_prio]
+        lower = [
+            p for p in node_pods
+            if _priority(p) < pod_prio
+            and _pod_key(p) not in self._gang_protected
+        ]
         all_removed = frozenset(_pod_key(p) for p in lower)
         if not self._fits(pod, node, all_removed):
             return None

@@ -45,13 +45,24 @@ class WaitingPod:
             self._cv.notify_all()
 
     def reject(self, plugin_name: str, msg: str = "rejected") -> None:
+        """First rejection wins (a concurrent second reject cannot
+        change the recorded plugin/message); pending deadlines are
+        cleared so the handle reads as settled afterwards."""
         with self._cv:
-            self._rejected = (plugin_name, msg)
+            if self._rejected is None:
+                self._rejected = (plugin_name, msg)
+            self._deadlines.clear()
             self._cv.notify_all()
 
     def wait(self) -> tuple[str, str] | None:
         """Block until resolved. None == allowed by everyone; otherwise
-        (plugin, message) for an explicit reject or a timeout expiry."""
+        (plugin, message) for an explicit reject or a timeout expiry.
+
+        Timeout selection is deterministic — earliest deadline, then
+        plugin name — so the plugin recorded into permit-result-timeout
+        is reproducible regardless of dict iteration order; the expiry
+        settles the handle (a second wait() returns the same
+        rejection)."""
         with self._cv:
             while True:
                 if self._rejected is not None:
@@ -59,8 +70,11 @@ class WaitingPod:
                 if not self._deadlines:
                     return None
                 now = time.monotonic()
-                expired = [p for p, d in self._deadlines.items() if d <= now]
+                expired = sorted(
+                    (d, p) for p, d in self._deadlines.items() if d <= now)
                 if expired:
                     # upstream: timeout rejects the waiting pod
-                    return (expired[0], "timeout")
+                    self._rejected = (expired[0][1], "timeout")
+                    self._deadlines.clear()
+                    return self._rejected
                 self._cv.wait(timeout=min(self._deadlines.values()) - now)

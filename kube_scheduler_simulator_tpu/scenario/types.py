@@ -14,7 +14,10 @@ STEP_CONTROLLER_RUNNING = "ControllerRunning"
 STEP_CONTROLLER_COMPLETED = "ControllerCompleted"
 STEP_COMPLETED = "Finished"
 
-# resource-kind mapping for operation objects (kind -> store resource)
+# resource-kind mapping for operation objects (kind -> store resource).
+# PodGroup rides the generic-GVR registration (framework/gang.py
+# ensure_podgroup_resource / config extraResources) — scenarios can
+# create gangs directly (docs/gang-scheduling.md).
 KIND_TO_RESOURCE = {
     "Namespace": "namespaces",
     "PriorityClass": "priorityclasses",
@@ -23,4 +26,5 @@ KIND_TO_RESOURCE = {
     "Node": "nodes",
     "PersistentVolume": "persistentvolumes",
     "Pod": "pods",
+    "PodGroup": "podgroups",
 }

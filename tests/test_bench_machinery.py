@@ -150,3 +150,23 @@ def test_measure_engine_reports_pipeline_spans():
     r_seq = bench.measure_engine(24, 6, seed=0, pipeline=False)
     assert r_seq["bound"] == r["bound"]
     assert "commit_stream_waves_total" not in r_seq["counters"]
+
+
+def test_measure_engine_reports_gang_counters():
+    """With gang_groups mixed into the queue, measure_engine reports the
+    vectorized quorum pass (gang_quorum_pass_seconds) and admission
+    counters alongside the wave-pipeline ones (docs/gang-scheduling.md)."""
+    r = bench.measure_engine(16, 6, seed=0, gang_groups=3, gang_members=4)
+    assert r["bound"] > 0
+    assert r["counters"].get("gang_quorum_pass_seconds", 0) > 0
+    assert r["counters"].get("gang_groups_admitted_total", 0) >= 1
+
+
+def test_measure_gang_shape_reports_counters():
+    """The make bench-gang entry: admitted + rolled-back groups both
+    show up in the counters, and parked members are reported."""
+    r = bench.measure_gang(3, 3, 8, seed=0, plain_pods=4, park_groups=1)
+    assert r["counters"].get("gang_groups_admitted_total") == 3
+    assert r["counters"].get("gang_quorum_rollbacks_total", 0) >= 1
+    assert r["parked"] == 2
+    assert r["bound"] == 3 * 3 + 4

@@ -1,0 +1,701 @@
+"""Gang scheduling: PodGroup co-scheduling with vectorized
+all-or-nothing admission (docs/gang-scheduling.md).
+
+End-to-end semantics under test: with a PodGroup of minMember=k, fewer
+than k feasible members ⇒ ZERO binds (members parked in
+engine.waiting_pods, then timeout-rejected with the recorder-shaped
+permit-result / permit-result-timeout annotations); ≥ k feasible
+members ⇒ every feasible member binds in the same wave epoch — under
+BOTH pipeline_commit=True (gang-boundary streaming cuts) and False
+(sequential post-pass), with the quorum computed by the vectorized
+segment-reduction (framework/gang.py quorum_slice)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+from kube_scheduler_simulator_tpu.framework.gang import (
+    POD_GROUP_LABEL,
+    GangDirectory,
+    group_key_of,
+    quorum_slice,
+)
+from kube_scheduler_simulator_tpu.models.workloads import (
+    make_gang_workload,
+    make_nodes,
+    make_pods,
+)
+from kube_scheduler_simulator_tpu.plugins.coscheduling import (
+    Coscheduling,
+    ensure_podgroup_resource,
+)
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.store import annotations as ann
+
+
+def _store(n_nodes=4, seed=1):
+    store = ObjectStore()
+    ensure_podgroup_resource(store)
+    for n in make_nodes(n_nodes, seed=seed):
+        store.create("nodes", n)
+    return store
+
+
+def _engine(store, pipeline=True, extra_plugins=(), chunk=512):
+    plugins = {"Coscheduling": Coscheduling()}
+    enabled = ["NodeResourcesFit", "Coscheduling"]
+    for p in extra_plugins:
+        plugins[p.name] = p
+        enabled.append(p.name)
+    cfg = PluginSetConfig(enabled=enabled, custom=plugins)
+    return SchedulerEngine(store, plugin_config=cfg, chunk=chunk,
+                           pipeline_commit=pipeline)
+
+
+def _annos(store, name, namespace="default"):
+    return store.get("pods", name, namespace)["metadata"].get("annotations") or {}
+
+
+def _gang(store, members=3, min_member=None, timeout=30, infeasible=(),
+          name_prefix="gang"):
+    pgs, pods = make_gang_workload(1, members, min_member=min_member,
+                                   seed=2, timeout_seconds=timeout,
+                                   name_prefix=name_prefix)
+    for i in infeasible:
+        pods[i]["spec"]["containers"][0]["resources"]["requests"]["cpu"] = \
+            "9999999m"
+    for pg in pgs:
+        store.create("podgroups", pg)
+    for p in pods:
+        store.create("pods", p)
+    return [p["metadata"]["name"] for p in pods]
+
+
+# --------------------------------------------------------------- admission
+
+
+def test_full_gang_binds_in_one_wave_with_permit_records():
+    store = _store()
+    names = _gang(store, members=3)
+    engine = _engine(store)
+    assert engine.schedule_pending() == 3
+    statuses = {}
+    for nm in names:
+        pod = store.get("pods", nm)
+        assert pod["spec"].get("nodeName"), nm
+        a = pod["metadata"]["annotations"]
+        statuses[nm] = (json.loads(a[ann.PERMIT_STATUS_RESULT]),
+                        json.loads(a[ann.PERMIT_TIMEOUT_RESULT]))
+    # members below quorum rank record "wait" (parked, then group-wide
+    # allow); the quorum-completing member records "success"
+    assert statuses[names[0]] == ({"Coscheduling": "wait"},
+                                  {"Coscheduling": "30s"})
+    assert statuses[names[1]] == ({"Coscheduling": "wait"},
+                                  {"Coscheduling": "30s"})
+    assert statuses[names[2]] == ({"Coscheduling": "success"},
+                                  {"Coscheduling": "0s"})
+    assert engine.waiting_pods == {} and engine.gang_parked == {}
+
+
+def test_below_quorum_parks_all_members_zero_binds():
+    store = _store()
+    names = _gang(store, members=3, infeasible=(2,))
+    engine = _engine(store)
+    assert engine.schedule_pending() == 0
+    for p in store.list("pods")[0]:
+        assert not p["spec"].get("nodeName"), p["metadata"]["name"]
+    # the two feasible members rolled back to waiting; the infeasible
+    # one went unschedulable through the normal path
+    parked = sorted(k[1] for k in engine.gang_parked)
+    assert parked == [names[0], names[1]]
+    assert sorted(k[1] for k in engine.waiting_pods) == parked
+    # parked pods have NO store write yet (no PodScheduled condition)
+    for nm in parked:
+        assert not (store.get("pods", nm).get("status") or {}).get("conditions")
+
+
+def test_quorum_completes_across_waves_at_assumed_nodes():
+    store = _store()
+    names = _gang(store, members=3, infeasible=(2,))
+    engine = _engine(store)
+    assert engine.schedule_pending() == 0
+    assumed = {(r.ns, r.name): r.node for r in engine.gang_parked.values()}
+    # fix the infeasible member: delete + recreate with a small request
+    bad = names[2]
+    pod = store.get("pods", bad)
+    store.delete("pods", bad, "default")
+    pod["metadata"].pop("resourceVersion", None)
+    pod["metadata"].pop("uid", None)
+    pod["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "100m"
+    store.create("pods", pod)
+    assert engine.schedule_pending() == 3
+    for nm in names:
+        assert store.get("pods", nm)["spec"].get("nodeName"), nm
+    # the parked members bound exactly at their assumed nodes
+    for (ns, nm), node in assumed.items():
+        assert store.get("pods", nm, ns)["spec"]["nodeName"] == node
+    assert engine.gang_parked == {} and engine.waiting_pods == {}
+
+
+def test_timeout_rejects_whole_gang_with_annotations():
+    store = _store()
+    names = _gang(store, members=3, timeout=0.15, infeasible=(2,))
+    engine = _engine(store)
+    assert engine.schedule_pending() == 0
+    assert len(engine.gang_parked) == 2
+    time.sleep(0.25)
+    engine._gang_maintain()  # what the next schedule_pending runs first
+    assert engine.gang_parked == {} and engine.waiting_pods == {}
+    from kube_scheduler_simulator_tpu.utils.tracing import TRACER
+
+    assert TRACER.summary()["counters"].get("gang_timeout_rejects_total")
+    # deterministic trigger: the earliest-parked member records
+    # "timeout", the sibling records the gang rejection; both carry the
+    # group timeout string and the Unschedulable condition
+    a0, a1 = _annos(store, names[0]), _annos(store, names[1])
+    assert json.loads(a0[ann.PERMIT_STATUS_RESULT]) == \
+        {"Coscheduling": "timeout"}
+    assert "timed out" in json.loads(a1[ann.PERMIT_STATUS_RESULT])["Coscheduling"]
+    for a in (a0, a1):
+        assert json.loads(a[ann.PERMIT_TIMEOUT_RESULT]) == \
+            {"Coscheduling": "0.15s"}
+    for nm in names[:2]:
+        conds = {c["type"]: c for c in
+                 store.get("pods", nm)["status"]["conditions"]}
+        assert conds["PodScheduled"]["reason"] == "Unschedulable"
+
+
+def test_prefilter_rejects_group_that_cannot_reach_quorum():
+    store = _store()
+    # 2 member pods exist, minMember=5: quorum is impossible
+    names = _gang(store, members=2, min_member=5)
+    engine = _engine(store)
+    assert engine.schedule_pending() == 0
+    assert engine.gang_parked == {} and engine.waiting_pods == {}
+    for nm in names:
+        a = _annos(store, nm)
+        status = json.loads(a[ann.PRE_FILTER_STATUS_RESULT])
+        assert "cannot reach quorum" in status["Coscheduling"]
+        # PreFilter aborted the cycle: no filter/score results
+        assert a.get(ann.FILTER_RESULT, "{}") == "{}"
+        conds = {c["type"]: c for c in
+                 store.get("pods", nm)["status"]["conditions"]}
+        assert conds["PodScheduled"]["reason"] == "Unschedulable"
+
+
+def test_prefilter_rejects_unsatisfiable_min_resources():
+    store = _store(n_nodes=2)
+    pgs, pods = make_gang_workload(1, 2, seed=3, timeout_seconds=30)
+    pgs[0]["spec"]["minResources"] = {"cpu": "100000", "memory": "1Ti"}
+    for pg in pgs:
+        store.create("podgroups", pg)
+    for p in pods:
+        store.create("pods", p)
+    engine = _engine(store)
+    assert engine.schedule_pending() == 0
+    a = _annos(store, pods[0]["metadata"]["name"])
+    assert "minResources" in \
+        json.loads(a[ann.PRE_FILTER_STATUS_RESULT])["Coscheduling"]
+
+
+def test_label_without_podgroup_schedules_as_ordinary_pod():
+    store = _store()
+    p = make_pods(1, seed=5)[0]
+    p["metadata"]["labels"][POD_GROUP_LABEL] = "no-such-group"
+    store.create("pods", p)
+    engine = _engine(store)
+    assert engine.schedule_pending() == 1
+    assert store.get("pods", p["metadata"]["name"])["spec"].get("nodeName")
+
+
+def test_assumed_capacity_reserved_while_parked():
+    """A parked gang's speculative assignments consume node capacity in
+    later waves (the upstream assumed-pod state): an ordinary pod that
+    only fits where the gang is assumed must go elsewhere/unschedulable."""
+    store = ObjectStore()
+    ensure_podgroup_resource(store)
+    store.create("nodes", {
+        "metadata": {"name": "only"},
+        "status": {"allocatable": {"cpu": "2", "memory": "8Gi",
+                                   "pods": "10"}},
+    })
+    pgs, pods = make_gang_workload(1, 3, seed=2, timeout_seconds=30,
+                                   cpu_milli=900)
+    pods[2]["spec"]["containers"][0]["resources"]["requests"]["cpu"] = \
+        "9999999m"  # below quorum: the two feasible members park
+    for pg in pgs:
+        store.create("podgroups", pg)
+    for p in pods:
+        store.create("pods", p)
+    engine = _engine(store)
+    assert engine.schedule_pending() == 0
+    assert len(engine.gang_parked) == 2  # 2 x 900m assumed on "only"
+    filler = make_pods(1, seed=7)[0]
+    filler["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "500m"
+    store.create("pods", filler)
+    assert engine.schedule_pending() == 0  # 2000m - 1800m assumed < 500m
+    assert not store.get("pods", filler["metadata"]["name"])["spec"].get(
+        "nodeName")
+
+
+# --------------------------------------------------------------- vectorized
+
+
+def test_quorum_slice_segment_reduction_semantics():
+    import numpy as np
+
+    # groups: 0 (3 members, min 3, all feasible), 1 (2 members, min 3,
+    # feasible -> parks), ungrouped pod, group 2 admitted via `already`
+    gid = np.array([0, 0, 0, 1, 1, -1, 2], dtype=np.int32)
+    sel = np.array([1, 2, 0, 1, 1, 3, 2], dtype=np.int32)
+    already = np.array([0, 0, 2], dtype=np.int32)
+    minm = np.array([3, 3, 3], dtype=np.int32)
+    admit, wave, wait = quorum_slice(gid, sel, already, minm)
+    assert admit.tolist() == [True, False, True]
+    assert wave.tolist() == [3, 2, 1]
+    # ranks 1,2 of group 0 waited; rank 3 completed quorum; group 1's
+    # two feasible members waited; group 2's member had already>=min
+    assert wait.tolist() == [True, True, False, True, True, False, False]
+
+
+def test_quorum_pass_counter_reported():
+    store = _store()
+    _gang(store, members=3)
+    engine = _engine(store)
+    from kube_scheduler_simulator_tpu.utils.tracing import TRACER
+
+    TRACER.reset()
+    engine.schedule_pending()
+    counters = TRACER.summary()["counters"]
+    assert counters.get("gang_quorum_pass_seconds", 0) > 0
+    assert counters.get("gang_groups_admitted_total") == 1
+
+
+def test_gang_counters_rollback_and_admit():
+    store = _store(n_nodes=8)
+    _gang(store, members=3, name_prefix="ok")
+    _gang(store, members=3, infeasible=(0,), name_prefix="parked")
+    engine = _engine(store)
+    from kube_scheduler_simulator_tpu.utils.tracing import TRACER
+
+    TRACER.reset()
+    assert engine.schedule_pending() == 3
+    counters = TRACER.summary()["counters"]
+    assert counters.get("gang_groups_admitted_total") == 1
+    assert counters.get("gang_quorum_rollbacks_total") == 1
+
+
+# --------------------------------------------------------------- ordering
+
+
+def test_pending_order_groups_gang_members_contiguously():
+    store = _store()
+    plain = make_pods(4, seed=11)
+    pgs, gpods = make_gang_workload(1, 2, seed=2)
+    for p in gpods:
+        p["spec"]["priority"] = 0  # equal footing: FIFO decides
+    # interleave creations: plain0, member0, plain1, member1, plain2...
+    store.create("pods", plain[0])
+    store.create("podgroups", pgs[0])
+    store.create("pods", gpods[0])
+    store.create("pods", plain[1])
+    store.create("pods", gpods[1])
+    store.create("pods", plain[2])
+    engine = _engine(store)
+    order = [p["metadata"]["name"] for p in engine.pending_pods()]
+    i0, i1 = order.index(gpods[0]["metadata"]["name"]), \
+        order.index(gpods[1]["metadata"]["name"])
+    # members contiguous, anchored at the first member's position
+    assert i1 == i0 + 1
+    assert order.index(plain[0]["metadata"]["name"]) < i0
+    assert order.index(plain[1]["metadata"]["name"]) > i1
+
+
+def test_pending_index_and_legacy_sort_agree_on_gangs():
+    from kube_scheduler_simulator_tpu.framework.pending import (
+        PendingPodIndex, gang_sorted)
+
+    store = _store()
+    pgs, gpods = make_gang_workload(2, 3, seed=2)
+    for pg in pgs:
+        store.create("podgroups", pg)
+    plain = make_pods(5, seed=13)
+    for i, p in enumerate(plain[:3]):
+        store.create("pods", p)
+    for p in gpods:
+        store.create("pods", p)
+    for p in plain[3:]:
+        store.create("pods", p)
+    idx = PendingPodIndex(store)
+    try:
+        via_index = [p["metadata"]["name"] for p in idx.pending()]
+    finally:
+        idx.close()
+    from kube_scheduler_simulator_tpu.cluster.store import list_shared
+
+    via_sort = [p["metadata"]["name"]
+                for p in gang_sorted(list_shared(store, "pods"))]
+    assert via_index == via_sort
+
+
+def test_pending_index_survives_member_lowering_group_min():
+    """Regression (review finding): a gang member arriving with a sort
+    key BELOW its group's resident min used to crash the index's
+    reposition (KeyError on the not-yet-inserted member)."""
+    from kube_scheduler_simulator_tpu.framework.pending import PendingPodIndex
+
+    store = _store()
+    store.create("podgroups", {
+        "metadata": {"name": "g", "namespace": "default"},
+        "spec": {"minMember": 2},
+    })
+    idx = PendingPodIndex(store)
+    try:
+        store.create("pods", {
+            "metadata": {"name": "m0", "namespace": "default",
+                         "labels": {POD_GROUP_LABEL: "g"}},
+            "spec": {"priority": 0, "containers": [{"name": "c"}]},
+        })
+        assert [p["metadata"]["name"] for p in idx.pending()] == ["m0"]
+        # higher priority -> lower sort key than the resident min
+        store.create("pods", {
+            "metadata": {"name": "m1", "namespace": "default",
+                         "labels": {POD_GROUP_LABEL: "g"}},
+            "spec": {"priority": 10, "containers": [{"name": "c"}]},
+        })
+        order = [p["metadata"]["name"] for p in idx.pending()]  # no KeyError
+        assert order == ["m1", "m0"]
+        # and the group stays contiguous against an interleaving pod
+        store.create("pods", {
+            "metadata": {"name": "plain", "namespace": "default"},
+            "spec": {"priority": 5, "containers": [{"name": "c"}]},
+        })
+        assert [p["metadata"]["name"] for p in idx.pending()] == \
+            ["m1", "m0", "plain"]
+    finally:
+        idx.close()
+
+
+def test_custom_queue_sort_routes_gangs_through_permit_machinery():
+    """Regression (review finding): a custom QueueSort order breaks the
+    gang-contiguity invariant, so the engine must NOT run the
+    vectorized pass — gangs go through the per-pod Permit machinery
+    and still admit all-or-nothing."""
+    from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+
+    class NameSort(CustomPlugin):
+        name = "NameSort"
+
+        def less(self, a, b):
+            # interleaves gang members with everything else
+            return a["metadata"]["name"][::-1] < b["metadata"]["name"][::-1]
+
+    store = _store()
+    names = _gang(store, members=3)
+    engine = _engine(store, extra_plugins=(NameSort(),))
+    assert not engine._gang_vectorized()
+    assert engine.schedule_pending() == 3
+    for nm in names:
+        assert store.get("pods", nm)["spec"].get("nodeName"), nm
+    assert engine.waiting_pods == {} and engine.gang_parked == {}
+
+
+def test_sort_key_tolerates_non_integer_resource_versions():
+    """Regression (PR 3's kubeapi _rv_int synthesizes non-integer rvs):
+    _sort_key/gang_sorted must not raise ValueError on them."""
+    from kube_scheduler_simulator_tpu.framework.pending import (
+        _sort_key, gang_sorted)
+
+    pods = [
+        {"metadata": {"name": "a", "resourceVersion": "12abc"},
+         "spec": {"priority": 0}},
+        {"metadata": {"name": "b", "resourceVersion": "7"},
+         "spec": {"priority": 0}},
+        {"metadata": {"name": "c", "resourceVersion": "etag-xyz"},
+         "spec": {"priority": 10}},
+        {"metadata": {"name": "d"}, "spec": {}},
+    ]
+    keys = [_sort_key(p) for p in pods]  # must not raise
+    assert keys[1] == (0, 7, "")
+    order = [p["metadata"]["name"] for p in gang_sorted(pods)]
+    # priority 10 first; non-integer rvs sort as 0 (before rv 7),
+    # lexicographic among themselves
+    assert order == ["c", "d", "a", "b"]
+
+
+# --------------------------------------------------------------- parity
+
+
+def test_gang_streaming_cuts_match_sequential_with_straddling_gangs():
+    """Gangs of 5 with chunk=4 force every gang to straddle a chunk
+    boundary: the streaming committer's gang-boundary cuts must produce
+    the same binds, bind order and bit-identical annotations as the
+    sequential post-pass.  (The full mixed-workload gate lives in
+    tests/test_golden_annotations.py.)"""
+    import copy
+    import queue as queue_mod
+
+    nodes = make_nodes(10, seed=7)
+    pgs, gpods = make_gang_workload(3, 5, seed=9)
+    for p in gpods:
+        if (p["metadata"]["labels"][POD_GROUP_LABEL] == "gang-0001"
+                and p["metadata"]["name"].endswith("004")):
+            p["spec"]["containers"][0]["resources"]["requests"]["cpu"] = \
+                "9999999m"
+
+    def run(pipeline):
+        store = ObjectStore()
+        ensure_podgroup_resource(store)
+        for n in nodes:
+            store.create("nodes", copy.deepcopy(n))
+        for pg in pgs:
+            store.create("podgroups", copy.deepcopy(pg))
+        for p in gpods:
+            store.create("pods", copy.deepcopy(p))
+        q = store.watch("pods")
+        engine = _engine(store, pipeline=pipeline, chunk=4)
+        bound = engine.schedule_pending()
+        order, seen = [], set()
+        while True:
+            try:
+                _rv, et, obj = q.get_nowait()
+            except queue_mod.Empty:
+                break
+            nm = obj["metadata"]["name"]
+            if (et == "MODIFIED" and (obj.get("spec") or {}).get("nodeName")
+                    and nm not in seen):
+                seen.add(nm)
+                order.append(nm)
+        store.unwatch("pods", q)
+        anns = {p["metadata"]["name"]: p["metadata"].get("annotations") or {}
+                for p in store.list("pods")[0]}
+        return bound, order, anns, sorted(k for k in engine.gang_parked)
+
+    bound_p, order_p, anns_p, parked_p = run(True)
+    bound_s, order_s, anns_s, parked_s = run(False)
+    assert bound_p == bound_s == 10  # gangs 0 and 2 admit, gang 1 parks
+    assert order_p == order_s
+    assert parked_p == parked_s and len(parked_p) == 4
+    assert anns_p == anns_s
+
+
+# --------------------------------------------------------------- fallbacks
+
+
+def test_gang_through_per_pod_permit_machinery_with_other_lifecycle():
+    """Another custom lifecycle plugin forces the per-pod Permit path:
+    the Coscheduling plugin's own permit()/unreserve() carry the gang —
+    same-wave quorum admission still binds everyone."""
+    from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+
+    log = []
+
+    class Observer(CustomPlugin):
+        name = "Observer"
+
+        def reserve(self, pod, node):
+            log.append(pod["metadata"]["name"])
+            return None
+
+        def unreserve(self, pod, node):
+            return None
+
+    store = _store()
+    names = _gang(store, members=3)
+    engine = _engine(store, extra_plugins=(Observer(),))
+    assert engine._custom_lifecycle_plugins()  # per-pod machinery active
+    assert engine.schedule_pending() == 3
+    for nm in names:
+        assert store.get("pods", nm)["spec"].get("nodeName"), nm
+    assert sorted(log) == sorted(names)
+    assert engine.waiting_pods == {}
+
+
+def test_gang_timeout_through_per_pod_permit_machinery():
+    from kube_scheduler_simulator_tpu.plugins.custom import CustomPlugin
+
+    class Observer(CustomPlugin):
+        def __init__(self):
+            self.name = "Observer"
+
+        def reserve(self, pod, node):
+            return None
+
+        def unreserve(self, pod, node):
+            return None
+
+    store = _store()
+    names = _gang(store, members=3, timeout=0.2, infeasible=(2,))
+    engine = _engine(store, extra_plugins=(Observer(),))
+    # the per-pod path resolves waits inside the call (waiter threads)
+    assert engine.schedule_pending() == 0
+    assert engine.waiting_pods == {}
+    for nm in names:
+        assert not store.get("pods", nm)["spec"].get("nodeName")
+
+
+# --------------------------------------------------------------- preemption
+
+
+def test_preemption_never_drops_running_gang_below_quorum():
+    from kube_scheduler_simulator_tpu.framework.preemption import Preemptor
+
+    store = ObjectStore()
+    ensure_podgroup_resource(store)
+    store.create("nodes", {
+        "metadata": {"name": "n1"},
+        "status": {"allocatable": {"cpu": "2", "memory": "8Gi",
+                                   "pods": "10"}},
+    })
+    store.create("podgroups", {
+        "metadata": {"name": "job", "namespace": "default"},
+        "spec": {"minMember": 2},
+    })
+    # both gang members bound on n1 (quota: 2 bound - 2 minMember = 0
+    # removable), plus one plain low-priority pod
+    for i in range(2):
+        store.create("pods", {
+            "metadata": {"name": f"job-{i}", "namespace": "default",
+                         "labels": {POD_GROUP_LABEL: "job"}},
+            "spec": {"priority": 0, "nodeName": "n1",
+                     "containers": [{"name": "c", "resources": {
+                         "requests": {"cpu": "700m", "memory": "1Gi"}}}]},
+        })
+    store.create("pods", {
+        "metadata": {"name": "plain", "namespace": "default"},
+        "spec": {"priority": 0, "nodeName": "n1",
+                 "containers": [{"name": "c", "resources": {
+                     "requests": {"cpu": "600m", "memory": "1Gi"}}}]},
+    })
+    preemptor_pod = {
+        "metadata": {"name": "vip", "namespace": "default"},
+        "spec": {"priority": 100,
+                 "containers": [{"name": "c", "resources": {
+                     "requests": {"cpu": "600m", "memory": "1Gi"}}}]},
+    }
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit"])
+    out = Preemptor(store, cfg).preempt(
+        preemptor_pod, [("n1", "NodeResourcesFit")])
+    # evicting "plain" frees 600m — enough for the preemptor — and the
+    # gang members are protected, so they must not appear as victims
+    assert out.nominated_node == "n1"
+    victims = {(v["metadata"] or {}).get("name") for v in out.victims}
+    assert victims == {"plain"}
+
+    # a preemptor that could only fit by evicting a protected member
+    # finds no candidate at all
+    big = dict(preemptor_pod)
+    big["spec"] = {"priority": 100, "containers": [{"name": "c", "resources": {
+        "requests": {"cpu": "1500m", "memory": "1Gi"}}}]}
+    out2 = Preemptor(store, cfg).preempt(big, [("n1", "NodeResourcesFit")])
+    assert out2.nominated_node == ""
+
+
+# --------------------------------------------------------------- scenario
+
+
+def test_gang_scenario_e2e_from_example_file():
+    """examples/gang_scenario.json: a PodGroup + 3 members created over
+    scenario steps end Succeeded with all-or-nothing binds."""
+    from pathlib import Path
+
+    from kube_scheduler_simulator_tpu.scenario.runner import ScenarioService
+
+    scenario = json.loads(
+        (Path(__file__).parent.parent / "examples" / "gang_scenario.json")
+        .read_text())
+    store = ObjectStore()
+    ensure_podgroup_resource(store)
+    engine = _engine(store)
+    svc = ScenarioService(store, engine)
+    svc.create(scenario, run=False)
+    result = svc.run("gang-demo")
+    assert result["status"]["phase"] == "Succeeded", result["status"]
+    bound = [p["metadata"]["name"] for p in store.list("pods")[0]
+             if p["spec"].get("nodeName")]
+    assert sorted(bound) == ["train-job-0", "train-job-1", "train-job-2"]
+    timeline = result["status"]["scenarioResult"]["timeline"]
+    scheduled = [e for evs in timeline.values() for e in evs
+                 if "podScheduled" in e]
+    assert len(scheduled) == 3
+
+
+# --------------------------------------------------------------- soak
+
+
+def test_gang_soak_staggered_arrival_no_parked_leak():
+    """N groups with staggered member arrival: some complete quorum
+    across calls, some time out; no parked-pod leak remains in
+    engine.waiting_pods / engine.gang_parked."""
+    store = _store(n_nodes=8, seed=3)
+    n_groups = 6
+    pgs, pods = make_gang_workload(n_groups, 3, seed=4, timeout_seconds=0.4)
+    for pg in pgs:
+        store.create("podgroups", pg)
+    by_group: dict[str, list[dict]] = {}
+    for p in pods:
+        by_group.setdefault(
+            p["metadata"]["labels"][POD_GROUP_LABEL], []).append(p)
+    groups = sorted(by_group)
+    # groups 0-3: members arrive over three rounds (complete); groups
+    # 4-5: the third member is infeasible from the start (time out)
+    for g in groups[4:]:
+        by_group[g][2]["spec"]["containers"][0]["resources"]["requests"][
+            "cpu"] = "9999999m"
+        for p in by_group[g]:
+            store.create("pods", p)
+    engine = _engine(store)
+    for round_ in range(3):
+        for g in groups[:4]:
+            store.create("pods", by_group[g][round_])
+        engine.schedule_pending()
+        if round_ < 2:
+            # staggered groups can't reach quorum yet (fewer than
+            # minMember pods exist): PreFilter rejects them — only the
+            # infeasible-member groups 4-5 hold parks, and nothing from
+            # groups 0-3 binds
+            assert {k[1].rsplit("-member-", 1)[0]
+                    for k in engine.gang_parked} == set(groups[4:])
+            for g in groups[:4]:
+                for p in by_group[g][:round_ + 1]:
+                    assert not store.get(
+                        "pods", p["metadata"]["name"])["spec"].get("nodeName")
+    # groups 0-3 fully admitted once every member exists
+    for g in groups[:4]:
+        for p in by_group[g]:
+            assert store.get("pods", p["metadata"]["name"])["spec"].get(
+                "nodeName"), p["metadata"]["name"]
+    # expire the doomed groups; their members reject (and would re-park
+    # on further attempts — delete them to settle)
+    time.sleep(0.5)
+    engine._gang_maintain()
+    assert engine.gang_parked == {} and engine.waiting_pods == {}
+    for g in groups[4:]:
+        for p in by_group[g][:2]:
+            a = _annos(store, p["metadata"]["name"])
+            assert ann.PERMIT_STATUS_RESULT in a
+        for p in by_group[g]:
+            store.delete("pods", p["metadata"]["name"], "default")
+    assert engine.schedule_pending() == 0
+    assert engine.gang_parked == {} and engine.waiting_pods == {}
+
+
+def test_deleted_podgroup_releases_parked_members():
+    store = _store()
+    names = _gang(store, members=3, infeasible=(2,))
+    engine = _engine(store)
+    assert engine.schedule_pending() == 0
+    assert len(engine.gang_parked) == 2
+    store.delete("podgroups", "gang-0000", "default")
+    # next call reconciles: the park dissolves, members reschedule as
+    # ordinary pods
+    bound = engine.schedule_pending()
+    assert engine.gang_parked == {} and engine.waiting_pods == {}
+    assert bound == 2
+    for nm in names[:2]:
+        assert store.get("pods", nm)["spec"].get("nodeName"), nm

@@ -311,3 +311,82 @@ def test_pipelined_commit_parity_with_sequential_postpass():
             assert anns_p[name].get(key) == anns_s[name].get(key), (
                 f"pod {name} key {key} diverged between pipelined and "
                 "sequential commit")
+
+
+def test_gang_pipelined_commit_parity_with_sequential_postpass():
+    """The parity gate extended to gang scheduling
+    (docs/gang-scheduling.md): a mixed wave of PodGroups (one admitted,
+    one below quorum), gang-labeled pods and plain pods must produce
+    bit-identical annotations (permit-result / permit-result-timeout /
+    result-history included), the same bind count, the same bind order
+    AND the same parked set between pipeline_commit=True (gang-boundary
+    streaming cuts, chunk=8 so gangs of 5 straddle chunks) and False
+    (the sequential post-pass with the same vectorized quorum pass)."""
+    import copy
+    import queue as queue_mod
+
+    from kube_scheduler_simulator_tpu.framework.gang import POD_GROUP_LABEL
+    from kube_scheduler_simulator_tpu.models.workloads import (
+        make_gang_workload, make_nodes, make_pods)
+    from kube_scheduler_simulator_tpu.plugins.coscheduling import (
+        Coscheduling, ensure_podgroup_resource)
+
+    nodes = make_nodes(14, seed=21, taint_fraction=0.2)
+    pgs, gpods = make_gang_workload(3, 5, seed=22)
+    for p in gpods:
+        # one gang below quorum: two members infeasible
+        if (p["metadata"]["labels"][POD_GROUP_LABEL] == "gang-0001"
+                and p["metadata"]["name"].endswith(("003", "004"))):
+            p["spec"]["containers"][0]["resources"]["requests"]["cpu"] = \
+                "9999999m"
+    plain = make_pods(40, seed=23, with_affinity=True, with_tolerations=True)
+    for i, p in enumerate(plain):
+        p["spec"]["priority"] = (i % 3) * 100
+
+    def run(pipeline):
+        store = ObjectStore()
+        ensure_podgroup_resource(store)
+        for n in nodes:
+            store.create("nodes", copy.deepcopy(n))
+        for pg in pgs:
+            store.create("podgroups", copy.deepcopy(pg))
+        for p in gpods + plain:
+            store.create("pods", copy.deepcopy(p))
+        q = store.watch("pods")
+        cfg = PluginSetConfig(
+            enabled=["NodeResourcesFit", "NodeResourcesBalancedAllocation",
+                     "NodeAffinity", "TaintToleration", "Coscheduling"],
+            custom={"Coscheduling": Coscheduling()},
+        )
+        engine = SchedulerEngine(store, plugin_config=cfg, chunk=8,
+                                 pipeline_commit=pipeline)
+        bound = engine.schedule_pending()
+        bind_order, seen = [], set()
+        while True:
+            try:
+                _rv, event_type, obj = q.get_nowait()
+            except queue_mod.Empty:
+                break
+            name = obj["metadata"]["name"]
+            if (event_type == "MODIFIED"
+                    and (obj.get("spec") or {}).get("nodeName")
+                    and name not in seen):
+                seen.add(name)
+                bind_order.append(name)
+        store.unwatch("pods", q)
+        anns = {p["metadata"]["name"]: p["metadata"].get("annotations") or {}
+                for p in store.list("pods")[0]}
+        parked = sorted(k for k in engine.gang_parked)
+        return bound, bind_order, anns, parked
+
+    bound_p, order_p, anns_p, parked_p = run(True)
+    bound_s, order_s, anns_s, parked_s = run(False)
+    assert bound_p == bound_s
+    assert order_p == order_s
+    assert parked_p == parked_s and len(parked_p) == 3
+    assert anns_p.keys() == anns_s.keys()
+    for name in anns_s:
+        for key in set(anns_s[name]) | set(anns_p[name]):
+            assert anns_p[name].get(key) == anns_s[name].get(key), (
+                f"pod {name} key {key} diverged between pipelined and "
+                "sequential gang commit")

@@ -1,0 +1,100 @@
+"""WaitingPod determinism and concurrency (framework/waiting.py).
+
+The permit-result-timeout annotation must be reproducible: timeout
+selection is earliest deadline then plugin name, reject() settles the
+handle (clears pending deadlines, first rejection wins), and
+allow/reject racing from concurrent threads resolves to exactly one
+consistent outcome.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kube_scheduler_simulator_tpu.framework.waiting import WaitingPod
+
+
+def _pod(name="p"):
+    return {"metadata": {"name": name, "namespace": "default"}}
+
+
+def test_timeout_picks_earliest_deadline_then_plugin_name():
+    # B's deadline is earliest -> B is the recorded timeout plugin even
+    # though A sorts first alphabetically and was inserted first
+    wp = WaitingPod(_pod(), {"A": 0.2, "B": 0.01})
+    assert wp.wait() == ("B", "timeout")
+    # equal deadlines: plugin name breaks the tie deterministically
+    wp2 = WaitingPod(_pod(), {"Zeta": 0.0, "Alpha": 0.0})
+    assert wp2.wait() == ("Alpha", "timeout")
+
+
+def test_timeout_settles_the_handle():
+    wp = WaitingPod(_pod(), {"A": 0.0})
+    first = wp.wait()
+    assert first == ("A", "timeout")
+    # a second wait (or a racing waiter) sees the SAME resolution, and
+    # no pending plugins remain
+    assert wp.wait() == first
+    assert wp.pending_plugins() == []
+
+
+def test_reject_clears_deadlines_and_first_rejection_wins():
+    wp = WaitingPod(_pod(), {"A": 30.0, "B": 30.0})
+    wp.reject("B", "veto")
+    assert wp.pending_plugins() == []  # state cleared on reject
+    wp.reject("A", "late veto")       # second reject cannot overwrite
+    assert wp.wait() == ("B", "veto")
+
+
+def test_allow_reject_race_resolves_consistently():
+    """allow and reject racing from two threads: wait() returns either
+    the rejection or None (all allowed), never a torn state, and the
+    handle reads settled afterwards."""
+    for _ in range(50):
+        wp = WaitingPod(_pod(), {"A": 5.0})
+        results = []
+        barrier = threading.Barrier(3)
+
+        def allower():
+            barrier.wait()
+            wp.allow("A")
+
+        def rejecter():
+            barrier.wait()
+            wp.reject("A", "race")
+
+        def waiter():
+            barrier.wait()
+            results.append(wp.wait())
+
+        threads = [threading.Thread(target=f)
+                   for f in (allower, rejecter, waiter)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert results and results[0] in (None, ("A", "race"))
+        assert wp.pending_plugins() == []
+        # a rejection, once observed, is sticky.  (A reject landing
+        # after the waiter already resolved "allowed" is recorded on
+        # the handle but moot — the engine pops the pod on resolution.)
+        if results[0] == ("A", "race"):
+            assert wp.wait() == ("A", "race")
+        else:
+            assert wp.wait() in (None, ("A", "race"))
+
+
+def test_concurrent_allows_release_waiter():
+    wp = WaitingPod(_pod(), {"A": 5.0, "B": 5.0})
+    out = []
+    t = threading.Thread(target=lambda: out.append(wp.wait()))
+    t.start()
+    time.sleep(0.02)
+    ta = threading.Thread(target=lambda: wp.allow("A"))
+    tb = threading.Thread(target=lambda: wp.allow("B"))
+    ta.start()
+    tb.start()
+    for th in (ta, tb, t):
+        th.join(5)
+    assert out == [None]
