@@ -7,8 +7,9 @@
 
 PY ?= python
 
-.PHONY: codec native-asan test test-asan bench smoke clean parity-fullscale \
-        parity-fullscale-device multichip-scaling host-probe tpu-watch
+.PHONY: codec native-asan test test-asan bench bench-check smoke clean \
+        parity-fullscale parity-fullscale-device multichip-scaling \
+        host-probe tpu-watch
 
 # measurement artifacts (committed under docs/bench/; see BASELINE.md)
 parity-fullscale:
@@ -50,6 +51,11 @@ test:
 
 bench:
 	$(PY) bench.py
+
+# compare the newest BENCH_*.json round against the previous one on the
+# key serving metrics; exits nonzero on >15% regression (docs/metrics.md)
+bench-check:
+	$(PY) docs/bench/bench_check.py
 
 # gang-workload shape (docs/gang-scheduling.md): PodGroup co-scheduling
 # through the vectorized quorum pass, printing the gang_* counters so

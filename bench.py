@@ -554,6 +554,7 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
             "store_batch_writes_total", "store_batches_total",
             "replay_width_retries_total",
             "decode_chunk_calls_total", "decode_native_thread_seconds",
+            "wave_attribution_seconds",
             "gang_groups_admitted_total", "gang_quorum_rollbacks_total",
             "gang_timeout_rejects_total", "gang_quorum_pass_seconds",
         ) if k in summary["counters"]
@@ -568,10 +569,16 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
             f"C worker time")
     cps = scale_pods / total
     log(f"  engine: bound {bound}/{scale_pods} in {total:.2f}s -> {cps:,.0f} cycles/s")
+    snap = TRACER.snapshot()
     return {"pods": scale_pods, "nodes": scale_nodes, "bound": bound,
             "cycles_per_sec": round(cps, 1),
             "spans": {k: round(v, 2) for k, v in spans.items()},
-            "counters": {k: round(v, 3) for k, v in counters.items()}}
+            "counters": {k: round(v, 3) for k, v in counters.items()},
+            # the full flight-recorder snapshot (histograms + labeled
+            # counters + per-plugin attribution, docs/metrics.md) rides
+            # the BENCH artifact so perf rounds keep the whole surface
+            "metrics": {"labeled_counters": snap["labeled_counters"],
+                        "histograms": snap["histograms"]}}
 
 
 def measure_gang(n_groups: int, members: int, scale_nodes: int, seed: int,
@@ -643,7 +650,10 @@ def measure_gang(n_groups: int, members: int, scale_nodes: int, seed: int,
     pods_per_sec = len(pods) / total if total else 0.0
     log(f"  gang engine: bound {bound}/{len(pods)} in {total:.2f}s -> "
         f"{pods_per_sec:,.0f} pods/s ({len(engine.gang_parked)} parked)")
+    snap = TRACER.snapshot()
     return {
+        "metrics": {"labeled_counters": snap["labeled_counters"],
+                    "histograms": snap["histograms"]},
         "groups": n_groups, "members": members, "nodes": scale_nodes,
         "park_groups": park_groups, "plain_pods": plain_pods,
         "bound": bound, "pods": len(pods), "parked": len(engine.gang_parked),

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""`make bench-check`: CI-enforceable perf trajectory.
+
+Compares the newest committed bench round (`BENCH_*.json` at the repo
+root) against the previous one on the key serving metrics and exits
+nonzero when any regressed more than the threshold (default 15%):
+
+  * `decode_pods_per_sec`      — annotation decode rate (higher better);
+  * `commit_stream_overlap_seconds` of the engine_2k_1k wave — commit
+    work hidden inside the replay window (higher better,
+    docs/wave-pipeline.md);
+  * engine_2k_1k *wave wall* (pods / cycles_per_sec, lower better);
+  * the headline e2e `value` (higher better).
+
+A metric missing on either side (e.g. a CPU-fallback round that skipped
+an engine phase, or rounds predating a counter) is reported as SKIP and
+never fails the check — the gate enforces "no silent regression", not
+"every round measures everything".
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+DEFAULT_THRESHOLD = 0.15
+
+
+def extract_bench_line(doc: dict) -> dict | None:
+    """The bench.py one-JSON-line result from a BENCH_*.json round
+    artifact ({n, cmd, rc, tail}) or from a raw bench line itself."""
+    if "metric" in doc:
+        return doc
+    tail = doc.get("tail") or ""
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if line.startswith('{"metric"'):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
+    """{metric: (value or None, direction)} — direction 'higher' means
+    bigger is better, 'lower' the reverse."""
+    extra = bench.get("extra") or {}
+    eng = extra.get("engine_2k_1k") or {}
+    counters = eng.get("counters") or {}
+    wall = None
+    if eng.get("cycles_per_sec") and eng.get("pods"):
+        wall = eng["pods"] / eng["cycles_per_sec"]
+    return {
+        "decode_pods_per_sec": (extra.get("decode_pods_per_sec"), "higher"),
+        "commit_stream_overlap_seconds":
+            (counters.get("commit_stream_overlap_seconds"), "higher"),
+        "engine_2k_1k_wave_wall_seconds": (wall, "lower"),
+        "headline_e2e_cycles_per_sec": (bench.get("value"), "higher"),
+    }
+
+
+def compare(prev: dict, new: dict,
+            threshold: float = DEFAULT_THRESHOLD) -> list[dict]:
+    """[{metric, old, new, ratio, status}] — status ok|regression|skip."""
+    rows = []
+    old_m, new_m = key_metrics(prev), key_metrics(new)
+    for name, (old_v, direction) in old_m.items():
+        new_v = new_m[name][0]
+        if not old_v or new_v is None:
+            rows.append({"metric": name, "old": old_v, "new": new_v,
+                         "ratio": None, "status": "skip"})
+            continue
+        ratio = new_v / old_v
+        if direction == "higher":
+            bad = ratio < 1 - threshold
+        else:
+            bad = ratio > 1 + threshold
+        rows.append({"metric": name, "old": old_v, "new": new_v,
+                     "ratio": round(ratio, 3),
+                     "status": "regression" if bad else "ok"})
+    return rows
+
+
+def _round_files(root: Path) -> list[Path]:
+    files = [p for p in root.glob("BENCH_*.json")
+             if re.fullmatch(r"BENCH_r?\d+\.json", p.name)]
+
+    def order(p: Path):
+        try:
+            return (json.loads(p.read_text()).get("n", 0), p.name)
+        except (OSError, json.JSONDecodeError):
+            return (-1, p.name)
+
+    return sorted(files, key=order)
+
+
+def main(argv: list[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default=str(Path(__file__).parents[2]),
+                    help="directory holding the BENCH_*.json rounds")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    args = ap.parse_args(argv)
+    files = _round_files(Path(args.dir))
+    if len(files) < 2:
+        print(f"bench-check: fewer than two BENCH_*.json rounds in "
+              f"{args.dir} — nothing to compare")
+        return 0
+    prev_p, new_p = files[-2], files[-1]
+    prev = extract_bench_line(json.loads(prev_p.read_text()))
+    new = extract_bench_line(json.loads(new_p.read_text()))
+    if prev is None or new is None:
+        bad = prev_p.name if prev is None else new_p.name
+        print(f"bench-check: no bench JSON line found in {bad}")
+        return 2
+    print(f"bench-check: {prev_p.name} -> {new_p.name} "
+          f"(threshold {args.threshold:.0%})")
+    rc = 0
+    for row in compare(prev, new, args.threshold):
+        mark = {"ok": "OK  ", "skip": "SKIP", "regression": "FAIL"}[row["status"]]
+        ratio = f'{row["ratio"]:.3f}' if row["ratio"] is not None else "-"
+        print(f"  {mark} {row['metric']}: {row['old']} -> {row['new']} "
+              f"(x{ratio})")
+        if row["status"] == "regression":
+            rc = 1
+    if rc:
+        print("bench-check: REGRESSION above threshold — see FAIL rows")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

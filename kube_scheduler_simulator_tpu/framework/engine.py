@@ -225,6 +225,10 @@ class _WaveCommitter:
         self.gang = gang if gang else None
         self._selected = (np.full(len(pending), -2, dtype=np.int32)
                           if self.gang is not None else None)
+        # wave span id set by the engine once the replay span opens, so
+        # the worker's commit_stream spans parent under it across the
+        # thread boundary (utils/tracing.py span tree)
+        self.parent_span: int | None = None
         self._upto = 0          # pods [0, _upto) already committed
         self._busy: list[tuple[float, float]] = []
         self._exc: BaseException | None = None
@@ -253,10 +257,12 @@ class _WaveCommitter:
         surface worker errors.  -> (#bound, None)."""
         replay_end = time.perf_counter()
         self._q.put(None)
-        with TRACER.span("commit_and_reflect", pods=len(self.pending)):
+        with TRACER.span("commit_and_reflect", pods=len(self.pending)) as sp:
             self._thread.join()
             if self._exc is None:
                 self._reflects.drain()
+        TRACER.observe("framework_extension_point_duration_seconds",
+                       sp.seconds, extension_point="bind")
         overlap = sum(max(0.0, min(t1, replay_end) - t0)
                       for t0, t1 in self._busy if t0 < replay_end)
         TRACER.count("commit_stream_overlap_seconds", round(overlap, 6))
@@ -290,7 +296,10 @@ class _WaveCommitter:
                 continue  # keep draining so finish() never blocks
             try:
                 t0 = time.perf_counter()
-                self._commit(*item)
+                lo, hi, selected = item
+                with TRACER.span("commit_stream", parent=self.parent_span,
+                                 lo=lo, hi=hi):
+                    self._commit(lo, hi, selected)
                 self._busy.append((t0, time.perf_counter()))
             except BaseException as e:  # noqa: BLE001 — re-raised in finish()
                 self._exc = e
@@ -733,6 +742,28 @@ class SchedulerEngine:
     def _profile_wave(self, pending: list[dict],
                       exclude: set[tuple[str, str]] | None = None
                       ) -> tuple[int, str | None]:
+        """Timed shell around _profile_wave_run: feeds the upstream-named
+        scheduling_attempt_duration_seconds histogram — wave wall
+        amortized per pod (the batched paths have no per-pod attempt
+        clock), result=scheduled for bound pods, unschedulable for the
+        rest of the wave (an approximation: parked gang members and
+        gated pods count as unschedulable until they resolve)."""
+        t0 = time.perf_counter()
+        bound, retry = self._profile_wave_run(pending, exclude)
+        n = len(pending)
+        if n:
+            per = (time.perf_counter() - t0) / n
+            if bound:
+                TRACER.observe("scheduling_attempt_duration_seconds", per,
+                               n=bound, result="scheduled")
+            if n > bound:
+                TRACER.observe("scheduling_attempt_duration_seconds", per,
+                               n=n - bound, result="unschedulable")
+        return bound, retry
+
+    def _profile_wave_run(self, pending: list[dict],
+                          exclude: set[tuple[str, str]] | None = None
+                          ) -> tuple[int, str | None]:
         """One wave over the given pending pods with the current
         plugin_config. Returns (#bound, retry reason or None).
 
@@ -843,7 +874,7 @@ class SchedulerEngine:
                 # provably-non-interfering prefix — bit-identical to the
                 # scan (parallel/speculative.py; tests/test_speculative.py)
                 with TRACER.span("speculative_replay", pods=len(pending),
-                                 nodes=len(nodes)):
+                                 nodes=len(nodes)) as sp:
                     rr, spec_stats = replay_speculative(
                         cw, mesh, pods=pending,
                         namespaces=self._list_shared("namespaces"))
@@ -855,16 +886,19 @@ class SchedulerEngine:
                 all_annotations = [None] * len(pending)
                 with TRACER.span("decode_stream", pods=len(pending)):
                     decode_chunk_into(rr, 0, len(pending), all_annotations)
+                self._record_attribution(rr, sp.seconds)
                 return self._finish_wave(cw, rr, all_annotations, pending,
                                          exclude)
 
         if self._custom_lifecycle_plugins():
             # a custom Reserve/Permit/PreBind can reject mid-wave and abort
             # the rest — decode per pod so an aborted wave wastes nothing
-            with TRACER.span("device_replay", pods=len(pending), nodes=len(nodes)):
+            with TRACER.span("device_replay", pods=len(pending),
+                             nodes=len(nodes)) as sp:
                 rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
                             mesh=mesh, unroll=self.unroll)
             all_annotations = _LazyDecode(rr)
+            self._record_attribution(rr, sp.seconds)
             return self._finish_wave(cw, rr, all_annotations, pending, exclude)
 
         if self._can_stream_commit():
@@ -878,25 +912,31 @@ class SchedulerEngine:
                                        gang=self._gang_wave)
             try:
                 with TRACER.span("replay_and_decode_stream",
-                                 pods=len(pending), nodes=len(nodes)):
+                                 pods=len(pending), nodes=len(nodes)) as sp:
+                    # the worker's commit_stream spans parent under the
+                    # wave's replay span across the thread boundary
+                    committer.parent_span = sp.id
                     rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
                                 mesh=mesh, unroll=self.unroll,
                                 on_chunk=committer.on_chunk)
             except BaseException:
                 committer.abort()
                 raise
-            return committer.finish()
+            result = committer.finish()
+            self._record_attribution(rr, sp.seconds)
+            return result
 
         # stream: each chunk decodes (chunk-granular native call, or the
         # host thread pool on the fallback ladder) as soon as its
         # transfer lands, overlapping the device's later chunks
         all_annotations = [None] * len(pending)
         with TRACER.span("replay_and_decode_stream", pods=len(pending),
-                         nodes=len(nodes)):
+                         nodes=len(nodes)) as sp:
             rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
                         mesh=mesh, unroll=self.unroll,
                         on_chunk=lambda rr_, lo, hi: decode_chunk_into(
                             rr_, lo, hi, all_annotations))
+        self._record_attribution(rr, sp.seconds)
         return self._finish_wave(cw, rr, all_annotations, pending, exclude)
 
     def _can_stream_commit(self) -> bool:
@@ -911,6 +951,61 @@ class SchedulerEngine:
                 and not self._extenders_map()
                 and not self._custom_lifecycle_plugins()
                 and not self.plugin_config.postfilters())
+
+    def _record_attribution(self, rr, replay_seconds: float) -> None:
+        """Per-plugin attribution from the replay tensors the wave
+        already decoded (docs/metrics.md): labeled WORK counters (pods x
+        nodes evaluated, first-fail filter rejects, raw score column
+        sums over feasible nodes, prefilter screens) — fused device
+        execution has no per-plugin wall clock — plus the upstream-named
+        framework_extension_point / plugin_execution histograms with
+        the replay span APPORTIONED across points and plugins by
+        evaluated work (documented estimate; host-path plugins record
+        real wall time instead).  Never fails a wave."""
+        try:
+            from .replay import plugin_attribution
+
+            t0 = time.perf_counter()
+            att = plugin_attribution(rr)
+            if att is None:
+                return
+            work: dict[tuple[str, str], int] = {}
+            for name, d in att["filter"].items():
+                TRACER.inc("plugin_pods_nodes_evaluated_total", d["evaluated"],
+                           plugin=name, extension_point="filter")
+                TRACER.inc("plugin_filter_rejects_total", d["rejects"],
+                           plugin=name)
+                work[("filter", name)] = d["evaluated"]
+            for name, d in att["score"].items():
+                TRACER.inc("plugin_pods_nodes_evaluated_total", d["evaluated"],
+                           plugin=name, extension_point="score")
+                TRACER.inc("plugin_score_sum_total", d["sum"], plugin=name)
+                work[("score", name)] = d["evaluated"]
+            for name, d in att["prefilter"].items():
+                TRACER.inc("plugin_pods_nodes_evaluated_total", d["evaluated"],
+                           plugin=name, extension_point="prefilter")
+                TRACER.inc("plugin_prefilter_screens_total", d["screened"],
+                           plugin=name)
+                work[("prefilter", name)] = d["evaluated"]
+            total = sum(work.values())
+            if replay_seconds > 0 and total > 0:
+                points: dict[str, float] = {}
+                for (point, name), w in work.items():
+                    if w <= 0:
+                        continue
+                    share = replay_seconds * w / total
+                    points[point] = points.get(point, 0.0) + share
+                    TRACER.observe("plugin_execution_duration_seconds", share,
+                                   plugin=name, extension_point=point,
+                                   status="Success")
+                for point, secs in points.items():
+                    TRACER.observe(
+                        "framework_extension_point_duration_seconds", secs,
+                        extension_point=point)
+            TRACER.count("wave_attribution_seconds",
+                         round(time.perf_counter() - t0, 6))
+        except Exception:
+            pass  # attribution is observability; waves never fail on it
 
     def _finish_wave(self, cw, rr, all_annotations, pending,
                      exclude: set[tuple[str, str]] | None
@@ -938,7 +1033,7 @@ class SchedulerEngine:
             gang_admit, gang_wait = self._gang_decide(
                 gang, np.asarray(rr.selected, dtype=np.int32), 0,
                 len(pending))
-        with TRACER.span("commit_and_reflect", pods=len(pending)):
+        with TRACER.span("commit_and_reflect", pods=len(pending)) as commit_sp:
             for i, pod in enumerate(pending):
                 meta = pod.get("metadata") or {}
                 ns, name = meta.get("namespace") or "default", meta.get("name", "")
@@ -1011,6 +1106,8 @@ class SchedulerEngine:
                         n_bound += 1
                         reflects.submit(rec.ns, rec.name, rec.uid)
             reflects.drain()
+        TRACER.observe("framework_extension_point_duration_seconds",
+                       commit_sp.seconds, extension_point="bind")
         return n_bound, retry
 
     def _reflector_pool(self):
@@ -1147,9 +1244,12 @@ class SchedulerEngine:
         from .gang import quorum_slice
 
         t0 = time.perf_counter()
-        admit, wave_counts, wait_mask = quorum_slice(
-            ctx.gid[lo:hi], np.asarray(selected[lo:hi], dtype=np.int32),
-            ctx.already, ctx.min_member)
+        # child span: under commit_stream on the worker thread, under
+        # commit_and_reflect on the sequential post-pass
+        with TRACER.span("gang_quorum", pods=hi - lo, groups=len(ctx.keys)):
+            admit, wave_counts, wait_mask = quorum_slice(
+                ctx.gid[lo:hi], np.asarray(selected[lo:hi], dtype=np.int32),
+                ctx.already, ctx.min_member)
         TRACER.count("gang_quorum_pass_seconds",
                      round(time.perf_counter() - t0, 6))
         for g in np.unique(ctx.gid[lo:hi]):
@@ -1279,6 +1379,16 @@ class SchedulerEngine:
                         bound += 1
         return bound
 
+    @staticmethod
+    def _observe_plugin(plugin: str, point: str, t0: float,
+                        status: str) -> None:
+        """Real per-plugin wall clock for host-path lifecycle calls —
+        the time half of the attribution story (docs/metrics.md:
+        device-fused plugins get work attribution instead)."""
+        TRACER.observe("plugin_execution_duration_seconds",
+                       time.perf_counter() - t0, plugin=plugin,
+                       extension_point=point, status=status)
+
     def _run_custom_lifecycle(self, pod, ns: str, name: str, node_name: str,
                               allow_async: bool = False,
                               private: bool = False):
@@ -1329,7 +1439,10 @@ class SchedulerEngine:
                 if ext.before_reserve(pod, node) is not None:
                     unreserve_all()  # plugin skipped, nothing recorded
                     return False
+            t0 = time.perf_counter()
             msg = p.reserve(pod, node)
+            self._observe_plugin(p.name, "reserve", t0,
+                                 "Success" if not msg else "Unschedulable")
             rs.add_reserve_result(ns, name, p.name,
                                   msg if msg else ann.SUCCESS_MESSAGE)
             if ext is not None and has_hook(ext, "after_reserve"):
@@ -1346,7 +1459,12 @@ class SchedulerEngine:
                 if ext.before_permit(pod, node) is not None:
                     unreserve_all()
                     return False
+            t0 = time.perf_counter()
             out = p.permit(pod, node)
+            self._observe_plugin(
+                p.name, "permit", t0,
+                "Success" if out is None
+                else ("Wait" if isinstance(out, tuple) else "Unschedulable"))
             if out is None:
                 rs.add_permit_result(ns, name, p.name, ann.SUCCESS_MESSAGE, "0s")
             elif isinstance(out, tuple):
@@ -1416,7 +1534,10 @@ class SchedulerEngine:
                 if ext.before_pre_bind(pod, node) is not None:
                     unreserve_all()
                     return False
+            t0 = time.perf_counter()
             msg = p.pre_bind(pod, node)
+            self._observe_plugin(p.name, "prebind", t0,
+                                 "Success" if not msg else "Unschedulable")
             rs.add_pre_bind_result(ns, name, p.name,
                                    msg if msg else ann.SUCCESS_MESSAGE)
             if ext is not None and has_hook(ext, "after_pre_bind"):
@@ -1506,7 +1627,9 @@ class SchedulerEngine:
             ext = emap.get(p.name)
             if ext is not None:
                 getattr(ext, "before_post_bind", lambda *a: None)(pod, node)
+            t0 = time.perf_counter()
             p.post_bind(pod, node)
+            self._observe_plugin(p.name, "postbind", t0, "Success")
             if ext is not None:
                 getattr(ext, "after_post_bind", lambda *a: None)(pod, node)
 

@@ -243,8 +243,22 @@ def quorum_slice(gid: np.ndarray, selected: np.ndarray,
     rank = cf - gbase[gid_safe]
     wait_mask = feas & ((already_j[gid_safe] + rank)
                         < jnp.asarray(min_member)[gid_safe])
-    return (np.asarray(admit), np.asarray(wave, dtype=np.int32),
-            np.asarray(wait_mask))
+    admit_np = np.asarray(admit)
+    wave_np = np.asarray(wave, dtype=np.int32)
+    # flight-recorder tap (docs/metrics.md): per-PASS decision counts for
+    # the groups this slice actually touched.  A group re-examined by a
+    # later pass counts again here — the engine's
+    # gang_groups_admitted_total counter stays the deduplicated total.
+    present = wave_np > 0
+    n_admit = int((present & admit_np).sum())
+    n_park = int((present & ~admit_np).sum())
+    from ..utils.tracing import TRACER
+
+    if n_admit:
+        TRACER.inc("gang_quorum_groups_total", n_admit, decision="admit")
+    if n_park:
+        TRACER.inc("gang_quorum_groups_total", n_park, decision="park")
+    return (admit_np, wave_np, np.asarray(wait_mask))
 
 
 # ------------------------------------------------------------ preemption

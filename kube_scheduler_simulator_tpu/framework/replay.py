@@ -249,6 +249,154 @@ class ReplayResult:
         return self._score_final
 
 
+def plugin_attribution(rr: ReplayResult) -> dict | None:
+    """Per-plugin work attribution reconstructed from the replay tensors
+    a wave already holds — no extra device work, no annotation-path
+    reads (the single-slot recon cache the decoders share is never
+    touched; the compact arrays are read directly).
+
+    Returns
+      {"filter":    {name: {"evaluated": pods x nodes the plugin ran on,
+                            "rejects": nodes it first-failed}},
+       "score":     {name: {"evaluated": pods x feasible nodes scored,
+                            "sum": raw score sum over those}},
+       "prefilter": {name: {"evaluated": pods screened (not skipped),
+                            "screened": pods it rejected pre-wave}}}
+    or None when the result is empty / holds neither layout.
+
+    Semantics mirror the framework: a filter plugin "ran" on (pod, node)
+    when no earlier active plugin failed there (stop-at-first-fail);
+    scoring only happens for pods with >1 feasible node; skipped
+    (PreFilter-skip) plugins attribute nothing.  Fused device execution
+    has no per-plugin wall clock — these WORK units are the per-plugin
+    truth, and what the engine's apportioned plugin_execution histogram
+    is derived from (docs/metrics.md)."""
+    cw = rr.cw
+    cfg = cw.config
+    filters = cfg.filters()
+    scorers = cfg.scorers()
+    prefilters = cfg.prefilters()
+    p = cw.n_pods
+    if p == 0:
+        return None
+    fskip = cw.host.get("filter_skip", {})
+    sskip = cw.host.get("score_skip", {})
+    out = {
+        "filter": {n: {"evaluated": 0, "rejects": 0} for n in filters},
+        "score": {n: {"evaluated": 0, "sum": 0} for n in scorers},
+        "prefilter": {},
+    }
+    static = cw.host.get("prefilter_reject", {})
+    dyn = (np.asarray(rr.prefilter_reject)
+           if rr.prefilter_reject is not None else np.zeros(p, np.int64))
+    for name in prefilters:
+        skips = fskip.get(name)
+        evaluated = p - (int(np.count_nonzero(np.asarray(skips, bool)))
+                         if skips is not None else 0)
+        screened = 0
+        msgs = static.get(name)
+        if msgs is not None:
+            screened += sum(1 for m in msgs if m is not None)
+        if name == "VolumeRestrictions":
+            screened += int(np.count_nonzero(
+                np.asarray(dyn, np.int64) & 1))
+        out["prefilter"][name] = {"evaluated": evaluated,
+                                  "screened": screened}
+
+    cc = rr._compact
+    f_count = len(filters)
+    fskip_mat = (np.stack([np.asarray(fskip.get(n, np.zeros(p)), bool)
+                           for n in filters])
+                 if f_count else None)  # [F, P]
+    feasible_count = (np.asarray(rr.feasible_count)
+                      if rr.feasible_count is not None
+                      else np.zeros(p, np.int32))
+    static_rows = cw.host.get("static_score_rows", {})
+
+    def _tally(lo: int, hi: int, ffp: np.ndarray,
+               score_arr_of) -> None:
+        """ffp: [m, N] first-fail words (0 == all active filters pass);
+        score_arr_of(s) -> [m, N] int64 raw column for scorer s."""
+        m = hi - lo
+        if f_count:
+            # per-pod histogram of first-fail values 0..F, one bincount
+            flat = (np.arange(m, dtype=np.int64)[:, None] * (f_count + 1)
+                    + ffp).ravel()
+            counts = np.bincount(flat, minlength=m * (f_count + 1)) \
+                .reshape(m, f_count + 1)
+            rejects = counts[:, 1:]                        # [m, F]
+            # plugin f ran on a node iff ffp == 0 or ffp > f:
+            # all-pass nodes + nodes whose first fail is at a later index
+            suff = np.cumsum(rejects[:, ::-1], axis=1)[:, ::-1]
+            ran = counts[:, :1] + suff                     # [m, F]
+            for f, name in enumerate(filters):
+                out["filter"][name]["rejects"] += int(rejects[:, f].sum())
+                col = ran[:, f]
+                skips = fskip_mat[f, lo:hi]
+                if skips.any():
+                    col = np.where(skips, 0, col)
+                out["filter"][name]["evaluated"] += int(col.sum())
+        if scorers:
+            feas = ffp == 0                                # [m, N]
+            feas_cnt = feas.sum(axis=1)
+            scored = feasible_count[lo:hi] > 1
+            if not scored.any():
+                return
+            feas64 = feas.astype(np.int64)
+            for s, name in enumerate(scorers):
+                sk = sskip.get(name)
+                s_on = (scored if sk is None
+                        else scored & ~np.asarray(sk[lo:hi], bool))
+                rows = np.flatnonzero(s_on)
+                if not rows.size:
+                    continue
+                arr = score_arr_of(s)
+                out["score"][name]["evaluated"] += int(feas_cnt[rows].sum())
+                out["score"][name]["sum"] += int(
+                    (arr[rows] * feas64[rows]).sum())
+
+    if cc is not None and cc.packed:
+        from .pipeline import PACK_MODES
+
+        _, code_bits, _ = PACK_MODES[cc.pack_mode]
+        for ci in range(len(cc.packed)):
+            lo = ci * cc.chunk
+            hi = min(lo + cc.chunk, p)
+            m = hi - lo
+            ffp = (np.asarray(cc.packed[ci][:m]).astype(np.int64)
+                   >> code_bits)
+
+            def arr_of(s: int, ci=ci, lo=lo, hi=hi, m=m) -> np.ndarray:
+                group, row = cc.score_cols[s]
+                if group == "host":
+                    return np.asarray(static_rows[row][lo:hi], np.int64)
+                return np.asarray(getattr(cc, group)[ci][:m, row, :],
+                                  np.int64)
+
+            _tally(lo, hi, ffp, arr_of)
+        return out
+    if rr._filter_codes is None and rr._score_raw is None:
+        return None if not prefilters else out
+    # full-array layout (the speculative path): derive the first-fail
+    # index from the per-plugin codes, same stop-at-first-fail rule
+    codes = np.asarray(rr._filter_codes) if rr._filter_codes is not None \
+        else np.zeros((p, 0, cw.n_nodes), np.int32)
+    raw = np.asarray(rr._score_raw) if rr._score_raw is not None \
+        else np.zeros((p, 0, cw.n_nodes), np.int64)
+    if codes.shape[1]:
+        fail = codes != 0                                   # [P, F, N]
+        any_fail = fail.any(axis=1)
+        first = np.argmax(fail, axis=1)                     # [P, N]
+        ffp_full = np.where(any_fail, first + 1, 0).astype(np.int64)
+    else:
+        # no filter plugins: argmax over the empty axis would raise —
+        # every node passes, first-fail is uniformly 0
+        ffp_full = np.zeros((p, codes.shape[2]), np.int64)
+    _tally(0, p, ffp_full,
+           lambda s: np.asarray(raw[:, s, :], np.int64))
+    return out
+
+
 def _slice_xs(xs: dict[str, Any], lo: int, hi: int, pad_to: int) -> dict[str, Any]:
     def cut(a):
         piece = a[lo:hi]

@@ -20,6 +20,13 @@ framework embeds the cluster, so the same CRUD is exposed at
   /api/v1/namespaces | nodes | pods | ... (GET list, POST create)
   /api/v1/<resource>/<ns>/<name> or /api/v1/<resource>/<name>
   (GET, PUT update, DELETE)
+Observability surface (docs/metrics.md):
+  GET  /metrics                 -> Prometheus text exposition
+  GET  /api/v1/metrics          -> full tracer snapshot JSON
+  GET  /api/v1/metrics/stream   -> SSE snapshots (?interval=S&count=N)
+  GET  /api/v1/trace            -> Perfetto/chrome://tracing JSON (?limit=N)
+  POST /api/v1/profile          -> XLA profile start/stop (409 on bad state)
+  GET  /healthz | /readyz       -> liveness / scheduling-loop readiness
 Middleware: request logging + CORS (reference: server.go:27-37).
 """
 
@@ -28,6 +35,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -130,10 +138,16 @@ def _make_handler(di: DIContainer):
                     return self._static(path[len("/web/"):])
                 if path == "/metrics" and method == "GET":
                     return self._metrics_text()
+                if path in ("/healthz", "/readyz") and method == "GET":
+                    return self._health(path)
                 if path == "/api/v1/metrics" and method == "GET":
                     from ..utils.tracing import TRACER
 
-                    return self._json(200, TRACER.summary())
+                    return self._json(200, TRACER.snapshot())
+                if path == "/api/v1/metrics/stream" and method == "GET":
+                    return self._metrics_stream(url)
+                if path == "/api/v1/trace" and method == "GET":
+                    return self._trace(url)
                 if path == "/api/v1/profile" and method == "POST":
                     return self._profile()
                 if path == "/api/v1/schedulerconfiguration":
@@ -243,8 +257,10 @@ def _make_handler(di: DIContainer):
         def _profile(self):
             """POST /api/v1/profile {"action": "start", "logDir": ...} /
             {"action": "stop"} — XLA profile capture around live
-            scheduling (additive observability, SURVEY.md §5)."""
-            from ..utils.tracing import TRACER
+            scheduling (additive observability, SURVEY.md §5).  Invalid
+            state transitions (double start, stop without start) are a
+            409 Conflict with a JSON error body, never a 500."""
+            from ..utils.tracing import TRACER, ProfileStateError
 
             body = self._body() or {}
             action = body.get("action")
@@ -256,9 +272,82 @@ def _make_handler(di: DIContainer):
                 if action == "stop":
                     d = TRACER.stop_xla_profile()
                     return self._json(200, {"profiling": False, "logDir": d})
-            except RuntimeError as e:
-                return self._json(409, {"message": str(e)})
-            return self._json(400, {"message": "action must be start or stop"})
+            except ProfileStateError as e:
+                return self._json(409, {"reason": "Conflict",
+                                        "message": str(e)})
+            return self._json(400, {"reason": "BadRequest",
+                                    "message": "action must be start or stop"})
+
+        def _health(self, path: str):
+            """GET /healthz (liveness: the HTTP server answers) and
+            /readyz (readiness: the scheduling loop thread is running, so
+            submitted pods will actually be scheduled — 503 until
+            then)."""
+            if path == "/healthz":
+                return self._json(200, {"status": "ok"})
+            loop = di.scheduling_loop
+            t = getattr(loop, "_thread", None)
+            if t is not None and t.is_alive():
+                return self._json(200, {"status": "ready"})
+            return self._json(503, {"status": "not ready",
+                                    "message": "scheduling loop not running"})
+
+        def _trace(self, url):
+            """GET /api/v1/trace?limit=N — the recorded span tree as
+            chrome://tracing / Perfetto JSON (trace-event format; load
+            the response body in https://ui.perfetto.dev — the
+            docs/metrics.md walkthrough reads a pipelined wave)."""
+            from ..utils.tracing import TRACER
+
+            params = parse_qs(url.query)
+            limit = None
+            v = params.get("limit", [""])[0]
+            if v:
+                try:
+                    limit = max(int(v), 0)
+                except ValueError:
+                    return self._json(400, {"reason": "BadRequest",
+                                            "message": f"bad limit {v!r}"})
+            return self._json(200, TRACER.perfetto(limit=limit))
+
+        def _metrics_stream(self, url):
+            """GET /api/v1/metrics/stream?interval=S&count=N — Server-Sent
+            Events: one `data: <snapshot JSON>` event per interval (the
+            same shape as /api/v1/metrics), until the client disconnects
+            or `count` events were sent (count=0: unbounded)."""
+            from ..utils.tracing import TRACER
+
+            params = parse_qs(url.query)
+            try:
+                interval = float(params.get("interval", ["5"])[0])
+                count = int(params.get("count", ["0"])[0])
+            except ValueError:
+                return self._json(400, {"reason": "BadRequest",
+                                        "message": "bad interval/count"})
+            interval = min(max(interval, 0.05), 3600.0)
+            self.send_response(200)
+            self._cors()
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: bytes):
+                self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+            sent = 0
+            try:
+                while count <= 0 or sent < count:
+                    payload = json.dumps(TRACER.snapshot())
+                    write_chunk(f"data: {payload}\n\n".encode())
+                    self.wfile.flush()
+                    sent += 1
+                    if count > 0 and sent >= count:
+                        break
+                    time.sleep(interval)
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass  # client went away mid-stream
 
         def _index(self):
             """Serve the web UI (the reference runs a separate Nuxt app on

@@ -369,11 +369,13 @@ def _decode_chunk_native(rr, lo: int, hi: int, out: list, base: int) -> bool:
         return False
     from . import native_decode
 
-    triples, thread_s = native_decode.decode_chunk_fused(
-        ctx, rr, lo, hi, skip=_chunk_skip_mask(rr, lo, hi))
-    TRACER.count("decode_chunk_calls_total")
-    TRACER.count("decode_native_thread_seconds", round(thread_s, 6))
-    _assemble_chunk(rr, lo, hi, triples, out, base)
+    with TRACER.span("decode_chunk", lo=lo, hi=hi, path="native_chunk"):
+        triples, thread_s = native_decode.decode_chunk_fused(
+            ctx, rr, lo, hi, skip=_chunk_skip_mask(rr, lo, hi))
+        TRACER.count("decode_chunk_calls_total")
+        TRACER.count("decode_native_thread_seconds", round(thread_s, 6))
+        TRACER.inc("decode_path_total", hi - lo, path="native_chunk")
+        _assemble_chunk(rr, lo, hi, triples, out, base)
     return True
 
 
@@ -403,23 +405,29 @@ def decode_chunk_into(rr, lo: int, hi: int, out: list, base: int = 0) -> None:
         if routed:
             return
         lo = s0  # keep anything the native path already decoded
+    fallback_path = ("native_pod" if _native_ctx(rr.cw) is not None
+                     else "python")
     if hi - lo < 16 or effective_cpu_count() < 2:
         # single-core hosts: the pool's dispatch + recon-lock traffic
         # costs more than the GIL-released C calls can win back
+        TRACER.inc("decode_path_total", hi - lo, path=fallback_path)
         for i in range(lo, hi):
             out[i - base] = decode_pod_result(rr, i)
         return
-    if cc is not None and _native_ctx(rr.cw) is None:
-        # pure-Python path reads codes_of/raw_of/final_of: reconstruct the
-        # chunk once here so pool workers share it.  The fused native path
-        # reads the compact arrays directly — warming recon for it would
-        # re-create exactly the [C,F,N]/[C,S,N] materialization it avoids.
-        # (full-array results — the speculative path — need no recon)
-        rr._chunk_recon(lo // cc.chunk, scores=True)
-    for i, a in zip(range(lo, hi),
-                    _decode_pool().map(lambda i: decode_pod_result(rr, i),
-                                       range(lo, hi))):
-        out[i - base] = a
+    with TRACER.span("decode_chunk", lo=lo, hi=hi, path=fallback_path):
+        TRACER.inc("decode_path_total", hi - lo, path=fallback_path)
+        if cc is not None and _native_ctx(rr.cw) is None:
+            # pure-Python path reads codes_of/raw_of/final_of: reconstruct
+            # the chunk once here so pool workers share it.  The fused
+            # native path reads the compact arrays directly — warming recon
+            # for it would re-create exactly the [C,F,N]/[C,S,N]
+            # materialization it avoids.  (full-array results — the
+            # speculative path — need no recon)
+            rr._chunk_recon(lo // cc.chunk, scores=True)
+        for i, a in zip(range(lo, hi),
+                        _decode_pool().map(lambda i: decode_pod_result(rr, i),
+                                           range(lo, hi))):
+            out[i - base] = a
 
 
 def decode_release_batches(rr, lo: int, hi: int, on_pod=None,
@@ -466,6 +474,7 @@ def decode_release_batches(rr, lo: int, hi: int, on_pod=None,
                 TRACER.count("decode_chunk_calls_total")
                 TRACER.count("decode_native_thread_seconds",
                              round(handle.thread_seconds, 6))
+                TRACER.inc("decode_path_total", b1 - b0, path="native_chunk")
                 sink: list = [None] * (b1 - b0)
                 _assemble_chunk(rr, b0, b1, triples, sink, b0)
                 if on_pod is not None:

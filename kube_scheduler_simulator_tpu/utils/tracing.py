@@ -1,21 +1,38 @@
-"""Tracing and metrics for the scheduling engine.
+"""Wave flight recorder: tracing and metrics for the scheduling engine.
 
 Additive over the reference (SURVEY.md §5: the reference has no tracing
 beyond the per-Pod annotation record; the upstream scheduler only
 blank-imports Prometheus registration, cmd/scheduler/scheduler.go:9-11).
 Here the TPU path gets real observability:
 
-- span timings (compile, device eval, bind, reflect, full wave) in a
-  bounded ring buffer with per-name aggregates;
-- counters (pods scheduled/unschedulable, preemptions, waves);
-- Prometheus text exposition + JSON, served at /metrics and
-  /api/v1/metrics by the simulator server;
+- HIERARCHICAL spans (span/parent ids, thread ids, labels) in a bounded
+  ring buffer with per-name aggregates — the span tree covers
+  compile_workload -> replay_and_decode_stream -> decode_chunk /
+  commit_stream -> gang_quorum -> commit_and_reflect, including spans
+  recorded on the pipelined-commit worker thread (parented explicitly
+  across threads);
+- fixed-bucket HISTOGRAMS and LABELED counters under the upstream
+  kube-scheduler metric names (scheduling_attempt_duration_seconds,
+  framework_extension_point_duration_seconds,
+  plugin_execution_duration_seconds — bucket layouts match upstream
+  pkg/scheduler/metrics/metrics.go);
+- plain counters (pods scheduled/unschedulable, preemptions, waves) —
+  the pre-flight-recorder API, unchanged;
+- valid Prometheus text exposition (# HELP/# TYPE, metric-name
+  sanitization, label escaping; validate_exposition() is the strict
+  checker the tests run against every scrape), served at /metrics;
+- Perfetto / chrome://tracing JSON export of the span tree
+  (GET /api/v1/trace), showing the PR-2 pipeline overlap in one
+  browser load (docs/metrics.md has the walkthrough);
 - optional XLA profile capture via jax.profiler (trace start/stop to a
   directory TensorBoard/xprof can read).
 """
 
 from __future__ import annotations
 
+import itertools
+import os
+import re
 import threading
 import time
 from collections import deque
@@ -24,27 +41,188 @@ from contextlib import contextmanager
 _PREFIX = "kss_tpu"
 
 
+class ProfileStateError(RuntimeError):
+    """Invalid XLA-profile state transition (double start, stop without
+    start) — the server maps it to HTTP 409."""
+
+
+def _exp_buckets(start: float, factor: float, count: int) -> tuple[float, ...]:
+    out, v = [], start
+    for _ in range(count):
+        out.append(v)
+        v *= factor
+    return tuple(out)
+
+
+# upstream kube-scheduler histogram layouts (pkg/scheduler/metrics):
+#   scheduling_attempt_duration_seconds   ExponentialBuckets(0.001, 2, 15)
+#   framework_extension_point_duration_seconds
+#                                         ExponentialBuckets(0.0001, 2, 12)
+#   plugin_execution_duration_seconds     ExponentialBuckets(1e-5, 1.5, 20)
+BUCKETS: dict[str, tuple[float, ...]] = {
+    "scheduling_attempt_duration_seconds": _exp_buckets(0.001, 2, 15),
+    "framework_extension_point_duration_seconds": _exp_buckets(0.0001, 2, 12),
+    "plugin_execution_duration_seconds": _exp_buckets(1e-5, 1.5, 20),
+}
+_DEFAULT_BUCKETS = _exp_buckets(0.001, 2, 15)
+
+_HELP: dict[str, str] = {
+    "scheduling_attempt_duration_seconds":
+        "Per-pod scheduling attempt duration (wave wall amortized over the "
+        "wave's pods on the batched paths), by result.",
+    "framework_extension_point_duration_seconds":
+        "Per-wave extension point duration; prefilter/filter/score are "
+        "apportioned from the fused replay span by evaluated work, bind is "
+        "the commit tail wall time.",
+    "plugin_execution_duration_seconds":
+        "Per-plugin execution duration: real wall time on the host path "
+        "(reserve/permit/prebind/postbind), work-apportioned replay time "
+        "for device-fused filter/score/prefilter (docs/metrics.md).",
+    "pods_scheduled_total": "Pods bound by scheduling waves.",
+    "pods_unschedulable_total": "Pods left pending after a full pass.",
+    "scheduling_waves_total": "Batched scheduling waves run.",
+    "plugin_pods_nodes_evaluated_total":
+        "Pod x node evaluations attributed to a plugin from the replay "
+        "tensors (prefilter: pods screened-in).",
+    "plugin_filter_rejects_total":
+        "Nodes rejected with this plugin as the first failing filter.",
+    "plugin_score_sum_total":
+        "Sum of this plugin's raw scores over feasible nodes of scored pods.",
+    "plugin_prefilter_screens_total":
+        "Pods this PreFilter plugin screened out before the wave compiled.",
+    "gang_quorum_groups_total":
+        "Gang groups per vectorized quorum pass, by decision.",
+    "decode_path_total":
+        "Pods decoded per decoder-ladder path (docs/wave-pipeline.md).",
+}
+
+_NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """A valid Prometheus metric name from an arbitrary span/counter name
+    (dashes, dots, spaces -> '_'; leading digit prefixed)."""
+    s = _NAME_SANITIZE_RE.sub("_", name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_float(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_le(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    return f"{v:g}"
+
+
+class Span:
+    """Handle yielded by Tracer.span(): carries the span id (for explicit
+    cross-thread parenting) and, after exit, the measured seconds."""
+
+    __slots__ = ("id", "parent_id", "name", "seconds")
+
+    def __init__(self, span_id: int, parent_id: int | None, name: str):
+        self.id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.seconds = 0.0
+
+
+class _Hist:
+    """One histogram series (a label set): fixed bounds, per-bucket
+    counts (non-cumulative internally), sum and count."""
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+
 class Tracer:
     def __init__(self, capacity: int = 4096):
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=capacity)
         self._agg: dict[str, dict] = {}
         self._counters: dict[str, float] = {}
+        # labeled counters: name -> {((k, v), ...) sorted: value}
+        self._lcounters: dict[str, dict[tuple, float]] = {}
+        # histograms: name -> {((k, v), ...) sorted: _Hist}
+        self._hists: dict[str, dict[tuple, _Hist]] = {}
+        self._hist_bounds: dict[str, tuple[float, ...]] = {}
         self._profile_dir: str | None = None
+        self._profile_lock = threading.Lock()
+        self._epoch = time.time()
+        self._perf_epoch = time.perf_counter()
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._tids: dict[int, tuple[int, str]] = {}  # ident -> (tid, name)
 
     # ------------------------------------------------------------- spans
 
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current_span_id(self) -> int | None:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        ent = self._tids.get(ident)
+        if ent is None:
+            ent = (len(self._tids) + 1, threading.current_thread().name)
+            self._tids[ident] = ent
+        return ent[0]
+
     @contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, parent: int | None = None, **attrs):
+        """Record a span; nested spans on the same thread parent
+        implicitly, `parent=` parents explicitly across threads (the
+        commit worker parents its chunk spans under the wave's replay
+        span).  Yields a Span whose .id other threads may use and whose
+        .seconds is set on exit."""
+        st = self._stack()
+        sp = Span(next(self._ids),
+                  parent if parent is not None else (st[-1] if st else None),
+                  name)
+        st.append(sp.id)
         t0 = time.perf_counter()
         try:
-            yield
+            yield sp
         finally:
             dt = time.perf_counter() - t0
+            sp.seconds = dt
+            st.pop()
             with self._lock:
-                self._events.append(
-                    {"name": name, "t": time.time(), "seconds": dt, **attrs}
-                )
+                tid = self._tid()
+                self._events.append({
+                    "name": name, "t": time.time(), "seconds": dt,
+                    "ts": round(t0 - self._perf_epoch, 6),
+                    "span_id": sp.id, "parent_id": sp.parent_id, "tid": tid,
+                    **attrs,
+                })
                 a = self._agg.setdefault(
                     name, {"count": 0, "total_seconds": 0.0, "max_seconds": 0.0}
                 )
@@ -52,9 +230,45 @@ class Tracer:
                 a["total_seconds"] += dt
                 a["max_seconds"] = max(a["max_seconds"], dt)
 
+    # ---------------------------------------------------------- counters
+
     def count(self, name: str, n: float = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
+
+    def inc(self, name: str, n: float = 1, **labels) -> None:
+        """Labeled counter increment; identical label sets merge
+        regardless of keyword order."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            series = self._lcounters.setdefault(name, {})
+            series[key] = series.get(key, 0) + n
+
+    # --------------------------------------------------------- histograms
+
+    def observe(self, name: str, value: float, n: int = 1, **labels) -> None:
+        """Histogram observation (n identical observations at once — the
+        batched waves amortize one wall time over many pods).  Buckets
+        come from BUCKETS[name] (upstream layouts) or the default
+        exponential ladder."""
+        if n <= 0:
+            return
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            bounds = self._hist_bounds.get(name)
+            if bounds is None:
+                bounds = self._hist_bounds[name] = BUCKETS.get(
+                    name, _DEFAULT_BUCKETS)
+            series = self._hists.setdefault(name, {})
+            h = series.get(key)
+            if h is None:
+                h = series[key] = _Hist(len(bounds))
+            i = 0
+            while i < len(bounds) and value > bounds[i]:
+                i += 1
+            h.counts[i] += n
+            h.sum += value * n
+            h.count += n
 
     # ------------------------------------------------------------ export
 
@@ -64,6 +278,9 @@ class Tracer:
         return evs[-limit:]
 
     def summary(self) -> dict:
+        """Back-compat aggregate view: span aggregates + plain counters
+        (the pre-flight-recorder shape; snapshot() adds the labeled
+        families)."""
         with self._lock:
             spans = {
                 k: {**v, "avg_seconds": v["total_seconds"] / max(v["count"], 1)}
@@ -71,53 +288,343 @@ class Tracer:
             }
             return {"spans": spans, "counters": dict(self._counters)}
 
+    def snapshot(self) -> dict:
+        """Full metrics snapshot: summary() plus labeled counters and
+        histogram series — what /api/v1/metrics, the SSE stream and the
+        bench artifact emit."""
+        out = self.summary()
+        with self._lock:
+            out["time"] = time.time()
+            out["labeled_counters"] = {
+                name: [{"labels": dict(key), "value": v}
+                       for key, v in sorted(series.items())]
+                for name, series in sorted(self._lcounters.items())
+            }
+            out["histograms"] = {
+                name: {
+                    "buckets": list(self._hist_bounds[name]),
+                    "series": [
+                        {"labels": dict(key), "counts": list(h.counts),
+                         "sum": round(h.sum, 9), "count": h.count}
+                        for key, h in sorted(series.items())
+                    ],
+                }
+                for name, series in sorted(self._hists.items())
+            }
+        return out
+
+    # ------------------------------------------------------- prometheus
+
+    @staticmethod
+    def _render_labels(pairs: tuple, extra: str = "") -> str:
+        parts = [f'{k}="{escape_label_value(v)}"' for k, v in pairs]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
     def prometheus_text(self) -> str:
-        """Prometheus exposition format (the observable analogue of the
-        upstream scheduler's /metrics)."""
-        s = self.summary()
-        out = []
-        for name, v in sorted(s["counters"].items()):
-            m = f"{_PREFIX}_{name}"
-            out.append(f"# TYPE {m} counter")
-            out.append(f"{m} {v}")
-        for name, a in sorted(s["spans"].items()):
-            m = f"{_PREFIX}_span_{name}"
-            out.append(f"# TYPE {m}_seconds_total counter")
-            out.append(f"{m}_seconds_total {a['total_seconds']}")
-            out.append(f"# TYPE {m}_count counter")
-            out.append(f"{m}_count {a['count']}")
-            out.append(f"# TYPE {m}_seconds_max gauge")
-            out.append(f"{m}_seconds_max {a['max_seconds']}")
+        """Prometheus text exposition (the observable analogue of the
+        upstream scheduler's /metrics).  Always passes
+        validate_exposition(): # HELP/# TYPE per family, sanitized
+        metric names, escaped label values, cumulative histogram
+        buckets ending at +Inf."""
+        with self._lock:
+            counters = dict(self._counters)
+            lcounters = {n: dict(s) for n, s in self._lcounters.items()}
+            hists = {
+                n: (self._hist_bounds[n],
+                    {k: (list(h.counts), h.sum, h.count)
+                     for k, h in s.items()})
+                for n, s in self._hists.items()
+            }
+            aggs = {k: dict(v) for k, v in self._agg.items()}
+        out: list[str] = []
+
+        def family(name: str, mtype: str, help_key: str | None = None) -> str:
+            m = sanitize_metric_name(f"{_PREFIX}_{name}")
+            h = _HELP.get(help_key or name, f"{name} ({mtype}).")
+            out.append(f"# HELP {m} {_escape_help(h)}")
+            out.append(f"# TYPE {m} {mtype}")
+            return m
+
+        for name, v in sorted(counters.items()):
+            m = family(name, "counter")
+            out.append(f"{m} {_fmt_float(v)}")
+        for name, series in sorted(lcounters.items()):
+            m = family(name, "counter")
+            for key, v in sorted(series.items()):
+                out.append(f"{m}{self._render_labels(key)} {_fmt_float(v)}")
+        for name, (bounds, series) in sorted(hists.items()):
+            m = family(name, "histogram")
+            for key, (bcounts, hsum, hcount) in sorted(series.items()):
+                cum = 0
+                for bound, c in zip((*bounds, float("inf")), bcounts):
+                    cum += c
+                    le = f'le="{_fmt_le(bound)}"'
+                    out.append(
+                        f"{m}_bucket{self._render_labels(key, le)} {cum}")
+                out.append(f"{m}_sum{self._render_labels(key)} "
+                           f"{_fmt_float(hsum)}")
+                out.append(f"{m}_count{self._render_labels(key)} {hcount}")
+        for name, a in sorted(aggs.items()):
+            base = f"span_{name}"
+            m = family(f"{base}_seconds_total", "counter", help_key=base)
+            out.append(f"{m} {_fmt_float(a['total_seconds'])}")
+            m = family(f"{base}_count", "counter", help_key=base)
+            out.append(f"{m} {_fmt_float(a['count'])}")
+            m = family(f"{base}_seconds_max", "gauge", help_key=base)
+            out.append(f"{m} {_fmt_float(a['max_seconds'])}")
         return "\n".join(out) + "\n"
+
+    # --------------------------------------------------------- perfetto
+
+    def perfetto(self, limit: int | None = None) -> dict:
+        """chrome://tracing / Perfetto JSON of the recorded span tree.
+
+        Complete events ("ph": "X") on per-thread tracks; ts/dur in
+        microseconds since the tracer epoch.  Span/parent ids ride in
+        args so the tree survives even across thread tracks (the
+        commit worker's commit_stream spans visibly overlap the
+        replay_and_decode_stream parent on another track —
+        docs/metrics.md walkthrough)."""
+        with self._lock:
+            evs = list(self._events)
+            tids = dict(self._tids)
+        if limit is not None:
+            evs = evs[-limit:] if limit > 0 else []  # evs[-0:] is ALL
+        pid = os.getpid()
+        trace: list[dict] = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "kss-tpu-simulator"},
+        }]
+        for tid, tname in sorted(tids.values()):
+            trace.append({"name": "thread_name", "ph": "M", "pid": pid,
+                          "tid": tid, "args": {"name": tname}})
+        for ev in evs:
+            if "span_id" not in ev:
+                continue
+            args = {k: v for k, v in ev.items()
+                    if k not in ("name", "t", "ts", "seconds", "tid")}
+            trace.append({
+                "name": ev["name"], "cat": "wave", "ph": "X",
+                "ts": int(ev["ts"] * 1e6),
+                "dur": max(1, int(ev["seconds"] * 1e6)),
+                "pid": pid, "tid": ev["tid"], "args": args,
+            })
+        return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
     def reset(self) -> None:
         with self._lock:
             self._events.clear()
             self._agg.clear()
             self._counters.clear()
+            self._lcounters.clear()
+            self._hists.clear()
+            self._hist_bounds.clear()
 
     # -------------------------------------------------------- XLA profile
 
     def start_xla_profile(self, log_dir: str) -> None:
         import jax
 
-        if self._profile_dir is not None:
-            raise RuntimeError(f"profile already running into {self._profile_dir}")
-        jax.profiler.start_trace(log_dir)
-        self._profile_dir = log_dir
+        with self._profile_lock:
+            if self._profile_dir is not None:
+                raise ProfileStateError(
+                    f"profile already running into {self._profile_dir}")
+            try:
+                jax.profiler.start_trace(log_dir)
+            except RuntimeError as e:
+                # a profiler session started outside this Tracer — still a
+                # state conflict, not a server error
+                raise ProfileStateError(str(e)) from e
+            self._profile_dir = log_dir
 
     def stop_xla_profile(self) -> str:
         import jax
 
-        if self._profile_dir is None:
-            raise RuntimeError("no profile running")
-        jax.profiler.stop_trace()
-        d, self._profile_dir = self._profile_dir, None
-        return d
+        with self._profile_lock:
+            if self._profile_dir is None:
+                raise ProfileStateError("no profile running")
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError as e:
+                # the profiler session died outside this Tracer — clear
+                # our state (nothing is running) and report the conflict
+                # as a 409, not a server error
+                self._profile_dir = None
+                raise ProfileStateError(str(e)) from e
+            d, self._profile_dir = self._profile_dir, None
+            return d
 
     @property
     def profiling(self) -> bool:
         return self._profile_dir is not None
+
+
+# ------------------------------------------------- exposition validator
+
+
+def _parse_label_body(body: str) -> list[tuple[str, str]]:
+    """Parse the inside of {...}, honoring \\", \\\\ and \\n escapes.
+    Raises ValueError on malformed input."""
+    pairs: list[tuple[str, str]] = []
+    i, n = 0, len(body)
+    while i < n:
+        j = body.find("=", i)
+        if j < 0:
+            raise ValueError(f"label without '=': {body[i:]!r}")
+        name = body[i:j]
+        if not _LABEL_NAME_RE.match(name):
+            raise ValueError(f"invalid label name {name!r}")
+        if j + 1 >= n or body[j + 1] != '"':
+            raise ValueError(f"unquoted label value after {name!r}")
+        i = j + 2
+        val: list[str] = []
+        while True:
+            if i >= n:
+                raise ValueError(f"unterminated label value for {name!r}")
+            c = body[i]
+            if c == "\\":
+                if i + 1 >= n or body[i + 1] not in ('"', "\\", "n"):
+                    raise ValueError(f"bad escape in label value for {name!r}")
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[body[i + 1]])
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            elif c == "\n":
+                raise ValueError("raw newline in label value")
+            else:
+                val.append(c)
+                i += 1
+        pairs.append((name, "".join(val)))
+        if i < n:
+            if body[i] != ",":
+                raise ValueError(f"expected ',' between labels at {body[i:]!r}")
+            i += 1
+    return pairs
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(?:\{(.*)\})?"                         # optional label body
+    r" (NaN|[+-]?Inf|[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)"  # value
+    r"(?: (-?[0-9]+))?$"                     # optional timestamp
+)
+
+
+def validate_exposition(text: str) -> dict:
+    """Strict Prometheus text-format (0.0.4) validator.
+
+    Checks: final newline; # HELP/# TYPE syntax, at most one each per
+    family and both before the family's samples; valid metric/label
+    names; quoted + escaped label values; parseable sample values; no
+    duplicate label names per sample; family samples not interleaved;
+    histogram families carry cumulative _bucket series per label set
+    ending at le="+Inf", with matching _count and a _sum.
+
+    Returns {family: {"type", "help", "samples": [(name, labels, value)]}}.
+    Raises ValueError with the offending line on any violation.
+    """
+    if not text.endswith("\n"):
+        raise ValueError("exposition must end with a newline")
+    families: dict[str, dict] = {}
+    current: str | None = None
+    closed: set[str] = set()
+
+    def fam(name: str) -> dict:
+        return families.setdefault(
+            name, {"type": None, "help": None, "samples": []})
+
+    for lineno, line in enumerate(text.split("\n")[:-1], 1):
+        if line == "":
+            continue
+        try:
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                kind = line[2:6]
+                rest = line[7:]
+                name, _, payload = rest.partition(" ")
+                if not _METRIC_NAME_RE.match(name):
+                    raise ValueError(f"invalid metric name {name!r}")
+                f = fam(name)
+                if f["samples"]:
+                    raise ValueError(f"# {kind} after samples of {name}")
+                if kind == "HELP":
+                    if f["help"] is not None:
+                        raise ValueError(f"duplicate # HELP for {name}")
+                    f["help"] = payload
+                else:
+                    if f["type"] is not None:
+                        raise ValueError(f"duplicate # TYPE for {name}")
+                    if payload not in ("counter", "gauge", "histogram",
+                                       "summary", "untyped"):
+                        raise ValueError(f"invalid type {payload!r}")
+                    f["type"] = payload
+                continue
+            if line.startswith("#"):
+                continue  # plain comment
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                raise ValueError("unparseable sample line")
+            name, body, value = m.group(1), m.group(2), m.group(3)
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                cand = name[: -len(suffix)] if name.endswith(suffix) else None
+                if cand and families.get(cand, {}).get("type") == "histogram":
+                    base = cand
+                    break
+            labels = _parse_label_body(body) if body else []
+            seen = set()
+            for k, _v in labels:
+                if k in seen:
+                    raise ValueError(f"duplicate label {k!r}")
+                seen.add(k)
+            float(value.replace("Inf", "inf"))  # parse check
+            if base != current:
+                if base in closed:
+                    raise ValueError(f"samples of {base} interleaved")
+                if current is not None:
+                    closed.add(current)
+                current = base
+            fam(base)["samples"].append((name, dict(labels), value))
+        except ValueError as e:
+            raise ValueError(f"line {lineno}: {e} — {line!r}") from None
+
+    for name, f in families.items():
+        if f["type"] != "histogram":
+            continue
+        series: dict[tuple, dict] = {}
+        for sname, labels, value in f["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            s = series.setdefault(key, {"buckets": [], "sum": None,
+                                        "count": None})
+            if sname == name + "_bucket":
+                if "le" not in labels:
+                    raise ValueError(f"{name}_bucket without le label")
+                s["buckets"].append(
+                    (float(labels["le"].replace("Inf", "inf")), float(value)))
+            elif sname == name + "_sum":
+                s["sum"] = float(value)
+            elif sname == name + "_count":
+                s["count"] = float(value)
+            else:
+                raise ValueError(f"stray sample {sname!r} in histogram {name}")
+        for key, s in series.items():
+            if not s["buckets"] or s["buckets"][-1][0] != float("inf"):
+                raise ValueError(f"histogram {name}{dict(key)} lacks a "
+                                 "+Inf bucket")
+            les = [le for le, _ in s["buckets"]]
+            if les != sorted(les):
+                raise ValueError(f"histogram {name} buckets out of order")
+            counts = [c for _, c in s["buckets"]]
+            if counts != sorted(counts):
+                raise ValueError(f"histogram {name} buckets not cumulative")
+            if s["sum"] is None or s["count"] is None:
+                raise ValueError(f"histogram {name} missing _sum or _count")
+            if s["count"] != counts[-1]:
+                raise ValueError(f"histogram {name} _count != +Inf bucket")
+    return families
 
 
 TRACER = Tracer()

@@ -32,6 +32,11 @@ const API = {
   scenarios: () => api("GET", "/api/v1/scenarios"),
   submitScenario: (s) => api("POST", "/api/v1/scenarios", s),
   metrics: () => api("GET", "/api/v1/metrics"),
+  // flight-recorder surface (docs/metrics.md): the full snapshot
+  // (histograms + labeled counters) and the Perfetto span-tree export
+  getMetrics: () => API.metrics(),
+  getTrace: (limit) =>
+    api("GET", "/api/v1/trace" + (limit ? "?limit=" + limit : "")),
 };
 
 // ---- watch stream (web/api/v1/watcher.ts analogue: fetch ReadableStream

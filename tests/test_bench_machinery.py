@@ -170,3 +170,98 @@ def test_measure_gang_shape_reports_counters():
     assert r["counters"].get("gang_quorum_rollbacks_total", 0) >= 1
     assert r["parked"] == 2
     assert r["bound"] == 3 * 3 + 4
+
+
+# ------------------------------------------------------- bench-check
+
+
+def _bench_check():
+    """Load docs/bench/bench_check.py (make bench-check) as a module."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(bench.__file__).parent / "docs" / "bench" / "bench_check.py"
+    spec = importlib.util.spec_from_file_location("bench_check", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_line(value=900.0, decode=1500.0, overlap=1.4, eng_cps=870.0):
+    return {"metric": "m", "value": value, "unit": "cycles/s",
+            "extra": {"decode_pods_per_sec": decode,
+                      "engine_2k_1k": {
+                          "pods": 2000, "cycles_per_sec": eng_cps,
+                          "counters": {
+                              "commit_stream_overlap_seconds": overlap}}}}
+
+
+def test_bench_check_ok_and_regressions():
+    bc = _bench_check()
+    rows = bc.compare(_bench_line(), _bench_line())
+    assert all(r["status"] == "ok" for r in rows)
+    # >15% drop of a higher-is-better metric fails
+    rows = {r["metric"]: r for r in bc.compare(
+        _bench_line(), _bench_line(decode=1500.0 * 0.8))}
+    assert rows["decode_pods_per_sec"]["status"] == "regression"
+    # a 15%-tolerated drift passes
+    rows = {r["metric"]: r for r in bc.compare(
+        _bench_line(), _bench_line(decode=1500.0 * 0.9))}
+    assert rows["decode_pods_per_sec"]["status"] == "ok"
+    # wave wall is lower-is-better: slower engine (lower cps -> higher
+    # wall) regresses
+    rows = {r["metric"]: r for r in bc.compare(
+        _bench_line(), _bench_line(eng_cps=870.0 * 0.8))}
+    assert rows["engine_2k_1k_wave_wall_seconds"]["status"] == "regression"
+
+
+def test_bench_check_skips_missing_metrics():
+    bc = _bench_check()
+    old = _bench_line()
+    new = _bench_line()
+    del new["extra"]["engine_2k_1k"]  # e.g. a fallback round
+    rows = {r["metric"]: r for r in bc.compare(old, new)}
+    assert rows["engine_2k_1k_wave_wall_seconds"]["status"] == "skip"
+    assert rows["commit_stream_overlap_seconds"]["status"] == "skip"
+    assert rows["headline_e2e_cycles_per_sec"]["status"] == "ok"
+
+
+def test_bench_check_extracts_line_from_round_tail():
+    import json
+
+    bc = _bench_check()
+    line = _bench_line()
+    doc = {"n": 6, "cmd": "python bench.py", "rc": 0,
+           "tail": "noise\nmore noise\n" + json.dumps(line) + "\n"}
+    assert bc.extract_bench_line(doc) == line
+    assert bc.extract_bench_line({"tail": "no json here"}) is None
+
+
+def test_bench_check_main_exit_codes(tmp_path):
+    import json
+
+    bc = _bench_check()
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "tail": json.dumps(_bench_line()) + "\n"}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "tail": json.dumps(_bench_line(decode=100.0)) + "\n"}))
+    assert bc.main(["--dir", str(tmp_path)]) == 1
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "tail": json.dumps(_bench_line(decode=1600.0)) + "\n"}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+    # a single round: nothing to compare, success
+    (tmp_path / "BENCH_r02.json").unlink()
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_measure_engine_emits_metrics_snapshot():
+    """The BENCH artifact carries the flight-recorder families
+    (docs/metrics.md): upstream-named histograms + per-plugin labeled
+    counters ride every measure_engine result."""
+    r = bench.measure_engine(24, 6, seed=0)
+    hists = r["metrics"]["histograms"]
+    assert "scheduling_attempt_duration_seconds" in hists
+    assert "plugin_execution_duration_seconds" in hists
+    lc = r["metrics"]["labeled_counters"]
+    assert "plugin_pods_nodes_evaluated_total" in lc
+    assert "decode_path_total" in lc

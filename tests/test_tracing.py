@@ -62,6 +62,14 @@ def test_metrics_http_endpoints():
         with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
             assert r.headers["Content-Type"].startswith("text/plain")
             r.read()
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert r.status == 200
+        # scheduler loop not started -> not ready
+        try:
+            urllib.request.urlopen(base + "/readyz", timeout=10)
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
         req = urllib.request.Request(
             base + "/api/v1/profile", data=json.dumps({"action": "nope"}).encode(),
             method="POST", headers={"Content-Type": "application/json"})
