@@ -7,7 +7,8 @@
 
 PY ?= python
 
-.PHONY: codec native-asan test test-asan bench bench-check smoke clean \
+.PHONY: codec native-asan native-tsan test test-asan test-tsan analyze \
+        bench bench-check smoke clean \
         parity-fullscale parity-fullscale-device multichip-scaling \
         host-probe tpu-watch
 
@@ -46,7 +47,24 @@ native-asan:
 test-asan:
 	$(PY) -m pytest tests/test_native_asan.py -q -m slow
 
-test:
+# ThreadSanitizer build of the codec; the slow test in
+# tests/test_native_tsan.py runs the 4-thread concurrent chunk-decode
+# soak against it (suppressions scope TSan to the codec's own threads —
+# see native/tsan_suppressions.txt and docs/static-analysis.md)
+native-tsan:
+	$(PY) -c "from kube_scheduler_simulator_tpu.native import build_codec, TSAN_FLAGS; print(build_codec('kube_scheduler_simulator_tpu/native/_annotation_codec_tsan.so', extra_flags=TSAN_FLAGS))"
+
+test-tsan:
+	$(PY) -m pytest tests/test_native_tsan.py -q -m slow
+
+# the kss-analyze static suite (docs/static-analysis.md): lock
+# discipline, device purity, observability conformance.  Pure AST — no
+# JAX import, no device; exits nonzero on any finding not suppressed
+# in-source or grandfathered in tools/analysis/baseline.json
+analyze:
+	$(PY) -m tools.analysis
+
+test: analyze
 	$(PY) -m pytest tests/ -q -m "not slow"
 
 bench:
@@ -68,5 +86,6 @@ smoke:
 
 clean:
 	rm -f kube_scheduler_simulator_tpu/native/_annotation_codec.so \
-	    kube_scheduler_simulator_tpu/native/_annotation_codec_asan.so
+	    kube_scheduler_simulator_tpu/native/_annotation_codec_asan.so \
+	    kube_scheduler_simulator_tpu/native/_annotation_codec_tsan.so
 	find . -name __pycache__ -type d -exec rm -rf {} +

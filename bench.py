@@ -1137,6 +1137,16 @@ def _run(args):
         },
         "vs_baseline_device_only": round(main_fig["device_only_cps"] / par_cps, 1),
     })
+    # record the kss-analyze verdict for the tree this round ran from:
+    # bench-check refuses to compare a round produced with outstanding
+    # analyzer findings (a hot-path pod-loop or a blocking-under-lock
+    # hold skews exactly the metrics the gate protects)
+    try:
+        from tools.analysis import analysis_verdict
+        extra["analysis"] = analysis_verdict()
+    except Exception as e:  # never fail a bench run over the analyzer
+        extra["analysis"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     # claim stdout before emitting the one JSON line: if the hang
     # watchdog fired mid-run (a wedged device op that later RETURNED
     # instead of raising), its fallback child owns stdout — park until

@@ -117,6 +117,15 @@ def main(argv: list[str]) -> int:
         bad = prev_p.name if prev is None else new_p.name
         print(f"bench-check: no bench JSON line found in {bad}")
         return 2
+    analysis = (new.get("extra") or {}).get("analysis") or {}
+    if analysis.get("new_findings"):
+        print(f"bench-check: REFUSING to compare — {new_p.name} was "
+              f"produced from a tree with {analysis['new_findings']} "
+              f"outstanding kss-analyze finding(s); a hot-path or lock "
+              f"violation invalidates the round (run `make analyze`)")
+        for line in analysis.get("findings") or []:
+            print(f"  {line}")
+        return 2
     print(f"bench-check: {prev_p.name} -> {new_p.name} "
           f"(threshold {args.threshold:.0%})")
     rc = 0

@@ -283,7 +283,11 @@ class ObjectStore:
             cur = self._objects[resource].get(key)
             if cur is None:
                 raise NotFound(f"{resource} \"{key}\" not found")
-            return copy.deepcopy(cur) if copy_object else cur
+        # deep copy OUTSIDE the lock hold: stored objects are replaced,
+        # never mutated in place (the update() contract), so the
+        # reference grabbed under the lock is an immutable snapshot and
+        # the O(object) copy must not serialize every other store user
+        return copy.deepcopy(cur) if copy_object else cur
 
     def list(self, resource: str, namespace: str | None = None,
              label_selector: dict | None = None,
@@ -308,8 +312,15 @@ class ObjectStore:
                 if label_selector is not None and not object_matches_label_selector(
                         label_selector, obj):
                     continue
-                items.append(copy.deepcopy(obj) if copy_objects else obj)
-            return items, self._last_rv
+                items.append(obj)
+            rv = self._last_rv
+        if copy_objects:
+            # the listing snapshot is the references; the O(N x object)
+            # deep copies run outside the lock hold (stored objects are
+            # replace-on-update, so the refs cannot change underneath) —
+            # a 10k-pod copying list() must not stall writers/watchers
+            items = [copy.deepcopy(obj) for obj in items]
+        return items, rv
 
     def _validate_pod_update(self, key: str, cur: dict, obj: dict) -> None:
         """apiserver validation: spec.nodeName is write-once (only the
@@ -432,17 +443,27 @@ class ObjectStore:
         """Full keyspace snapshot (the etcd-prefix dump reset takes at boot,
         reference: reset/reset.go:32-55)."""
         with self._lock:
-            return copy.deepcopy(self._objects)
+            # shallow per-resource snapshot under the lock pins the exact
+            # keyspace state; the heavy deep copy happens outside it
+            # (stored objects are never mutated in place)
+            snap = {r: dict(objs) for r, objs in self._objects.items()}
+        return copy.deepcopy(snap)
 
     def restore(self, kvs: dict) -> None:
         """Delete-prefix + re-put (reference: reset/reset.go:57-78).  Watch
         subscribers receive DELETED/ADDED events for the transition."""
+        # copy the incoming keyspace BEFORE taking the lock: the caller's
+        # dicts must not be shared with stored state, but the O(keyspace)
+        # deep copy has no business inside the write lock hold
+        copies = {resource: {key: copy.deepcopy(obj)
+                             for key, obj in objs.items()}
+                  for resource, objs in kvs.items()}
         with self._lock:
             for resource in list(self.resources):
                 for key in list(self._objects[resource]):
                     cur = self._objects[resource].pop(key)
                     self._notify(resource, DELETED, cur, self._next_rv())
-            for resource, objs in kvs.items():
+            for resource, objs in copies.items():
                 if resource not in self.resources and objs:
                     # a dump from a store with registered extras: infer
                     # the registration from the objects themselves
@@ -452,7 +473,6 @@ class ObjectStore:
                         namespaced="/" in next(iter(objs)),
                         api_version=first.get("apiVersion") or "v1")
                 for key, obj in objs.items():
-                    obj = copy.deepcopy(obj)
                     self._objects[resource][key] = obj
                     self._notify(resource, ADDED, obj, self._next_rv())
 

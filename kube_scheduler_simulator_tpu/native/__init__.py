@@ -24,6 +24,12 @@ BUILD_CMD = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread"]
 ASAN_FLAGS = ["-g", "-fsanitize=address,undefined",
               "-fno-sanitize-recover=undefined"]
 
+# `make native-tsan` recipe: ThreadSanitizer build for the concurrent
+# chunk-decode soak (tests/test_native_tsan.py) — the codec's worker
+# pool, per-call arenas and cross-chunk FilterCaches are exactly the
+# kind of hand-rolled concurrency TSan exists for
+TSAN_FLAGS = ["-g", "-fsanitize=thread"]
+
 
 def build_codec(so: str | None = None,
                 extra_flags: list[str] | tuple[str, ...] = ()) -> str:
@@ -233,7 +239,10 @@ def get_lib():
         if _lib is None and not _tried:
             _tried = True
             try:
-                _lib = _build_and_load()
+                # the g++ build/dlopen runs under the module lock ON
+                # PURPOSE: concurrent first users must block until the
+                # one-shot build lands rather than race the compiler
+                _lib = _build_and_load()  # kss-analyze: allow(blocking-under-lock)
             except Exception:
                 _lib = None
     return _lib

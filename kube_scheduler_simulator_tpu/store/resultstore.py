@@ -147,51 +147,79 @@ class ResultStore:
     def get_stored_result(self, pod: dict) -> dict[str, str] | None:
         meta = pod.get("metadata") or {}
         k = _key(meta.get("namespace") or "default", meta.get("name", ""))
+
+        def snap2(d):
+            # two-level snapshot: granular adds mutate the inner
+            # per-node dicts in place, so sharing them outside the lock
+            # would race the marshal below
+            return {node: dict(plugins) for node, plugins in d.items()}
+
         with self._mu:
             r = self._results.get(k)
             if r is None:
                 return None
+            # the lock hold is ONLY these O(keys) reference snapshots;
+            # the JSON decode/merge/encode of the (potentially ~MB)
+            # blobs runs after release so concurrent granular adds and
+            # the engine's put_decoded never queue behind serialization
+            # (the PR 2 encode-off-the-store-lock rule, enforced by
+            # kss-analyze serialize-under-lock)
             out = dict(r.decoded)
+            pre_filter_result = {p: list(v)
+                                 for p, v in r.pre_filter_result.items()}
+            pre_filter_status = dict(r.pre_filter_status)
+            filt = snap2(r.filter)
+            post_filter = snap2(r.post_filter)
+            pre_score = dict(r.pre_score)
+            score = snap2(r.score)
+            final_score = snap2(r.final_score)
+            reserve = dict(r.reserve)
+            permit = dict(r.permit)
+            permit_timeout = dict(r.permit_timeout)
+            prebind = dict(r.prebind)
+            bind = dict(r.bind)
+            custom = dict(r.custom)
+            selected_node = r.selected_node
 
-            def put(key, granular, nested=False):
-                """Merge granular adds OVER the decoded blob for the key:
-                a custom plugin's Reserve result must not erase an
-                in-tree plugin's decoded entry under the same key."""
-                if not granular:
-                    if key not in out:
-                        out[key] = ann.marshal({} if not isinstance(granular, str) else "")
-                    return
-                base = {}
-                if key in out:
-                    try:
-                        base = json.loads(out[key])
-                    except ValueError:
-                        base = {}
-                    if not isinstance(base, dict):
-                        base = {}
-                if nested:
-                    for node, plugins in granular.items():
-                        base.setdefault(node, {}).update(plugins)
-                else:
-                    base.update(granular)
-                out[key] = ann.marshal(base)
+        def put(key, granular, nested=False):
+            """Merge granular adds OVER the decoded blob for the key:
+            a custom plugin's Reserve result must not erase an
+            in-tree plugin's decoded entry under the same key."""
+            if not granular:
+                if key not in out:
+                    out[key] = ann.marshal({} if not isinstance(granular, str) else "")
+                return
+            base = {}
+            if key in out:
+                try:
+                    base = json.loads(out[key])
+                except ValueError:
+                    base = {}
+                if not isinstance(base, dict):
+                    base = {}
+            if nested:
+                for node, plugins in granular.items():
+                    base.setdefault(node, {}).update(plugins)
+            else:
+                base.update(granular)
+            out[key] = ann.marshal(base)
 
-            put(ann.PRE_FILTER_RESULT, r.pre_filter_result)
-            put(ann.PRE_FILTER_STATUS_RESULT, r.pre_filter_status)
-            put(ann.FILTER_RESULT, r.filter, nested=True)
-            put(ann.POST_FILTER_RESULT, r.post_filter, nested=True)
-            put(ann.PRE_SCORE_RESULT, r.pre_score)
-            put(ann.SCORE_RESULT, r.score, nested=True)
-            put(ann.FINAL_SCORE_RESULT, r.final_score, nested=True)
-            put(ann.RESERVE_RESULT, r.reserve)
-            put(ann.PERMIT_STATUS_RESULT, r.permit)
-            put(ann.PERMIT_TIMEOUT_RESULT, r.permit_timeout)
-            put(ann.PRE_BIND_RESULT, r.prebind)
-            put(ann.BIND_RESULT, r.bind)
-            if r.selected_node or ann.SELECTED_NODE not in out:
-                out[ann.SELECTED_NODE] = r.selected_node
-            out.update(r.custom)
-            return out
+        put(ann.PRE_FILTER_RESULT, pre_filter_result)
+        put(ann.PRE_FILTER_STATUS_RESULT, pre_filter_status)
+        put(ann.FILTER_RESULT, filt, nested=True)
+        put(ann.POST_FILTER_RESULT, post_filter, nested=True)
+        put(ann.PRE_SCORE_RESULT, pre_score)
+        put(ann.SCORE_RESULT, score, nested=True)
+        put(ann.FINAL_SCORE_RESULT, final_score, nested=True)
+        put(ann.RESERVE_RESULT, reserve)
+        put(ann.PERMIT_STATUS_RESULT, permit)
+        put(ann.PERMIT_TIMEOUT_RESULT, permit_timeout)
+        put(ann.PRE_BIND_RESULT, prebind)
+        put(ann.BIND_RESULT, bind)
+        if selected_node or ann.SELECTED_NODE not in out:
+            out[ann.SELECTED_NODE] = selected_node
+        out.update(custom)
+        return out
 
     def delete_data(self, pod: dict) -> None:
         meta = pod.get("metadata") or {}

@@ -432,7 +432,11 @@ class Tracer:
                 raise ProfileStateError(
                     f"profile already running into {self._profile_dir}")
             try:
-                jax.profiler.start_trace(log_dir)
+                # profiler start runs under _profile_lock BY DESIGN: the
+                # lock exists solely to make the is-running check and the
+                # start one transition (409 on double start); nothing on
+                # the scheduling path ever takes it
+                jax.profiler.start_trace(log_dir)  # kss-analyze: allow(device-under-lock)
             except RuntimeError as e:
                 # a profiler session started outside this Tracer — still a
                 # state conflict, not a server error
@@ -446,7 +450,9 @@ class Tracer:
             if self._profile_dir is None:
                 raise ProfileStateError("no profile running")
             try:
-                jax.profiler.stop_trace()
+                # same contract as start: _profile_lock serializes only
+                # the profiler state transition itself
+                jax.profiler.stop_trace()  # kss-analyze: allow(device-under-lock)
             except RuntimeError as e:
                 # the profiler session died outside this Tracer — clear
                 # our state (nothing is running) and report the conflict

@@ -14,6 +14,29 @@ from kube_scheduler_simulator_tpu.utils.platform import force_cpu  # noqa: E402
 
 force_cpu(n_virtual_devices=8)
 
+# lock-witness mode (docs/static-analysis.md): KSS_TPU_LOCK_WITNESS=1
+# wraps every lock created from here on in the lockdep-style witness;
+# the concurrency/engine soak modules then FAIL on any acquisition-order
+# cycle, even when the interleaving didn't actually deadlock.  Installed
+# before any test module imports so engines/stores built inside tests
+# get witnessed locks.
+_WITNESS = None
+if os.environ.get("KSS_TPU_LOCK_WITNESS") == "1":
+    from tools.analysis import lockwitness  # noqa: E402
+
+    _WITNESS = lockwitness.install()
+
+_WITNESS_MODULES = {"test_concurrency_soak", "test_engine_soak"}
+
+
+def pytest_runtest_teardown(item):
+    if _WITNESS is None:
+        return
+    mod = getattr(item, "module", None)
+    name = getattr(mod, "__name__", "").rpartition(".")[2]
+    if name in _WITNESS_MODULES:
+        _WITNESS.assert_no_cycles()
+
 
 def pytest_configure(config):
     config.addinivalue_line(

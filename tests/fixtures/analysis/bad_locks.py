@@ -1,0 +1,87 @@
+"""Seeded lock-discipline violations for tests/test_analyze.py.
+
+NEVER imported — analyzed as AST only.  Each class seeds one rule:
+an A->B / B->A lock-order inversion (the PR 3 kubeapi deadlock shape,
+two-lock variant), a helper that reacquires its caller's non-reentrant
+lock (the single-lock variant), blocking/device/serialize work under a
+lock, and a suppressed site proving the allow() comment works.
+"""
+
+import copy
+import json
+import subprocess
+import threading
+import time
+
+import jax.numpy as jnp
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                return 2
+
+
+class SelfDeadlock:
+    """The kubeapi._rv_int shape: a helper that re-takes the lock its
+    caller already holds."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    def _helper(self):
+        with self._lock:
+            self._counter += 1
+            return self._counter
+
+    def caller(self):
+        with self._lock:
+            return self._helper()
+
+
+class BlockingUnderLock:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def sleeps(self):
+        with self._mu:
+            time.sleep(0.1)
+
+    def spawns(self):
+        with self._mu:
+            subprocess.run(["true"])
+
+    def device_work(self):
+        with self._mu:
+            return jnp.zeros((4,)).sum()
+
+    def serializes(self):
+        with self._mu:
+            return json.dumps({"k": copy.deepcopy({"v": 1})})
+
+    def allowed(self):
+        with self._mu:
+            time.sleep(0.01)  # kss-analyze: allow(blocking-under-lock)
+
+
+class AcquireRelease:
+    """acquire()/release() style holds are tracked too."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def manual(self):
+        self._mu.acquire()
+        time.sleep(0.05)
+        self._mu.release()

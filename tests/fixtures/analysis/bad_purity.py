@@ -1,0 +1,42 @@
+"""Seeded device-purity violations (analyzed as AST only, roots declared
+by the test's manifest): a per-pod Python loop in the hot path, a
+host-sync `.item()`, and trace-time nondeterminism inside jitted code.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def hot_entry(pods, nodes, table):
+    total = 0
+    for pod in pods:               # pod-loop
+        total += helper(pod, table)
+    for i in range(len(nodes)):    # pod-loop (range(len(nodes)))
+        total += i
+    return total
+
+
+def helper(pod, table):
+    score = table[pod]
+    return score.item()            # host-sync
+
+
+@jax.jit
+def jitted_step(x):
+    noise = time.time()            # nondeterminism inside jit
+    return jnp.sum(x) + noise
+
+
+def cold_helper(pods):
+    # NOT reachable from the manifest root: must not be flagged
+    return [p for p in pods]
+
+
+def allowed_loop(pods):
+    out = 0
+    # kss-analyze: allow(pod-loop)
+    for p in pods:
+        out += 1
+    return out

@@ -254,6 +254,32 @@ def test_bench_check_main_exit_codes(tmp_path):
     assert bc.main(["--dir", str(tmp_path)]) == 0
 
 
+def test_bench_check_refuses_tainted_round(tmp_path, capsys):
+    """A round produced from a tree with outstanding kss-analyze
+    findings recorded in its JSON invalidates the comparison
+    (docs/static-analysis.md): refuse, don't gate on skewed numbers."""
+    import json
+
+    bc = _bench_check()
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "tail": json.dumps(_bench_line()) + "\n"}))
+    tainted = _bench_line()
+    tainted["extra"]["analysis"] = {
+        "new_findings": 2, "grandfathered": 29,
+        "findings": ["pkg/mod.py:3: [pod-loop] f: loop over pods"]}
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "tail": json.dumps(tainted) + "\n"}))
+    assert bc.main(["--dir", str(tmp_path)]) == 2
+    out = capsys.readouterr().out
+    assert "REFUSING" in out and "pod-loop" in out
+    # a recorded clean verdict (and rounds predating the field) compare
+    clean = _bench_line()
+    clean["extra"]["analysis"] = {"new_findings": 0, "grandfathered": 29}
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "tail": json.dumps(clean) + "\n"}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
 def test_measure_engine_emits_metrics_snapshot():
     """The BENCH artifact carries the flight-recorder families
     (docs/metrics.md): upstream-named histograms + per-plugin labeled

@@ -7,6 +7,7 @@ pods (Python early-out owns them), empty-active-mask pods, host-resident
 score columns, ranges that start mid-chunk, width-tier re-delivery, and
 concurrent chunk calls (per-call arenas must not be shared)."""
 
+import os
 import threading
 
 import numpy as np
@@ -156,6 +157,57 @@ def test_chunk_decode_width_tier_redelivery(monkeypatch):
         assert ca == pa, f"pod {i} diverged after width-tier re-delivery"
 
 
+def _localize_ndarrays(root) -> None:
+    """Replace every numpy array reachable from `root` with a
+    main-thread-owned copy.  The TSan harness (tests/test_native_tsan.py)
+    sets KSS_TPU_TSAN_LOCALIZE=1 so the codec's input buffers are no
+    longer the XLA-allocated pages jaxlib's (uninstrumented) device sync
+    handed over — preload-TSan cannot see that happens-before and would
+    report every input read as a race against the device memset.  The
+    copy keeps the codec's OWN concurrency (worker pool, arenas, caches,
+    output arrays) fully checked."""
+    seen: set[int] = set()
+    stack = [root]
+    while stack:
+        obj = stack.pop()
+        if id(obj) in seen or obj is None:
+            continue
+        seen.add(id(obj))
+        tmod = type(obj).__module__ or ""
+        if tmod.partition(".")[0] in ("jax", "jaxlib", "builtins") \
+                and not isinstance(obj, (dict, list)):
+            continue  # never introspect device arrays / jax internals
+        if isinstance(obj, dict):
+            for k, v in list(obj.items()):
+                if isinstance(v, np.ndarray):
+                    obj[k] = np.array(v, copy=True)
+                elif isinstance(v, (dict, list)) or hasattr(v, "__dict__") \
+                        or hasattr(v, "__slots__"):
+                    stack.append(v)
+        elif isinstance(obj, list):
+            for i, v in enumerate(obj):
+                if isinstance(v, np.ndarray):
+                    obj[i] = np.array(v, copy=True)
+                else:
+                    stack.append(v)
+        elif isinstance(obj, (tuple, set, frozenset, str, bytes)):
+            continue
+        else:
+            names = list(getattr(obj, "__dict__", {}) or ())
+            for cls in type(obj).__mro__:
+                names.extend(getattr(cls, "__slots__", ()))
+            for k in names:
+                try:
+                    v = getattr(obj, k)
+                except AttributeError:
+                    continue
+                if isinstance(v, np.ndarray):
+                    setattr(obj, k, np.array(v, copy=True))
+                elif isinstance(v, (dict, list)) or hasattr(v, "__dict__") \
+                        or hasattr(v, "__slots__"):
+                    stack.append(v)
+
+
 def test_chunk_decode_threaded_soak():
     """Concurrent chunk calls over the same ReplayResult: every call gets
     its own arena, so parallel decoders (pipelined commit + a bench
@@ -164,6 +216,8 @@ def test_chunk_decode_threaded_soak():
     nodes, pods, cfg = baseline_config(4, scale=0.02, seed=13)
     cw = compile_workload(nodes, pods, cfg)
     rr = replay(cw, chunk=32)
+    if os.environ.get("KSS_TPU_TSAN_LOCALIZE") == "1":
+        _localize_ndarrays(rr)
     n = len(pods)
     expected: list = [None] * n
     decode_chunk_into(rr, 0, n, expected)

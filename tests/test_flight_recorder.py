@@ -504,3 +504,66 @@ def test_profile_conflicts_return_409(live_server, monkeypatch):
     finally:
         code, _ = post("stop")
         assert code == 200
+
+
+# --------------------------------------- worker exception span balance
+
+
+def test_mid_chunk_exception_leaves_tracer_balanced(monkeypatch):
+    """A mid-chunk failure on the commit-worker thread must not leak
+    spans: the raising chunk's commit_stream span closes (with-statement
+    unwind), finish()'s commit_and_reflect tail closes before the worker
+    error re-raises on the engine thread, and the /api/v1/trace document
+    stays well-formed (docs/static-analysis.md, unbalanced-span rule)."""
+    TRACER.reset()
+    store = ObjectStore()
+    for n in make_nodes(6, seed=31):
+        store.create("nodes", n)
+    for p in make_pods(48, seed=32):
+        store.create("pods", p)
+    cfg = PluginSetConfig(enabled=[
+        "NodeResourcesFit", "NodeResourcesBalancedAllocation",
+        "NodeAffinity", "TaintToleration", "PodTopologySpread"])
+    engine = SchedulerEngine(store, plugin_config=cfg, chunk=16,
+                             pipeline_commit=True)
+    assert engine._can_stream_commit()
+
+    real = engine.result_store.put_decoded
+    calls = {"n": 0}
+
+    def poisoned(ns, name, annotations):
+        calls["n"] += 1
+        if calls["n"] == 20:  # second chunk, pod 4 of 16: MID-chunk
+            raise RuntimeError("mid-chunk poison")
+        return real(ns, name, annotations)
+
+    monkeypatch.setattr(engine.result_store, "put_decoded", poisoned)
+    with pytest.raises(RuntimeError, match="mid-chunk poison"):
+        engine.schedule_pending()
+
+    evs = TRACER.events(limit=1000)
+    # the span the worker was inside when it raised was still recorded
+    commits = [e for e in evs if e["name"] == "commit_stream"]
+    assert commits, "raising commit_stream span was dropped"
+    assert [e for e in evs if e["name"] == "commit_and_reflect"]
+    # both thread stacks unwound: the engine thread's stack is empty and
+    # every recorded parent_id resolves to a recorded span (a leaked
+    # open span would leave a dangling reference)
+    assert TRACER.current_span_id() is None
+    doc = TRACER.perfetto()
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    ids = {e["args"]["span_id"] for e in xs}
+    for e in xs:
+        for field in ("name", "cat", "ts", "dur", "pid", "tid", "args"):
+            assert field in e, f"{field} missing from {e}"
+        parent = e["args"].get("parent_id")
+        assert parent is None or parent in ids, \
+            f"{e['name']} parents under an unrecorded span {parent}"
+    json.dumps(doc)  # the /api/v1/trace body end to end
+
+    # the recorder (and engine) are not wedged: the next wave schedules
+    # normally and stays balanced
+    before = calls["n"]
+    assert engine.schedule_pending() > 0
+    assert calls["n"] > before
+    assert TRACER.current_span_id() is None

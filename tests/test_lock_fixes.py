@@ -1,0 +1,170 @@
+"""Regression tests for the kss-analyze serialize-under-lock fixes
+(docs/static-analysis.md).
+
+The lock-discipline analyzer flagged the O(object) deep copies and JSON
+marshal work `ObjectStore` and `ResultStore` used to run inside their
+lock holds.  The fixes snapshot references under the lock and run the
+heavy serialization after release; these tests pin that contract — the
+copy/encode must never observe the lock held — plus the snapshot
+semantics that make releasing early safe.
+"""
+
+import copy
+import json
+import threading
+
+import pytest
+
+from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+from kube_scheduler_simulator_tpu.models.workloads import make_nodes, make_pods
+from kube_scheduler_simulator_tpu.store import annotations as ann
+from kube_scheduler_simulator_tpu.store.resultstore import ResultStore
+
+
+def _held(lock) -> bool:
+    """True when `lock` cannot be acquired from a fresh thread, i.e.
+    someone (the caller) holds it right now.  The probe thread is the
+    point: a same-thread try-acquire on the store's RLock would always
+    succeed reentrantly and prove nothing."""
+    out = {}
+
+    def probe():
+        got = lock.acquire(blocking=False)
+        if got:
+            lock.release()
+        out["held"] = not got
+
+    t = threading.Thread(target=probe)
+    t.start()
+    t.join()
+    return out["held"]
+
+
+@pytest.fixture
+def seeded_store():
+    store = ObjectStore()
+    for n in make_nodes(3, seed=21):
+        store.create("nodes", n)
+    for p in make_pods(5, seed=22):
+        store.create("pods", p)
+    return store
+
+
+def _spy_deepcopy(monkeypatch, lock):
+    """Route copy.deepcopy through a wrapper that records whether `lock`
+    was held at call time."""
+    held_at_call: list[bool] = []
+    real = copy.deepcopy
+
+    def spy(obj, *a, **kw):
+        held_at_call.append(_held(lock))
+        return real(obj, *a, **kw)
+
+    monkeypatch.setattr(copy, "deepcopy", spy)
+    return held_at_call
+
+
+def test_objectstore_get_copies_outside_lock(seeded_store, monkeypatch):
+    store = seeded_store
+    name = store.list("pods")[0][0]["metadata"]["name"]
+    held = _spy_deepcopy(monkeypatch, store._lock)
+    pod = store.get("pods", name)
+    assert held and not any(held), "get() deep-copied under the store lock"
+    # releasing early is safe because the copy is still a snapshot: a
+    # caller-side mutation must not reach stored state
+    pod["metadata"]["labels"] = {"mutated": "yes"}
+    assert "mutated" not in (
+        store.get("pods", name)["metadata"].get("labels") or {})
+
+
+def test_objectstore_list_copies_outside_lock(seeded_store, monkeypatch):
+    store = seeded_store
+    held = _spy_deepcopy(monkeypatch, store._lock)
+    pods, _rv = store.list("pods")
+    assert len(pods) == 5
+    assert len(held) == 5 and not any(held), \
+        "list() ran its O(N x object) copies under the store lock"
+    pods[0]["spec"]["nodeName"] = "mutated-node"
+    fresh, _ = store.list("pods")
+    assert all(p["spec"].get("nodeName") != "mutated-node" for p in fresh)
+
+
+def test_objectstore_dump_restore_copy_outside_lock(seeded_store, monkeypatch):
+    store = seeded_store
+    held = _spy_deepcopy(monkeypatch, store._lock)
+    kvs = store.dump()
+    assert held and not any(held), "dump() deep-copied under the store lock"
+
+    held.clear()
+    store.restore(kvs)
+    assert held and not any(held), \
+        "restore() deep-copied the incoming keyspace under the write lock"
+    # restore still detaches from the caller's dicts (the reason the
+    # deepcopy exists at all): mutating the input afterwards must not
+    # reach stored state
+    res = next(r for r, objs in kvs.items() if objs)
+    key = next(iter(kvs[res]))
+    kvs[res][key]["metadata"]["name"] = "clobbered"
+    stored = store.dump()
+    assert stored[res][key]["metadata"]["name"] != "clobbered"
+
+
+def test_resultstore_encode_runs_outside_lock(monkeypatch):
+    rs = ResultStore()
+    rs.put_decoded("default", "p0", {
+        ann.FILTER_RESULT: json.dumps({"nodeA": {"InTree": "fail"}})})
+    rs.add_filter_result("default", "p0", "nodeB", "Custom", "ok")
+    rs.add_score_result("default", "p0", "nodeB", "Custom", 7)
+
+    held_at_marshal: list[bool] = []
+    real = ann.marshal
+
+    def spy(obj):
+        held_at_marshal.append(rs._mu.locked())
+        return real(obj)
+
+    monkeypatch.setattr(ann, "marshal", spy)
+    out = rs.get_stored_result(
+        {"metadata": {"namespace": "default", "name": "p0"}})
+    assert held_at_marshal and not any(held_at_marshal), \
+        "get_stored_result marshalled annotation blobs under _mu"
+    # the merge semantics survived the move: granular adds layer OVER
+    # the decoded blob without erasing other plugins' entries
+    merged = json.loads(out[ann.FILTER_RESULT])
+    assert merged["nodeA"]["InTree"] == "fail"
+    assert merged["nodeB"]["Custom"] == "ok"
+
+
+def test_resultstore_snapshot_isolates_concurrent_adds():
+    """The under-lock part of get_stored_result is a two-level reference
+    snapshot; the marshal outside the lock must therefore never iterate
+    a dict a concurrent granular add is mutating (pre-fix this raced
+    'dictionary changed size during iteration')."""
+    rs = ResultStore()
+    rs.put_decoded("default", "p0", {ann.FILTER_RESULT: ann.marshal({})})
+    pod = {"metadata": {"namespace": "default", "name": "p0"}}
+    stop = threading.Event()
+    errs: list[BaseException] = []
+
+    def hammer():
+        i = 0
+        try:
+            while not stop.is_set():
+                rs.add_filter_result("default", "p0",
+                                     f"node-{i % 37}", "Hammer", "x")
+                rs.add_score_result("default", "p0",
+                                    f"node-{i % 37}", "Hammer", i % 100)
+                i += 1
+        except BaseException as e:  # surfaced in the main thread
+            errs.append(e)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for _ in range(300):
+            out = rs.get_stored_result(pod)
+            json.loads(out[ann.FILTER_RESULT])  # always a complete doc
+    finally:
+        stop.set()
+        t.join()
+    assert not errs

@@ -1,0 +1,196 @@
+"""Lock-witness mode (tools/analysis/lockwitness.py): the lockdep-style
+runtime detector the conftest installs for the soak suites under
+KSS_TPU_LOCK_WITNESS=1.
+
+Covers: A->B/B->A inversion in a fixture thread pair is detected even
+though the interleaving never deadlocks; consistent ordering and RLock
+reentrancy stay clean; Condition wait/notify keeps the held-set correct
+through the release-reacquire; and a witnessed engine run produces
+bit-identical annotations to an unwitnessed one (the golden/parity
+contract with witness mode on).
+"""
+
+import threading
+
+import pytest
+
+from tools.analysis import lockwitness
+from tools.analysis.lockwitness import LockOrderViolation
+
+
+@pytest.fixture
+def witness():
+    w = lockwitness.install()
+    w.reset()
+    try:
+        yield w
+    finally:
+        lockwitness.uninstall()
+
+
+def test_inversion_detected_across_thread_pair(witness):
+    """The acceptance fixture: thread 1 takes A then B, thread 2 takes
+    B then A, with a barrier guaranteeing NO actual deadlock (thread 2
+    starts only after thread 1 released everything).  The witness still
+    reports the cycle — order, not luck, is the property."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    done = threading.Event()
+
+    def t1():
+        with lock_a:
+            with lock_b:
+                pass
+        done.set()
+
+    def t2():
+        done.wait(5)
+        with lock_b:
+            with lock_a:
+                pass
+
+    th1 = threading.Thread(target=t1, name="witness-t1")
+    th2 = threading.Thread(target=t2, name="witness-t2")
+    th1.start()
+    th2.start()
+    th1.join(5)
+    th2.join(5)
+    assert not th1.is_alive() and not th2.is_alive()
+
+    with pytest.raises(LockOrderViolation) as ei:
+        witness.assert_no_cycles()
+    msg = str(ei.value)
+    assert "cycle" in msg and "witness-t1" in msg and "witness-t2" in msg
+
+
+def test_consistent_order_is_clean(witness):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def worker():
+        for _ in range(50):
+            with lock_a:
+                with lock_b:
+                    pass
+
+    ths = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(10)
+    witness.assert_no_cycles()
+    assert any(a != b for (a, b) in witness.edges), \
+        "the consistent A->B edge should have been recorded"
+
+
+def test_nonreentrant_reacquire_is_a_cycle(witness):
+    """The PR 3 kubeapi._rv_int shape, single-lock variant: a helper
+    that re-takes the caller's non-reentrant lock.  Two instances from
+    the same creation site keep it from ACTUALLY deadlocking here; the
+    witness flags the site regardless."""
+    def make():
+        return threading.Lock()  # one site: same lock identity
+
+    outer, inner = make(), make()
+    with outer:
+        with inner:
+            pass
+    with pytest.raises(LockOrderViolation):
+        witness.assert_no_cycles()
+
+
+def test_rlock_reentrancy_clean(witness):
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    witness.assert_no_cycles()
+
+
+def test_condition_wait_releases_held_set(witness):
+    """cv.wait() drops the cv lock from the waiter's held set: a helper
+    lock taken by the NOTIFIER while the waiter sleeps inside wait()
+    must not produce edges from the cv to it on the waiter's thread."""
+    cv = threading.Condition()
+    other = threading.Lock()
+    ready = threading.Event()
+    woke = threading.Event()
+
+    def waiter():
+        with cv:
+            ready.set()
+            cv.wait(5)
+        woke.set()
+
+    t = threading.Thread(target=waiter, name="cv-waiter")
+    t.start()
+    assert ready.wait(5)
+    with other:
+        with cv:
+            cv.notify_all()
+    assert woke.wait(5)
+    t.join(5)
+    witness.assert_no_cycles()
+    # and the waiter's post-wait held set drained (release after wake)
+    assert witness._held() == []
+
+
+def test_queue_and_event_builtin_locks_still_work(witness):
+    import queue
+
+    q = queue.Queue()
+    q.put(1)
+    assert q.get(timeout=1) == 1
+    ev = threading.Event()
+    ev.set()
+    assert ev.wait(1)
+    witness.assert_no_cycles()
+
+
+def test_witnessed_engine_wave_bit_identical():
+    """Golden/parity contract with witness mode on: the same workload
+    scheduled with and without the witness produces byte-identical
+    annotations and bind order, and the witnessed run records no
+    acquisition-order cycle."""
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.models.workloads import make_nodes
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+
+    def run():
+        store = ObjectStore()
+        for n in make_nodes(6, seed=7):
+            store.create("nodes", n)
+        for i in range(12):
+            store.create("pods", {
+                "metadata": {"name": f"w-{i}", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "100m"}}}]}})
+        engine = SchedulerEngine(store, plugin_config=PluginSetConfig(
+            enabled=["NodeResourcesFit",
+                     "NodeResourcesBalancedAllocation"]))
+        engine.schedule_pending()
+        pods, _ = store.list("pods")
+        return {p["metadata"]["name"]:
+                (p["spec"].get("nodeName"),
+                 tuple(sorted((p["metadata"].get("annotations")
+                               or {}).items())))
+                for p in pods}
+
+    baseline = run()
+    w = lockwitness.install()
+    w.reset()
+    try:
+        witnessed = run()
+        w.assert_no_cycles()
+    finally:
+        lockwitness.uninstall()
+    assert witnessed == baseline
+
+
+def test_uninstall_restores_threading():
+    before = (threading.Lock, threading.RLock, threading.Condition)
+    lockwitness.install()
+    lockwitness.uninstall()
+    assert (threading.Lock, threading.RLock,
+            threading.Condition) == before

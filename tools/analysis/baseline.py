@@ -1,0 +1,64 @@
+"""Ratchet baseline for kss-analyze.
+
+The checked-in `tools/analysis/baseline.json` grandfathers known
+findings: `make analyze` exits 0 while every finding is either
+suppressed in-source (`# kss-analyze: allow(rule)`) or listed here with
+a reason.  The ratchet only tightens:
+
+  * a NEW finding (fingerprint absent from the baseline) fails the run —
+    grandfathering it requires an explicit `--update-baseline`, which a
+    reviewer sees as a baseline.json diff;
+  * a STALE entry (baseline fingerprint no longer found) is reported so
+    the next `--update-baseline` shrinks the file — fixed code does not
+    keep its indulgence.
+
+Fingerprints are line-number-free (rule + path + function + detail), so
+unrelated edits to a file never churn the baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .common import Finding
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> dict[str, str]:
+    """{fingerprint: reason}; missing file means an empty baseline."""
+    p = path or BASELINE_PATH
+    if not os.path.exists(p):
+        return {}
+    with open(p, encoding="utf-8") as f:
+        doc = json.load(f)
+    return {e["fingerprint"]: e.get("reason", "") for e in doc["entries"]}
+
+
+def save_baseline(entries: dict[str, str], path: str | None = None) -> None:
+    p = path or BASELINE_PATH
+    doc = {
+        "_comment": "kss-analyze ratchet: grandfathered findings. "
+                    "Entries are only added via --update-baseline; "
+                    "fixing the code and re-running --update-baseline "
+                    "shrinks the file.",
+        "entries": [{"fingerprint": fp, "reason": reason}
+                    for fp, reason in sorted(entries.items())],
+    }
+    with open(p, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def partition(findings: list[Finding], baseline: dict[str, str]
+              ) -> tuple[list[Finding], list[Finding], list[str]]:
+    """-> (new, grandfathered, stale_fingerprints)."""
+    new: list[Finding] = []
+    old: list[Finding] = []
+    seen: set[str] = set()
+    for f in findings:
+        seen.add(f.fingerprint)
+        (old if f.fingerprint in baseline else new).append(f)
+    stale = [fp for fp in baseline if fp not in seen]
+    return new, old, stale

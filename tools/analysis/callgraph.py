@@ -1,0 +1,306 @@
+"""Intra-repo call graph over the parsed module set.
+
+Name-based and deliberately conservative-but-approximate (the analyzers
+riding on it report through a ratchet baseline, so an over-approximation
+surfaces once and is triaged, never silently ignored):
+
+  * `self.m()` resolves to `m` on the enclosing class, then on its
+    repo-local base classes, then — only when the bare name is defined
+    exactly once repo-wide — to that unique definition;
+  * bare `f()` resolves to a module-level def in the same module or to a
+    `from mod import f` target inside the repo;
+  * `alias.f()` resolves through `import repo.pkg.mod as alias`;
+  * anything else (callbacks, dynamic dispatch, externals) stays an
+    *external* edge, recorded with its dotted text so the lock/purity
+    passes can classify it (time.sleep, jnp.*, subprocess.*, ...).
+
+Every function body is indexed once; reachability and per-function
+effect summaries (locks acquired, blocking ops) are computed by the
+consumers via `transitive()` fixpoints.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .common import Module, dotted_name
+
+
+@dataclass
+class FuncInfo:
+    key: str                     # "module.modname:Class.method" unique key
+    module: Module
+    qualname: str                # "Class.method" / "func"
+    cls: str | None
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    calls: list[tuple[str, int]] = field(default_factory=list)   # resolved keys
+    external_calls: list[tuple[str, int]] = field(default_factory=list)
+    jitted: bool = False         # decorated with / passed to jax.jit
+
+
+class CallGraph:
+    def __init__(self, modules: list[Module]):
+        self.modules = modules
+        self.by_path = {m.path: m for m in modules}
+        self.functions: dict[str, FuncInfo] = {}
+        # bare function/method name -> [keys]
+        self._by_name: dict[str, list[str]] = {}
+        # (modname, ClassName) -> {method name -> key}
+        self._methods: dict[tuple[str, str], dict[str, str]] = {}
+        # (modname, ClassName) -> [base class name strings]
+        self._bases: dict[tuple[str, str], list[str]] = {}
+        # (modname, ClassName) -> {attr names assigned via self.X = ...}
+        # (a stored callable attribute must not resolve as a method)
+        self._attrs: dict[tuple[str, str], set[str]] = {}
+        # (modname, cls-or-None) -> {names of defs nested inside funcs}
+        self._nested: dict[tuple[str, str | None], set[str]] = {}
+        # modname -> {local alias -> imported dotted target}
+        self._imports: dict[str, dict[str, str]] = {}
+        self._modnames = {m.modname for m in modules}
+        for m in modules:
+            self._index_module(m)
+        for m in modules:
+            self._resolve_module(m)
+
+    # ------------------------------------------------------------ indexing
+
+    def _index_module(self, mod: Module) -> None:
+        imports: dict[str, str] = {}
+        self._imports[mod.modname] = imports
+
+        def handle_import(node: ast.AST) -> None:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = self._abs_from(mod.modname, node)
+                for a in node.names:
+                    imports[a.asname or a.name] = f"{base}.{a.name}"
+
+        for node in ast.walk(mod.tree):
+            handle_import(node)
+
+        def index_func(fn, cls: str | None, nested: bool = False) -> None:
+            qual = f"{cls}.{fn.name}" if cls else fn.name
+            key = f"{mod.modname}:{qual}"
+            info = FuncInfo(key=key, module=mod, qualname=qual,
+                            cls=cls, node=fn)
+            info.jitted = self._is_jitted_def(fn)
+            self.functions[key] = info
+            self._by_name.setdefault(fn.name, []).append(key)
+            if cls and not nested:
+                # only top-level methods resolve via self.X; a def nested
+                # inside a method is enclosing-scope, not class-scope
+                self._methods.setdefault((mod.modname, cls), {})[fn.name] = key
+            if nested:
+                self._nested.setdefault(
+                    (mod.modname, cls), set()).add(fn.name)
+
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                index_func(node, None)
+                for sub in ast.walk(node):
+                    if sub is not node and isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        index_func(sub, None, nested=True)
+            elif isinstance(node, ast.ClassDef):
+                self._bases[(mod.modname, node.name)] = [
+                    b for b in (dotted_name(x) for x in node.bases) if b]
+                attrs = self._attrs.setdefault((mod.modname, node.name),
+                                               set())
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        for tgt in sub.targets:
+                            if (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"):
+                                attrs.add(tgt.attr)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        index_func(item, node.name)
+                        for sub in ast.walk(item):
+                            if sub is not item and isinstance(
+                                    sub, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                                index_func(sub, node.name, nested=True)
+
+    def _abs_from(self, modname: str, node: ast.ImportFrom) -> str:
+        if not node.level:
+            return node.module or ""
+        parts = modname.split(".")
+        # a module's package is its dotted prefix; level=1 is that package
+        base = parts[: len(parts) - node.level]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    @staticmethod
+    def _is_jitted_def(fn) -> bool:
+        for dec in fn.decorator_list:
+            names = []
+            if isinstance(dec, ast.Call):
+                names.append(dotted_name(dec.func))
+                names.extend(dotted_name(a) for a in dec.args)
+            else:
+                names.append(dotted_name(dec))
+            for name in names:
+                if name and "jit" in name.split("."):
+                    return True
+        return False
+
+    # ----------------------------------------------------------- resolving
+
+    def _resolve_module(self, mod: Module) -> None:
+        for key, info in self.functions.items():
+            if info.module is not mod:
+                continue
+            for call in self._calls_in(info.node):
+                target = self._resolve_call(info, call)
+                if target is not None:
+                    info.calls.append((target, call.lineno))
+                else:
+                    name = dotted_name(call.func)
+                    if name:
+                        info.external_calls.append((name, call.lineno))
+            # f passed to jax.jit(f) anywhere in the module marks f jitted
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func) or ""
+                if fname.split(".")[-1] == "jit" and node.args:
+                    arg = node.args[0]
+                    tgt = dotted_name(arg)
+                    if tgt:
+                        k = self._lookup_local(mod.modname, tgt)
+                        if k and k in self.functions:
+                            self.functions[k].jitted = True
+
+    def _calls_in(self, fn) -> list[ast.Call]:
+        out = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                out.append(node)
+        return out
+
+    def _lookup_local(self, modname: str, bare: str) -> str | None:
+        k = f"{modname}:{bare}"
+        return k if k in self.functions else None
+
+    def _resolve_call(self, info: FuncInfo, call: ast.Call) -> str | None:
+        func = call.func
+        name = dotted_name(func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        mod = info.module
+        # self.method() / cls.method()
+        if parts[0] in ("self", "cls") and len(parts) == 2 and info.cls:
+            return self._resolve_method(mod.modname, info.cls, parts[1])
+        if len(parts) == 1:
+            # bare call: a def nested in this method's enclosing scope,
+            # a same-module def, or a from-import of a repo def — NEVER
+            # a class-scope method (bare names don't see class scope)
+            if (info.cls and parts[0] in self._nested.get(
+                    (mod.modname, info.cls), ())):
+                k = f"{mod.modname}:{info.cls}.{parts[0]}"
+                if k in self.functions:
+                    return k
+            k = self._lookup_local(mod.modname, parts[0])
+            if k:
+                return k
+            target = self._imports[mod.modname].get(parts[0])
+            if target:
+                return self._resolve_dotted(target)
+            return None
+        # alias.attr(...): through an import of a repo module
+        target = self._imports[mod.modname].get(parts[0])
+        if target:
+            return self._resolve_dotted(".".join([target, *parts[1:]]))
+        return None
+
+    def _resolve_method(self, modname: str, cls: str,
+                        method: str) -> str | None:
+        seen: set[tuple[str, str]] = set()
+        stack = [(modname, cls)]
+        while stack:
+            mk = stack.pop()
+            if mk in seen:
+                continue
+            seen.add(mk)
+            key = self._methods.get(mk, {}).get(method)
+            if key:
+                return key
+            for base in self._bases.get(mk, []):
+                bare = base.split(".")[-1]
+                # base class defined in this module or imported from repo
+                if (mk[0], bare) in self._methods or (mk[0], bare) in self._bases:
+                    stack.append((mk[0], bare))
+                else:
+                    target = self._imports.get(mk[0], {}).get(
+                        base.split(".")[0])
+                    if target:
+                        dotted = ".".join([target, *base.split(".")[1:]])
+                        bmod, _, bcls = dotted.rpartition(".")
+                        if bmod in self._modnames:
+                            stack.append((bmod, bcls))
+        # a stored callable attribute (self.cb = fn) is not a method —
+        # never resolve it by name
+        if method in self._attrs.get((modname, cls), ()):
+            return None
+        # unique bare-name fallback
+        cands = self._by_name.get(method, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def _resolve_dotted(self, dotted: str) -> str | None:
+        """'repo.pkg.mod.func' or 'repo.pkg.mod.Class.method' -> key."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            modname = ".".join(parts[:i])
+            if modname in self._modnames:
+                rest = parts[i:]
+                key = f"{modname}:{'.'.join(rest)}"
+                if key in self.functions:
+                    return key
+                if len(rest) == 1:
+                    # from pkg import name where name is a module
+                    sub = f"{modname}.{rest[0]}"
+                    if sub in self._modnames:
+                        return None
+                return None
+        return None
+
+    # --------------------------------------------------------- reachability
+
+    def reachable(self, roots: list[str]) -> set[str]:
+        """Function keys reachable from the given keys (roots included
+        when they exist)."""
+        seen: set[str] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            for callee, _ln in self.functions[k].calls:
+                if callee not in seen:
+                    stack.append(callee)
+        return seen
+
+    def transitive(self, direct: dict[str, set]) -> dict[str, set]:
+        """Fixpoint union of per-function facts over the call graph:
+        OUT(f) = direct(f) ∪ ⋃ OUT(callee).  Handles cycles."""
+        out = {k: set(direct.get(k, ())) for k in self.functions}
+        changed = True
+        while changed:
+            changed = False
+            for k, info in self.functions.items():
+                acc = out[k]
+                before = len(acc)
+                for callee, _ln in info.calls:
+                    acc |= out.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+        return out

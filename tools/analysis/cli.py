@@ -1,0 +1,105 @@
+"""`python -m tools.analysis` — the `make analyze` entry point.
+
+Runs the three AST analyzers (lock discipline, device purity,
+observability conformance) over `kube_scheduler_simulator_tpu/`, applies
+in-source suppressions and the ratchet baseline, and exits nonzero on
+any NEW finding.  Pure AST: needs no JAX, no device, no imports of the
+analyzed modules; the full pass at HEAD runs in a couple of seconds.
+
+Exit codes: 0 clean (possibly with grandfathered findings), 1 new
+findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from . import run_analysis
+from .baseline import BASELINE_PATH, load_baseline, partition, save_baseline
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kss-analyze", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected from tools/)")
+    ap.add_argument("--package", default="kube_scheduler_simulator_tpu",
+                    help="package dir under root to analyze")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="ratchet baseline JSON path")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything as new)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "(the ONLY way the grandfather list may grow)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the run result as JSON to this path "
+                         "('-' for stdout)")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    t0 = time.perf_counter()
+    try:
+        result = run_analysis(root=args.root, package=args.package)
+    except SyntaxError as e:
+        print(f"kss-analyze: parse failure: {e}", file=sys.stderr)
+        return 2
+    findings = result["findings"]
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, grandfathered, stale = partition(findings, baseline)
+
+    if args.update_baseline:
+        entries = {}
+        for f in grandfathered:
+            entries[f.fingerprint] = baseline.get(f.fingerprint, "")
+        for f in new:
+            entries[f.fingerprint] = "grandfathered by --update-baseline"
+        save_baseline(entries, args.baseline)
+        print(f"kss-analyze: baseline updated: {len(entries)} entries "
+              f"({len(new)} new, {len(stale)} stale dropped) "
+              f"-> {args.baseline}")
+        new = []
+
+    if not args.quiet:
+        for f in new:
+            print(f"NEW  {f.render()}")
+        for f in grandfathered:
+            print(f"OLD  {f.render()}")
+        for fp in stale:
+            print(f"STALE baseline entry no longer found: {fp}")
+    dt = time.perf_counter() - t0
+    print(f"kss-analyze: {result['modules']} modules, "
+          f"{result['functions']} functions, "
+          f"{len(new)} new / {len(grandfathered)} grandfathered / "
+          f"{result['suppressed']} suppressed findings, "
+          f"{len(stale)} stale baseline entries ({dt:.2f}s)")
+
+    if args.json_out:
+        doc = {
+            "new": [f.__dict__ for f in new],
+            "grandfathered": [f.__dict__ for f in grandfathered],
+            "stale": stale,
+            "suppressed": result["suppressed"],
+            "seconds": round(dt, 3),
+        }
+        if args.json_out == "-":
+            json.dump(doc, sys.stdout, indent=2)
+            print()
+        else:
+            with open(args.json_out, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=2)
+    if new:
+        print("kss-analyze: FAIL — new findings above; fix them, add a "
+              "`# kss-analyze: allow(<rule>)` with justification, or run "
+              "--update-baseline", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

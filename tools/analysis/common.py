@@ -1,0 +1,118 @@
+"""Shared infrastructure for the kss-analyze static analyzers.
+
+Pure-AST: no module under analysis is ever imported (the lock/purity
+passes must run in CI without JAX or a device).  A `Module` is the parsed
+tree plus its source lines (for suppression comments); a `Finding` is one
+violation with a line-number-free fingerprint so the ratchet baseline
+survives unrelated edits.
+
+Suppression: a line (or the line directly above it) carrying
+`# kss-analyze: allow(<rule>)` silences findings of that rule anchored
+to that line.  `allow(*)` silences every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+_ALLOW_RE = re.compile(r"#\s*kss-analyze:\s*allow\(([\w*,\s-]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # e.g. "lock-order", "blocking-under-lock"
+    path: str          # repo-relative posix path
+    qualname: str      # module-relative function ("Class.method" / "func")
+    detail: str        # stable discriminator (lock pair, op name, ...)
+    lineno: int        # anchor line (NOT part of the fingerprint)
+    message: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule} {self.path} {self.qualname} {self.detail}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.lineno}: [{self.rule}] {self.qualname}: "
+                f"{self.message or self.detail}")
+
+
+@dataclass
+class Module:
+    path: str                  # repo-relative posix path
+    modname: str               # dotted module name
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    def allows(self, lineno: int, rule: str) -> bool:
+        """True when `# kss-analyze: allow(rule)` sits on the line or the
+        line directly above it."""
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _ALLOW_RE.search(self.lines[ln - 1])
+                if m:
+                    allowed = {s.strip() for s in m.group(1).split(",")}
+                    if "*" in allowed or rule in allowed:
+                        return True
+        return False
+
+
+def load_modules(root: str, package_dir: str) -> list[Module]:
+    """Parse every .py file under `package_dir` (relative to repo `root`)
+    into a Module.  Files that fail to parse raise — a syntax error in
+    the tree is itself a finding-worthy state."""
+    modules: list[Module] = []
+    base = os.path.join(root, package_dir)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            modname = rel[:-3].replace("/", ".")
+            if modname.endswith(".__init__"):
+                modname = modname[: -len(".__init__")]
+            with open(full, encoding="utf-8") as f:
+                src = f.read()
+            modules.append(Module(
+                path=rel, modname=modname,
+                tree=ast.parse(src, filename=rel),
+                lines=src.splitlines()))
+    return modules
+
+
+def load_module_file(root: str, rel_path: str) -> Module:
+    """A single file as a Module (fixture tests analyze lone files)."""
+    full = os.path.join(root, rel_path)
+    rel = rel_path.replace(os.sep, "/")
+    with open(full, encoding="utf-8") as f:
+        src = f.read()
+    modname = rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+    return Module(path=rel, modname=modname,
+                  tree=ast.parse(src, filename=rel), lines=src.splitlines())
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def filter_suppressed(findings: list[Finding],
+                      by_path: dict[str, Module]) -> list[Finding]:
+    out = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and mod.allows(f.lineno, f.rule):
+            continue
+        out.append(f)
+    return out

@@ -1,0 +1,460 @@
+"""Lock-discipline analyzer (rules: lock-order, self-deadlock,
+blocking-under-lock, device-under-lock, serialize-under-lock).
+
+Lock discovery is by assignment: `self.X = threading.Lock()/RLock()/
+Condition()` inside a class gives the lock identity `module:Class.X`;
+a module-level `X = threading.Lock()` gives `module:X`.  Holds are
+tracked structurally: `with self.X:` bodies, and `self.X.acquire()` ..
+`self.X.release()` runs inside one statement list.
+
+While a lock is held, every call is classified:
+
+  * a call that (transitively, over the intra-repo call graph) acquires
+    a DIFFERENT lock contributes an ordering edge A -> B; a cycle among
+    the edges is a lock-order inversion — exactly the PR 3
+    `kubeapi._rv_int` deadlock shape, reported before any thread ever
+    interleaves into it;
+  * a call that reacquires the SAME non-reentrant Lock is a
+    self-deadlock (that bug class again, single-lock variant);
+  * a call reaching a blocking operation (time.sleep, subprocess,
+    socket/urllib I/O, file open, Thread.join, native codec entry
+    points) is blocking-under-lock;
+  * a call reaching JAX dispatch (jnp.* / jax.*) is device-under-lock —
+    device work can take arbitrary milliseconds and must never happen
+    on a lock every reader shares;
+  * json/deepcopy/marshal serialization under a lock is
+    serialize-under-lock — not a deadlock, but exactly the hidden
+    serialization Gavel-style throughput claims die on, and the shape
+    PR 2 had to move off the store lock.
+
+Condition variables: `.wait()` on the HELD condition releases it by
+contract and is never flagged; `notify`/`notify_all` are lock-internal.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .callgraph import CallGraph
+from .common import Finding, dotted_name
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore"}
+REENTRANT_FACTORIES = {"RLock", "Condition"}  # Condition wraps an RLock
+
+BLOCKING_PREFIXES = (
+    "time.sleep", "subprocess.", "socket.", "urllib.request.",
+    "requests.", "select.",
+)
+BLOCKING_EXACT = {"open", "input"}
+BLOCKING_METHODS = {"urlopen", "recv", "connect",
+                    "check_call", "check_output", "run_until_complete"}
+# `.join` blocks only on thread-like receivers (str.join / os.path.join
+# are pure); match by receiver name
+_THREADISH = ("thread", "worker", "proc")
+DEVICE_PREFIXES = ("jnp.", "jax.")
+NATIVE_BASES = {"lib", "_lib", "native"}
+SERIALIZE_PREFIXES = ("json.dumps", "json.loads", "copy.deepcopy",
+                      "pickle.", "yaml.")
+SERIALIZE_METHODS = {"marshal"}
+
+
+@dataclass(frozen=True)
+class LockDef:
+    lock_id: str       # "module:Class.attr" or "module:attr"
+    reentrant: bool
+
+
+def _call_name(node: ast.Call) -> str | None:
+    return dotted_name(node.func)
+
+
+def _classify_external(name: str) -> str | None:
+    """rule name for an unresolved (external) call, or None."""
+    if any(name.startswith(p) for p in DEVICE_PREFIXES):
+        return "device-under-lock"
+    if name in BLOCKING_EXACT or any(
+            name.startswith(p) for p in BLOCKING_PREFIXES):
+        return "blocking-under-lock"
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[0] in NATIVE_BASES:
+        return "blocking-under-lock"
+    if len(parts) >= 2 and parts[-1] in BLOCKING_METHODS:
+        return "blocking-under-lock"
+    if (len(parts) >= 2 and parts[-1] == "join"
+            and any(t in parts[-2].lower() for t in _THREADISH)):
+        return "blocking-under-lock"
+    if any(name.startswith(p) for p in SERIALIZE_PREFIXES):
+        return "serialize-under-lock"
+    if len(parts) >= 2 and parts[-1] in SERIALIZE_METHODS:
+        return "serialize-under-lock"
+    return None
+
+
+class LockAnalyzer:
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self.locks: dict[str, LockDef] = {}
+        self._discover_locks()
+        # per function: set of lock_ids it DIRECTLY acquires
+        self._direct_acquires: dict[str, set[str]] = {}
+        # per function: set of (rule, opname) effects it DIRECTLY has
+        self._direct_effects: dict[str, set[tuple[str, str]]] = {}
+        for key, info in graph.functions.items():
+            acq, eff = self._function_direct_facts(info)
+            self._direct_acquires[key] = acq
+            self._direct_effects[key] = eff
+        self._trans_acquires = graph.transitive(self._direct_acquires)
+        self._trans_effects = graph.transitive(self._direct_effects)
+
+    # ----------------------------------------------------------- discovery
+
+    def _discover_locks(self) -> None:
+        for mod in self.graph.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        self._maybe_lock_assign(mod, node.name, sub)
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign):
+                    self._maybe_lock_assign(mod, None, stmt)
+
+    def _maybe_lock_assign(self, mod, cls: str | None,
+                           assign: ast.Assign) -> None:
+        if not isinstance(assign.value, ast.Call):
+            return
+        name = _call_name(assign.value) or ""
+        factory = name.split(".")[-1]
+        if factory not in LOCK_FACTORIES:
+            return
+        if not (name.startswith("threading.") or name == factory):
+            return
+        for tgt in assign.targets:
+            attr = None
+            if (cls and isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                attr = f"{cls}.{tgt.attr}"
+            elif cls is None and isinstance(tgt, ast.Name):
+                attr = tgt.id
+            if attr:
+                lid = f"{mod.modname}:{attr}"
+                self.locks[lid] = LockDef(
+                    lid, reentrant=factory in REENTRANT_FACTORIES)
+
+    def _lock_for_expr(self, info, expr: ast.AST) -> LockDef | None:
+        """LockDef for `self.X` / module-level `X` in this function."""
+        mod = info.module.modname
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and info.cls):
+            # walk the class MRO the same way method resolution does:
+            # a lock assigned in a repo-local base class is the same lock
+            cand = f"{mod}:{info.cls}.{expr.attr}"
+            if cand in self.locks:
+                return self.locks[cand]
+            for lid, d in self.locks.items():
+                m, _, qual = lid.partition(":")
+                if m == mod and qual.endswith(f".{expr.attr}"):
+                    return None  # other class's lock: not resolvable here
+            # unique attr-name fallback across the repo (self._lock of a
+            # mixin/base defined elsewhere)
+            hits = [d for lid, d in self.locks.items()
+                    if lid.partition(":")[2].split(".")[-1] == expr.attr]
+            if len(hits) == 1:
+                return hits[0]
+            return None
+        if isinstance(expr, ast.Name):
+            cand = f"{mod}:{expr.id}"
+            return self.locks.get(cand)
+        return None
+
+    # ------------------------------------------------------- direct facts
+
+    def _function_direct_facts(self, info):
+        """(locks acquired anywhere in fn, (rule, op) effects anywhere in
+        fn) — used for the *transitive* summaries of callees."""
+        acquires: set[str] = set()
+        effects: set[tuple[str, str]] = set()
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    d = self._lock_for_expr(info, item.context_expr)
+                    if d:
+                        acquires.add(d.lock_id)
+            elif isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name is None:
+                    continue
+                if name.endswith(".acquire"):
+                    base = node.func.value
+                    d = self._lock_for_expr(info, base)
+                    if d:
+                        acquires.add(d.lock_id)
+                rule = _classify_external(name)
+                if rule and not self._is_resolved_call(info, node):
+                    effects.add((rule, name))
+        return acquires, effects
+
+    def _is_resolved_call(self, info, call: ast.Call) -> bool:
+        ln = call.lineno
+        return any(l == ln for _t, l in info.calls)
+
+    # ------------------------------------------------------------ analysis
+
+    def analyze(self) -> tuple[list[Finding], dict[tuple[str, str], list]]:
+        findings: list[Finding] = []
+        # ordering edges: (held, acquired) -> [(path, qual, line, via)]
+        edges: dict[tuple[str, str], list] = {}
+        for key, info in self.graph.functions.items():
+            self._walk_held(info, info.node.body, [], findings, edges)
+        findings.extend(self._order_findings(edges))
+        return findings, edges
+
+    def _walk_held(self, info, body: list, held: list[LockDef],
+                   findings, edges) -> None:
+        i = 0
+        while i < len(body):
+            stmt = body[i]
+            if isinstance(stmt, ast.With):
+                inner = held
+                for item in stmt.items:
+                    d = self._lock_for_expr(info, item.context_expr)
+                    if d:
+                        self._note_acquire(info, item.context_expr.lineno,
+                                           inner, d, findings, edges)
+                        inner = inner + [d]
+                # check calls in the with-line items themselves first
+                for item in stmt.items:
+                    self._check_expr(info, item.context_expr, held,
+                                     findings, edges)
+                self._walk_held(info, stmt.body, inner, findings, edges)
+                i += 1
+                continue
+            # linear acquire()/release() within this statement list
+            d = self._acquire_stmt(info, stmt)
+            if d is not None:
+                self._note_acquire(info, stmt.lineno, held, d,
+                                   findings, edges)
+                # scan forward to the matching release in this block
+                j = i + 1
+                inner_stmts = []
+                while j < len(body):
+                    if self._release_stmt(info, body[j]) == d.lock_id:
+                        break
+                    inner_stmts.append(body[j])
+                    j += 1
+                self._walk_held(info, inner_stmts, held + [d],
+                                findings, edges)
+                i = j + 1
+                continue
+            if isinstance(stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                                 ast.Try)):
+                # header expressions with the current held set, then the
+                # nested blocks (each exactly once)
+                for header in ("test", "iter"):
+                    sub = getattr(stmt, header, None)
+                    if sub is not None:
+                        self._check_expr(info, sub, held, findings, edges)
+                for attr in ("body", "orelse", "finalbody"):
+                    subs = getattr(stmt, attr, None)
+                    if subs:
+                        self._walk_held(info, subs, held, findings, edges)
+                for h in getattr(stmt, "handlers", []):
+                    self._walk_held(info, h.body, held, findings, edges)
+            elif not isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.ClassDef)):
+                # simple statement: every call in it runs under `held`
+                self._check_expr(info, stmt, held, findings, edges)
+            i += 1
+
+    def _acquire_stmt(self, info, stmt) -> LockDef | None:
+        if (isinstance(stmt, (ast.Expr, ast.Assign))
+                and isinstance(stmt.value, ast.Call)):
+            name = _call_name(stmt.value)
+            if name and name.endswith(".acquire"):
+                return self._lock_for_expr(info, stmt.value.func.value)
+        return None
+
+    def _release_stmt(self, info, stmt) -> str | None:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            name = _call_name(stmt.value)
+            if name and name.endswith(".release"):
+                d = self._lock_for_expr(info, stmt.value.func.value)
+                if d:
+                    return d.lock_id
+        return None
+
+    def _note_acquire(self, info, lineno: int, held: list[LockDef],
+                      d: LockDef, findings, edges) -> None:
+        for h in held:
+            if h.lock_id == d.lock_id:
+                if not d.reentrant:
+                    findings.append(Finding(
+                        rule="self-deadlock", path=info.module.path,
+                        qualname=info.qualname, detail=d.lock_id,
+                        lineno=lineno,
+                        message=f"non-reentrant {d.lock_id} reacquired "
+                                "while already held on this path"))
+                continue
+            edges.setdefault((h.lock_id, d.lock_id), []).append(
+                (info.module.path, info.qualname, lineno, "direct"))
+
+    def _check_expr(self, info, expr, held, findings, edges) -> None:
+        """Flag calls in an expression (or simple statement) executed with
+        `held` locks; nested function bodies and lambdas run later and are
+        pruned."""
+        if not held:
+            return
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                self._check_call(info, node, held, findings, edges)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_call(self, info, call: ast.Call, held: list[LockDef],
+                    findings, edges) -> None:
+        name = _call_name(call)
+        if name is None:
+            return
+        held_ids = {h.lock_id for h in held}
+        # condition-variable wait on the held lock releases it: skip
+        if name.endswith(".wait") or name.endswith(".wait_for"):
+            d = self._lock_for_expr(info, call.func.value)
+            if d and d.lock_id in held_ids:
+                return
+        if name.endswith((".acquire", ".release", ".notify",
+                          ".notify_all", ".locked")):
+            return  # structural lock ops handled elsewhere
+        # resolved repo call: pull the callee's transitive summaries
+        target = None
+        for t, ln in info.calls:
+            if ln == call.lineno and self._matches_target(t, name):
+                target = t
+                break
+        if target is not None:
+            for lid in self._trans_acquires.get(target, ()):  # ordering
+                for h in held:
+                    if lid == h.lock_id:
+                        if not h.reentrant:
+                            findings.append(Finding(
+                                rule="self-deadlock",
+                                path=info.module.path,
+                                qualname=info.qualname,
+                                detail=f"{h.lock_id} via {target}",
+                                lineno=call.lineno,
+                                message=f"holds {h.lock_id} and calls "
+                                        f"{target} which reacquires it"))
+                    else:
+                        edges.setdefault((h.lock_id, lid), []).append(
+                            (info.module.path, info.qualname,
+                             call.lineno, target))
+            for rule, op in self._trans_effects.get(target, ()):
+                findings.append(self._effect_finding(
+                    info, call.lineno, held, rule, op, via=target))
+            return
+        rule = _classify_external(name)
+        if rule:
+            findings.append(self._effect_finding(
+                info, call.lineno, held, rule, name, via=None))
+
+    @staticmethod
+    def _matches_target(target_key: str, call_name: str) -> bool:
+        bare = target_key.rsplit(".", 1)[-1].rsplit(":", 1)[-1]
+        return call_name.split(".")[-1] == bare
+
+    def _effect_finding(self, info, lineno, held, rule, op, via):
+        held_s = "+".join(sorted(h.lock_id for h in held))
+        det = f"{op} holding {held_s}"
+        msg = (f"{op} while holding {held_s}"
+               + (f" (via {via})" if via else ""))
+        return Finding(rule=rule, path=info.module.path,
+                       qualname=info.qualname, detail=det,
+                       lineno=lineno, message=msg)
+
+    # -------------------------------------------------------- order cycles
+
+    def _order_findings(self, edges) -> list[Finding]:
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        findings: list[Finding] = []
+        for cycle in _find_cycles(graph):
+            # anchor the finding at each edge site participating in the
+            # cycle so suppression/baseline can target the real code
+            cyc = set(cycle)
+            pairs = [(a, b) for (a, b) in edges
+                     if a in cyc and b in cyc and a != b]
+            loop = " -> ".join([*cycle, cycle[0]])
+            for (a, b) in sorted(pairs):
+                for (path, qual, lineno, via) in edges[(a, b)]:
+                    findings.append(Finding(
+                        rule="lock-order", path=path, qualname=qual,
+                        detail=f"{a} -> {b} in cycle [{loop}]",
+                        lineno=lineno,
+                        message=f"acquisition order {a} -> {b} "
+                                f"participates in cycle {loop}"
+                                + (f" (via {via})"
+                                   if via != "direct" else "")))
+        return findings
+
+
+def _find_cycles(graph: dict[str, set[str]]) -> list[list[str]]:
+    """Elementary cycles via SCC decomposition (every SCC with more than
+    one node, reported as the sorted node list)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    counter = [0]
+    sccs: list[list[str]] = []
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs

@@ -1,0 +1,136 @@
+"""Device-purity analyzer for the wave hot path (rules: pod-loop,
+host-sync, nondeterminism).
+
+Roots come from a small manifest (HOT_PATH_ROOTS below — the engine wave
+entry, the whole replay module, the gang quorum slice, and the decode
+chunk routing); every function reachable from them over the intra-repo
+call graph is checked:
+
+  * pod-loop — a Python `for` over a pod/node-sized iterable (pending,
+    pods, nodes, or range(len(...)) of one).  The paper's whole point is
+    the dense pod x node x plugin re-expression; a per-pod Python loop
+    reintroduces the O(pods) interpreter serialization the fused wave
+    removed.  Host-side loops that are *by design* (str building in
+    decode, commit bookkeeping) are ratcheted or carry allow comments.
+  * host-sync — `.item()`, `float()`, `int()`, `np.asarray()`/
+    `np.array()` on a traced value forces a device->host transfer and a
+    blocking sync inside the wave.  Statically "traced" is undecidable,
+    so the rule fires on the syntactic forms inside *jitted* functions,
+    and on `.item()` anywhere in the hot path.
+  * nondeterminism — `time.*` / `random.*` / `np.random.*` inside
+    jitted code: a traced clock or RNG bakes one trace-time value into
+    the compiled executable, silently breaking replay determinism.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import CallGraph
+from .common import Finding, dotted_name
+
+# the hot-path manifest: (module suffix, qualname-or-* ) roots
+HOT_PATH_ROOTS: list[tuple[str, str]] = [
+    ("framework.engine", "SchedulerEngine._schedule_wave"),
+    ("framework.engine", "SchedulerEngine._profile_wave_run"),
+    ("framework.engine", "_CommitWorker.on_chunk"),
+    ("framework.replay", "*"),
+    ("framework.gang", "quorum_slice"),
+    ("store.decode", "decode_chunk_into"),
+    ("store.decode", "decode_all_parallel"),
+]
+
+BIG_ITERABLES = {"pending", "pods", "nodes"}
+HOST_SYNC_METHODS = {"item"}
+HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
+
+
+def resolve_roots(graph: CallGraph,
+                  roots: list[tuple[str, str]] | None = None) -> list[str]:
+    keys: list[str] = []
+    for mod_suffix, qual in roots or HOT_PATH_ROOTS:
+        for key, info in graph.functions.items():
+            modname = key.partition(":")[0]
+            if not (modname == mod_suffix
+                    or modname.endswith("." + mod_suffix)):
+                continue
+            if qual == "*" or info.qualname == qual:
+                keys.append(key)
+    return keys
+
+
+class PurityAnalyzer:
+    def __init__(self, graph: CallGraph,
+                 roots: list[tuple[str, str]] | None = None):
+        self.graph = graph
+        self.root_keys = resolve_roots(graph, roots)
+        self.reachable = graph.reachable(self.root_keys)
+
+    def analyze(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for key in sorted(self.reachable):
+            info = self.graph.functions[key]
+            findings.extend(self._check_function(info))
+        return findings
+
+    def _check_function(self, info) -> list[Finding]:
+        out: list[Finding] = []
+        jitted = info.jitted
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                big = self._big_iterable(node.iter)
+                if big:
+                    out.append(Finding(
+                        rule="pod-loop", path=info.module.path,
+                        qualname=info.qualname, detail=f"for over {big}",
+                        lineno=node.lineno,
+                        message=f"Python for-loop over {big} in the wave "
+                                "hot path (should be a fused tensor op)"))
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                last = name.split(".")[-1]
+                if last in HOST_SYNC_METHODS and "." in name:
+                    out.append(Finding(
+                        rule="host-sync", path=info.module.path,
+                        qualname=info.qualname, detail=f"{last}()",
+                        lineno=node.lineno,
+                        message=f"{name}() forces a device->host sync in "
+                                "the wave hot path"))
+                elif name in HOST_SYNC_CALLS and jitted:
+                    out.append(Finding(
+                        rule="host-sync", path=info.module.path,
+                        qualname=info.qualname, detail=name,
+                        lineno=node.lineno,
+                        message=f"{name} on a traced value inside jitted "
+                                "code materializes to host"))
+                elif jitted and any(name.startswith(p)
+                                    for p in NONDET_PREFIXES):
+                    out.append(Finding(
+                        rule="nondeterminism", path=info.module.path,
+                        qualname=info.qualname, detail=name,
+                        lineno=node.lineno,
+                        message=f"{name}() inside jitted code bakes a "
+                                "trace-time value into the executable"))
+        return out
+
+    def _big_iterable(self, it: ast.AST) -> str | None:
+        name = dotted_name(it)
+        if name and name.split(".")[-1] in BIG_ITERABLES:
+            return name
+        if isinstance(it, ast.Call):
+            cname = dotted_name(it.func)
+            if cname in ("range", "enumerate", "reversed", "sorted", "zip"):
+                for arg in it.args:
+                    inner = self._big_iterable(arg)
+                    if inner:
+                        return f"{cname}({inner})"
+                # range(len(pending)) shape
+                for arg in it.args:
+                    if (isinstance(arg, ast.Call)
+                            and dotted_name(arg.func) == "len"
+                            and arg.args):
+                        inner = self._big_iterable(arg.args[0])
+                        if inner:
+                            return f"{cname}(len({inner}))"
+        return None
