@@ -569,9 +569,35 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
             f"C worker time")
     cps = scale_pods / total
     log(f"  engine: bound {bound}/{scale_pods} in {total:.2f}s -> {cps:,.0f} cycles/s")
+
+    # lazy-decode headline (docs/wave-pipeline.md lazy-decode stage): how
+    # much decode the wave DEFERRED, and what a consumer pays on first
+    # read.  Cold = first GET of a pod (drains its deferred reflect +
+    # decodes its whole chunk in one native call); warm = a chunk-mate
+    # right after (memoized dict lookup + its own deferred write-back).
+    lazy_reg = getattr(engine.reflector, "_lazy", None)
+    deferred = lazy_reg.pending_count() if lazy_reg is not None else 0
+    lazy_stats = {"deferred_pods": deferred,
+                  "pods_materialized_in_wave": scale_pods - deferred}
+    if deferred:
+        sample = [p["metadata"] for p in pods[:2]]
+        t0 = time.perf_counter()
+        store.get("pods", sample[0]["name"], sample[0].get("namespace"))
+        lazy_stats["cold_read_seconds"] = round(time.perf_counter() - t0, 6)
+        if len(sample) > 1:
+            # second GET right after: pod 2 is pod 1's chunk-mate at
+            # bench chunk sizes, so this is the memoized warm path
+            t0 = time.perf_counter()
+            store.get("pods", sample[1]["name"], sample[1].get("namespace"))
+            lazy_stats["warm_read_seconds"] = round(
+                time.perf_counter() - t0, 6)
+        log(f"  lazy decode: {deferred}/{scale_pods} pods deferred past "
+            f"the wave; first read cold {lazy_stats['cold_read_seconds']*1e3:.1f}ms, "
+            f"warm {lazy_stats.get('warm_read_seconds', 0)*1e3:.1f}ms")
     snap = TRACER.snapshot()
     return {"pods": scale_pods, "nodes": scale_nodes, "bound": bound,
             "cycles_per_sec": round(cps, 1),
+            "lazy": lazy_stats,
             "spans": {k: round(v, 2) for k, v in spans.items()},
             "counters": {k: round(v, 3) for k, v in counters.items()},
             # the full flight-recorder snapshot (histograms + labeled

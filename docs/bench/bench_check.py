@@ -53,22 +53,39 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
     wall = None
     if eng.get("cycles_per_sec") and eng.get("pods"):
         wall = eng["pods"] / eng["cycles_per_sec"]
+    eng10k = extra.get("engine_10k_5k") or {}
+    lazy = eng.get("lazy") or {}
     return {
         "decode_pods_per_sec": (extra.get("decode_pods_per_sec"), "higher"),
         "commit_stream_overlap_seconds":
             (counters.get("commit_stream_overlap_seconds"), "higher"),
         "engine_2k_1k_wave_wall_seconds": (wall, "lower"),
         "headline_e2e_cycles_per_sec": (bench.get("value"), "higher"),
+        # lazy-decode era metrics (absent from pre-PR-9 rounds: the
+        # union/skip semantics of compare() carry them)
+        "engine_10k_5k_cycles_per_sec":
+            (eng10k.get("cycles_per_sec"), "higher"),
+        "lazy_cold_first_read_seconds":
+            (lazy.get("cold_read_seconds"), "lower"),
     }
 
 
 def compare(prev: dict, new: dict,
             threshold: float = DEFAULT_THRESHOLD) -> list[dict]:
-    """[{metric, old, new, ratio, status}] — status ok|regression|skip."""
+    """[{metric, old, new, ratio, status}] — status ok|regression|skip.
+
+    Iterates the UNION of both rounds' metric keys with missing entries
+    treated as None (SKIP): a metric added after the older round — or
+    dropped in a newer one — must never KeyError the gate, only a
+    present-on-both-sides regression fails it."""
     rows = []
     old_m, new_m = key_metrics(prev), key_metrics(new)
-    for name, (old_v, direction) in old_m.items():
-        new_v = new_m[name][0]
+    names = list(old_m) + [n for n in new_m if n not in old_m]
+    for name in names:
+        old_v = old_m.get(name, (None, "higher"))[0]
+        new_v, direction = new_m.get(name, (None, "higher"))
+        if name in old_m:
+            direction = old_m[name][1]
         if not old_v or new_v is None:
             rows.append({"metric": name, "old": old_v, "new": new_v,
                          "ratio": None, "status": "skip"})
@@ -84,9 +101,9 @@ def compare(prev: dict, new: dict,
     return rows
 
 
-def _round_files(root: Path) -> list[Path]:
-    files = [p for p in root.glob("BENCH_*.json")
-             if re.fullmatch(r"BENCH_r?\d+\.json", p.name)]
+def _round_files(root: Path, prefix: str = "BENCH") -> list[Path]:
+    files = [p for p in root.glob(f"{prefix}_*.json")
+             if re.fullmatch(rf"{prefix}_r?\d+\.json", p.name)]
 
     def order(p: Path):
         try:
@@ -97,6 +114,29 @@ def _round_files(root: Path) -> list[Path]:
     return sorted(files, key=order)
 
 
+def check_multichip(root: Path) -> str | None:
+    """Sanity gate on the newest MULTICHIP_*.json round: the 8-virtual-
+    device scaling harness must actually RUN (ok=true, skipped=false) —
+    a round that silently degraded back to 'skipped' would invalidate
+    the sharded-replay trajectory while the BENCH gate stayed green.
+    Returns an error string, or None when fine (or no rounds exist)."""
+    rounds = _round_files(root, prefix="MULTICHIP")
+    if not rounds:
+        return None
+    newest = rounds[-1]
+    try:
+        doc = json.loads(newest.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return f"{newest.name}: unreadable ({e})"
+    if doc.get("skipped"):
+        return (f"{newest.name}: skipped=true "
+                f"(reason: {doc.get('reason') or 'unspecified'}) — the "
+                "multichip harness must shard, not skip")
+    if not doc.get("ok"):
+        return f"{newest.name}: ok!=true"
+    return None
+
+
 def main(argv: list[str]) -> int:
     import argparse
 
@@ -105,6 +145,10 @@ def main(argv: list[str]) -> int:
                     help="directory holding the BENCH_*.json rounds")
     ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
     args = ap.parse_args(argv)
+    mc_err = check_multichip(Path(args.dir))
+    if mc_err is not None:
+        print(f"bench-check: MULTICHIP sanity failed — {mc_err}")
+        return 2
     files = _round_files(Path(args.dir))
     if len(files) < 2:
         print(f"bench-check: fewer than two BENCH_*.json rounds in "

@@ -113,6 +113,12 @@ class ObjectStore:
         self._last_rv = 0
         self._events: dict[str, list[tuple[int, str, dict]]] = {r: [] for r in RESOURCES}
         self._watchers: dict[str, list[queue.Queue]] = {r: [] for r in RESOURCES}
+        # read hooks (store/lazy.py LazyReflections): deferred-annotation
+        # materializers drained before copying reads return, so API
+        # consumers observe exactly the eager write-back's bytes while
+        # the engine's shared-manifest fast paths (copy_object(s)=False)
+        # stay off the decode
+        self._read_hooks: list = []
         for spec in extra_resources or []:
             self.register_resource(
                 spec["resource"], spec.get("kind") or spec["resource"].capitalize(),
@@ -134,6 +140,44 @@ class ObjectStore:
             self.resources[resource] = (kind, namespaced)
             if api_version and api_version != "v1":
                 self.api_versions[resource] = api_version
+
+    # ----------------------------------------------------------- read hooks
+
+    def add_read_hook(self, hook) -> None:
+        """Register a deferred-annotation materializer.  `hook.flush(
+        resource, name, namespace)` runs BEFORE copying reads (get with
+        copy_object=True, list with copy_objects=True, dump) return —
+        with no store lock held, so a hook may write back through the
+        normal update path; name=None flushes the whole resource,
+        resource=None flushes everything.  `hook.discard(resource,
+        name, namespace)` drops pending state for deleted/reset
+        objects.  Idempotent per hook object."""
+        with self._lock:
+            if hook not in self._read_hooks:
+                self._read_hooks.append(hook)
+
+    def remove_read_hook(self, hook) -> None:
+        with self._lock:
+            try:
+                self._read_hooks.remove(hook)
+            except ValueError:
+                pass
+
+    def materialize_reads(self, resource: str | None = None,
+                          name: str | None = None,
+                          namespace: str | None = None) -> None:
+        """Drain registered read hooks (no-op without hooks or pending
+        state) — the transparent-read barrier copying reads run, also
+        callable directly by consumers of the shared-manifest fast
+        paths (snapshot export, the HTTP watch stream) that need the
+        eager bytes without paying per-object deep copies."""
+        for hook in tuple(self._read_hooks):
+            hook.flush(resource, name, namespace)
+
+    def _discard_hooks(self, resource: str | None, name: str | None = None,
+                       namespace: str | None = None) -> None:
+        for hook in tuple(self._read_hooks):
+            hook.discard(resource, name, namespace)
 
     # ----------------------------------------------------------- helpers
 
@@ -269,6 +313,11 @@ class ObjectStore:
                 raise NotFound(f"{resource} \"{key}\" not found")
             rv = self._next_rv()
             self._notify(resource, DELETED, cur, rv)  # popped: share freely
+        if self._read_hooks:
+            # a deleted object's deferred annotations are unobservable:
+            # drop them (outside the lock) so they stop pinning the
+            # wave's replay buffers
+            self._discard_hooks(resource, name, namespace)
 
     def get(self, resource: str, name: str, namespace: str | None = None,
             copy_object: bool = True) -> dict:
@@ -278,6 +327,10 @@ class ObjectStore:
         if resource not in self.resources:
             raise NotFound(f"unknown resource {resource}")
         _, namespaced = self.resources[resource]
+        if copy_object and self._read_hooks:
+            # transparent lazy-annotation materialization (store/lazy.py):
+            # runs before the lock so the hook's write-back can take it
+            self.materialize_reads(resource, name, namespace)
         key = f"{namespace or 'default'}/{name}" if namespaced else name
         with self._lock:
             cur = self._objects[resource].get(key)
@@ -302,6 +355,11 @@ class ObjectStore:
         contract)."""
         from ..state.selectors import object_matches_label_selector
 
+        if copy_objects and self._read_hooks:
+            # copying lists are the API-read surface: drain deferred
+            # annotations for the whole resource first (the engine's
+            # per-wave listings use copy_objects=False and stay lazy)
+            self.materialize_reads(resource)
         with self._lock:
             if resource not in self.resources:
                 raise NotFound(f"unknown resource {resource}")
@@ -442,6 +500,10 @@ class ObjectStore:
     def dump(self) -> dict:
         """Full keyspace snapshot (the etcd-prefix dump reset takes at boot,
         reference: reset/reset.go:32-55)."""
+        if self._read_hooks:
+            # snapshot fidelity: deferred annotations must be on the
+            # objects the dump captures
+            self.materialize_reads()
         with self._lock:
             # shallow per-resource snapshot under the lock pins the exact
             # keyspace state; the heavy deep copy happens outside it
@@ -458,6 +520,10 @@ class ObjectStore:
         copies = {resource: {key: copy.deepcopy(obj)
                              for key, obj in objs.items()}
                   for resource, objs in kvs.items()}
+        if self._read_hooks:
+            # the replaced keyspace invalidates every deferred record
+            # (new incarnations, new uids): drop them all
+            self._discard_hooks(None)
         with self._lock:
             for resource in list(self.resources):
                 for key in list(self._objects[resource]):

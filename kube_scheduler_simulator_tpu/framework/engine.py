@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import copy
 import functools
+import os
 import time
 
 import numpy as np
@@ -209,7 +210,7 @@ class _WaveCommitter:
     wave still serializes on)."""
 
     def __init__(self, engine: "SchedulerEngine", node_names, pending,
-                 gang: "_GangCtx | None" = None):
+                 gang: "_GangCtx | None" = None, lazy: bool = False):
         import queue
         import threading
 
@@ -217,6 +218,18 @@ class _WaveCommitter:
         self.node_names = node_names
         self.pending = pending
         self.annotations: list = [None] * len(pending)
+        # lazy mode (store/lazy.py): on_chunk skips the decode entirely —
+        # the commit consumes TENSOR-LEVEL decisions (selected/gang
+        # quorum) and deposits LazyWave handles; annotations materialize
+        # on first read, off the wave's critical path
+        self.lazy = lazy
+        self._waves: list = []     # one LazyWave per width-tier replay run
+        self._cur_rr = None
+        # gang ranges can span chunks from two width tiers: remember the
+        # wave each pod's chunk was delivered by (byte-identical across
+        # tiers for delivered chunks, but exactness is free)
+        self._pod_wave: list | None = (
+            [None] * len(pending) if (lazy and gang) else None)
         self.n_bound = 0
         # gang-atomic streaming (docs/gang-scheduling.md): commit ranges
         # are cut on gang boundaries — a gang straddling the chunk edge
@@ -242,15 +255,35 @@ class _WaveCommitter:
     # ---------------------------------------------- replay-thread side
 
     def on_chunk(self, rr, lo: int, hi: int) -> None:
-        # the WHOLE chunk goes down in one call: decode_chunk_into routes
-        # it through the chunk-granular native decode (one GIL-released C
-        # call per chunk, C-side worker pool) when available
-        from ..store.decode import decode_chunk_into
-
-        decode_chunk_into(rr, lo, hi, self.annotations)
         import numpy as np
 
-        self._q.put((lo, hi, np.asarray(rr.selected[lo:hi]).copy()))
+        wave = None
+        if self.lazy:
+            # chunk HANDOFF only: no decode on the replay thread — a
+            # width-tier rerun delivers a fresh ReplayResult, which gets
+            # its own LazyWave (already-committed pods keep handles into
+            # the old one; delivered chunks are bit-identical across
+            # tiers, replay() contract)
+            if self._cur_rr is not rr:
+                from ..store.lazy import LazyWave
+                from .replay import ChunkAttribution
+
+                self._cur_rr = rr
+                w = LazyWave(rr, len(self.pending))
+                # per-plugin attribution tallies on the commit worker,
+                # chunk by chunk, overlapped with the device scan — off
+                # the wave tail (framework/replay.py ChunkAttribution)
+                w._attr_acc = ChunkAttribution(rr)
+                self._waves.append(w)
+            wave = self._waves[-1]
+        else:
+            # the WHOLE chunk goes down in one call: decode_chunk_into
+            # routes it through the chunk-granular native decode (one
+            # GIL-released C call per chunk, C-side worker pool)
+            from ..store.decode import decode_chunk_into
+
+            decode_chunk_into(rr, lo, hi, self.annotations)
+        self._q.put((wave, lo, hi, np.asarray(rr.selected[lo:hi]).copy()))
 
     def finish(self) -> tuple[int, None]:
         """Replay drained: commit the remaining chunks, settle reflects,
@@ -259,6 +292,8 @@ class _WaveCommitter:
         self._q.put(None)
         with TRACER.span("commit_and_reflect", pods=len(self.pending)) as sp:
             self._thread.join()
+            for w in self._waves:
+                w.seal()  # replay drained: deferred reads may decode
             if self._exc is None:
                 self._reflects.drain()
         TRACER.observe("framework_extension_point_duration_seconds",
@@ -280,6 +315,10 @@ class _WaveCommitter:
         self._stop = True
         self._q.put(None)
         self._thread.join()
+        for w in self._waves:
+            # landed commits stand; their handles point at chunks that
+            # were fully delivered before the failure
+            w.seal()
         try:
             self._reflects.drain()
         except Exception:
@@ -296,19 +335,44 @@ class _WaveCommitter:
                 continue  # keep draining so finish() never blocks
             try:
                 t0 = time.perf_counter()
-                lo, hi, selected = item
+                wave, lo, hi, selected = item
                 with TRACER.span("commit_stream", parent=self.parent_span,
                                  lo=lo, hi=hi):
-                    self._commit(lo, hi, selected)
+                    self._commit(wave, lo, hi, selected)
                 self._busy.append((t0, time.perf_counter()))
             except BaseException as e:  # noqa: BLE001 — re-raised in finish()
                 self._exc = e
 
-    def _commit(self, lo: int, hi: int, selected) -> None:
+    def _put_result(self, wave, i: int, ns: str, name: str) -> None:
+        """Deposit pod i's wave result: a lazy handle (tensor-backed,
+        decoded on first read) or the pre-decoded blobs."""
+        if wave is not None:
+            self.engine.result_store.put_lazy(ns, name, wave, i)
+        else:
+            self.engine.result_store.put_decoded(ns, name,
+                                                 self.annotations[i])
+
+    def attribution(self) -> dict | None:
+        """Finished per-plugin attribution for the final replay run, or
+        None (eager mode / broken accumulator).  Call after finish()."""
+        acc = getattr(self._waves[-1], "_attr_acc", None) if self._waves \
+            else None
+        return acc.finish() if acc is not None else None
+
+    def _commit(self, wave, lo: int, hi: int, selected) -> None:
+        if wave is not None:
+            acc = getattr(wave, "_attr_acc", None)
+            if acc is not None:
+                # before the watermark check: re-delivered chunks still
+                # count under the NEW run's accumulator (add_chunk never
+                # raises — broken accumulators just stop tallying)
+                acc.add_chunk(lo // wave.chunk)
         if hi <= self._upto:
             return  # width-tier re-delivery of an already-committed chunk
         if self.gang is not None:
             self._selected[lo:hi] = selected
+            if self._pod_wave is not None:
+                self._pod_wave[lo:hi] = [wave] * (hi - lo)
             cut = self._gang_cut(hi)
             if cut > self._upto:
                 self._commit_gang_range(self._upto, cut)
@@ -316,13 +380,12 @@ class _WaveCommitter:
             return
         eng = self.engine
         names = self.node_names
-        put_decoded = eng.result_store.put_decoded
         items: list[tuple[str, str, str | None]] = []
         uids: list[str | None] = []
         for i in range(max(lo, self._upto), hi):
             meta = self.pending[i].get("metadata") or {}
             ns, name = meta.get("namespace") or "default", meta.get("name", "")
-            put_decoded(ns, name, self.annotations[i])
+            self._put_result(wave, i, ns, name)
             sel = int(selected[i - lo])
             items.append((ns, name, names[sel] if sel >= 0 else None))
             uids.append(meta.get("uid"))
@@ -355,14 +418,15 @@ class _WaveCommitter:
         eng = self.engine
         gang = self.gang
         names = self.node_names
-        put_decoded = eng.result_store.put_decoded
         admit, wait_mask = eng._gang_decide(gang, self._selected, lo, hi)
         items: list[tuple[str, str, str | None]] = []
         uids: list[str | None] = []
         for i in range(lo, hi):
             meta = self.pending[i].get("metadata") or {}
             ns, name = meta.get("namespace") or "default", meta.get("name", "")
-            put_decoded(ns, name, self.annotations[i])
+            self._put_result(
+                self._pod_wave[i] if self._pod_wave is not None else None,
+                i, ns, name)
             sel = int(self._selected[i])
             g = int(gang.gid[i])
             parked = False
@@ -880,13 +944,19 @@ class SchedulerEngine:
                         namespaces=self._list_shared("namespaces"))
                     TRACER.count("speculative_rounds_total",
                                  spec_stats["rounds"])
+                self._record_attribution(rr, sp.seconds)
+                if self._wave_lazy_ok():
+                    from ..store.lazy import LazyWave
+
+                    return self._finish_wave(
+                        cw, rr, None, pending, exclude,
+                        lazy_wave=LazyWave(rr, len(pending), sealed=True))
                 # rr's arrays are final host numpy here: decode through
                 # the pooled chunk decoder like the scan path, not one
                 # pod at a time on the commit thread
                 all_annotations = [None] * len(pending)
                 with TRACER.span("decode_stream", pods=len(pending)):
                     decode_chunk_into(rr, 0, len(pending), all_annotations)
-                self._record_attribution(rr, sp.seconds)
                 return self._finish_wave(cw, rr, all_annotations, pending,
                                          exclude)
 
@@ -907,9 +977,12 @@ class SchedulerEngine:
             # -store puts, batched binds/unschedulable marks, reflect
             # submissions, pod order preserved) while the device scans
             # later chunks — instead of the whole wave idling through a
-            # sequential post-pass after the replay drains
+            # sequential post-pass after the replay drains.  In lazy
+            # mode the worker consumes tensor-level decisions only and
+            # the decode leaves the critical path entirely.
             committer = _WaveCommitter(self, cw.node_table.names, pending,
-                                       gang=self._gang_wave)
+                                       gang=self._gang_wave,
+                                       lazy=self._wave_lazy_ok())
             try:
                 with TRACER.span("replay_and_decode_stream",
                                  pods=len(pending), nodes=len(nodes)) as sp:
@@ -923,8 +996,25 @@ class SchedulerEngine:
                 committer.abort()
                 raise
             result = committer.finish()
-            self._record_attribution(rr, sp.seconds)
+            self._record_attribution(rr, sp.seconds,
+                                     att=committer.attribution())
             return result
+
+        if self._wave_lazy_ok():
+            # sequential post-pass, lazy: the replay streams only the
+            # compact tensors (no on_chunk decode at all); the commit
+            # below deposits LazyWave handles and defers the reflect —
+            # first read materializes (store/lazy.py)
+            from ..store.lazy import LazyWave
+
+            with TRACER.span("replay_and_decode_stream", pods=len(pending),
+                             nodes=len(nodes)) as sp:
+                rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
+                            mesh=mesh, unroll=self.unroll)
+            self._record_attribution(rr, sp.seconds)
+            return self._finish_wave(
+                cw, rr, None, pending, exclude,
+                lazy_wave=LazyWave(rr, len(pending), sealed=True))
 
         # stream: each chunk decodes (chunk-granular native call, or the
         # host thread pool on the fallback ladder) as soon as its
@@ -939,6 +1029,28 @@ class SchedulerEngine:
         self._record_attribution(rr, sp.seconds)
         return self._finish_wave(cw, rr, all_annotations, pending, exclude)
 
+    def _wave_lazy_ok(self) -> bool:
+        """True when this wave may defer annotation decode to first read
+        (store/lazy.py): lazy is the default on the batched tensor paths
+        — the commit consumes tensor-level decisions only, so decoding
+        on the critical path buys nothing — and turns off when
+
+          * KSS_TPU_EAGER_DECODE=1 (the golden/parity baseline mode);
+          * plugin-extender observers are registered (after_cycle sees
+            each pod's decoded annotations during the wave);
+          * the store/reflector pair cannot make deferred results
+            transparent to readers (no read hooks / no batch surface —
+            e.g. the remote HTTP cluster client).
+
+        The host-interleaved and custom-lifecycle paths decode per pod
+        regardless (their cycles consume annotations inline)."""
+        if os.environ.get("KSS_TPU_EAGER_DECODE") == "1":
+            return False
+        if self._extenders_map():
+            return False
+        return self.reflector.defer_supported() \
+            if hasattr(self.reflector, "defer_supported") else False
+
     def _can_stream_commit(self) -> bool:
         """True when nothing in the configuration forces the sequential
         post-pass: no plugin-extender observers (after_cycle sees each
@@ -952,7 +1064,8 @@ class SchedulerEngine:
                 and not self._custom_lifecycle_plugins()
                 and not self.plugin_config.postfilters())
 
-    def _record_attribution(self, rr, replay_seconds: float) -> None:
+    def _record_attribution(self, rr, replay_seconds: float,
+                            att: dict | None = None) -> None:
         """Per-plugin attribution from the replay tensors the wave
         already decoded (docs/metrics.md): labeled WORK counters (pods x
         nodes evaluated, first-fail filter rejects, raw score column
@@ -966,7 +1079,11 @@ class SchedulerEngine:
             from .replay import plugin_attribution
 
             t0 = time.perf_counter()
-            att = plugin_attribution(rr)
+            if att is None:
+                # streaming lazy waves pass the worker-accumulated
+                # tallies instead (ChunkAttribution); everything else
+                # pays the whole-result pass here
+                att = plugin_attribution(rr)
             if att is None:
                 return
             work: dict[tuple[str, str], int] = {}
@@ -1008,11 +1125,17 @@ class SchedulerEngine:
             pass  # attribution is observability; waves never fail on it
 
     def _finish_wave(self, cw, rr, all_annotations, pending,
-                     exclude: set[tuple[str, str]] | None
-                     ) -> tuple[int, str | None]:
+                     exclude: set[tuple[str, str]] | None,
+                     lazy_wave=None) -> tuple[int, str | None]:
         """Commit + reflect phase of a wave, shared by the scan and
         speculative replay paths: result-store puts, extender hooks,
-        custom lifecycle, binds, postfilter/preemption, write-backs."""
+        custom lifecycle, binds, postfilter/preemption, write-backs.
+
+        lazy_wave: a sealed LazyWave standing in for all_annotations —
+        the commit deposits handles and routes write-backs through
+        reflect_batch so they defer with the decode (store/lazy.py);
+        callers pass it only when no hook/lifecycle consumer needs the
+        decoded bytes during the wave."""
         postfilter_on = bool(self.plugin_config.postfilters())
         n_bound = 0
         retry: str | None = None
@@ -1020,8 +1143,10 @@ class SchedulerEngine:
         # on informer callbacks, async from scheduleOne): fan them over a
         # small pool — the native escape pass releases the GIL — and
         # settle before the wave returns.  Per-pod reflect (use_batch=
-        # False) keeps this post-pass on its pre-change write mechanism.
-        reflects = _ReflectBatcher(self, len(pending), use_batch=False)
+        # False) keeps this post-pass on its pre-change write mechanism;
+        # lazy waves use the batch surface, whose deferral IS the point.
+        reflects = _ReflectBatcher(self, len(pending),
+                                   use_batch=lazy_wave is not None)
 
         emap = self._extenders_map()
         has_lc = bool(self._custom_lifecycle_plugins())
@@ -1037,12 +1162,16 @@ class SchedulerEngine:
             for i, pod in enumerate(pending):
                 meta = pod.get("metadata") or {}
                 ns, name = meta.get("namespace") or "default", meta.get("name", "")
-                annotations = all_annotations[i]
-                self.result_store.put_decoded(ns, name, annotations)
+                if lazy_wave is not None:
+                    self.result_store.put_lazy(ns, name, lazy_wave, i)
+                else:
+                    annotations = all_annotations[i]
+                    self.result_store.put_decoded(ns, name, annotations)
                 # one private copy serves every third-party surface this
                 # cycle (hooks and plugins must not reach shared manifests)
                 priv = copy.deepcopy(pod) if emap or has_lc else pod
                 if emap:
+                    # extender observers force eager waves (_wave_lazy_ok)
                     for hook in emap.values():
                         hook.after_cycle(priv, annotations, self.result_store)
                 sel = int(rr.selected[i])

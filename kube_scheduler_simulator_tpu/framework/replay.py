@@ -249,6 +249,158 @@ class ReplayResult:
         return self._score_final
 
 
+class ChunkAttribution:
+    """Incremental per-chunk work attribution over a compact replay.
+
+    The whole-wave `plugin_attribution` pass costs seconds at fleet
+    scale (5.6s at 10k x 5k) and used to run on the wave's critical
+    path after the replay drained.  This accumulator computes the same
+    tallies one chunk at a time, so the streaming commit worker — idle
+    in lazy-decode mode — runs them WHILE the device scans later chunks
+    and the wave tail only pays `finish()` (prefilter section + any
+    chunk the worker didn't reach).  Single-threaded by contract: the
+    worker adds chunks during the wave, the engine calls finish() after
+    joining it.  Attribution is observability — any failure marks the
+    accumulator broken and finish() returns None, never failing a wave.
+    """
+
+    def __init__(self, rr: ReplayResult):
+        self.rr = rr
+        cw = rr.cw
+        self.filters = cw.config.filters()
+        self.scorers = cw.config.scorers()
+        self.p = cw.n_pods
+        self.fskip = cw.host.get("filter_skip", {})
+        self.sskip = cw.host.get("score_skip", {})
+        self.fskip_mat = (
+            np.stack([np.asarray(self.fskip.get(n, np.zeros(self.p)), bool)
+                      for n in self.filters])
+            if self.filters else None)  # [F, P]
+        self.static_rows = cw.host.get("static_score_rows", {})
+        self.out = {
+            "filter": {n: {"evaluated": 0, "rejects": 0}
+                       for n in self.filters},
+            "score": {n: {"evaluated": 0, "sum": 0} for n in self.scorers},
+            "prefilter": {},
+        }
+        self._done: set[int] = set()
+        self.broken = False
+
+    def add_chunk(self, ci: int) -> None:
+        """Tally compact chunk ci (idempotent; width-tier re-deliveries
+        are bit-identical so first-tally wins)."""
+        cc = self.rr._compact
+        if self.broken or cc is None or ci in self._done:
+            return
+        if ci >= len(cc.packed):
+            return  # not ingested (defensive; callers pass delivered chunks)
+        self._done.add(ci)
+        try:
+            self._tally_chunk(ci, cc)
+        except Exception:  # noqa: BLE001 — observability must not fail waves
+            self.broken = True
+
+    def _tally_chunk(self, ci: int, cc: _CompactChunks) -> None:
+        from .pipeline import PACK_MODES
+
+        _, code_bits, _ = PACK_MODES[cc.pack_mode]
+        lo = ci * cc.chunk
+        hi = min(lo + cc.chunk, self.p)
+        m = hi - lo
+        ffp = (np.asarray(cc.packed[ci][:m]).astype(np.int64) >> code_bits)
+
+        def arr_of(s: int) -> np.ndarray:
+            group, row = cc.score_cols[s]
+            if group == "host":
+                return np.asarray(self.static_rows[row][lo:hi])
+            # native-dtype slice view: the sum below accumulates into
+            # int64 via dtype=, no whole-column up-conversion copy
+            return getattr(cc, group)[ci][:m, row, :]
+
+        self._tally(lo, hi, ffp, arr_of)
+
+    def _tally(self, lo: int, hi: int, ffp: np.ndarray,
+               score_arr_of) -> None:
+        """ffp: [m, N] first-fail words (0 == all active filters pass);
+        score_arr_of(s) -> [m, N] raw column for scorer s (any integer
+        dtype; sums accumulate in int64)."""
+        out = self.out
+        f_count = len(self.filters)
+        m = hi - lo
+        if f_count:
+            # per-pod histogram of first-fail values 0..F, one bincount
+            flat = (np.arange(m, dtype=np.int64)[:, None] * (f_count + 1)
+                    + ffp).ravel()
+            counts = np.bincount(flat, minlength=m * (f_count + 1)) \
+                .reshape(m, f_count + 1)
+            rejects = counts[:, 1:]                        # [m, F]
+            # plugin f ran on a node iff ffp == 0 or ffp > f:
+            # all-pass nodes + nodes whose first fail is at a later index
+            suff = np.cumsum(rejects[:, ::-1], axis=1)[:, ::-1]
+            ran = counts[:, :1] + suff                     # [m, F]
+            for f, name in enumerate(self.filters):
+                out["filter"][name]["rejects"] += int(rejects[:, f].sum())
+                col = ran[:, f]
+                skips = self.fskip_mat[f, lo:hi]
+                if skips.any():
+                    col = np.where(skips, 0, col)
+                out["filter"][name]["evaluated"] += int(col.sum())
+        if self.scorers:
+            feas = ffp == 0                                # [m, N]
+            feas_cnt = feas.sum(axis=1)
+            fc = self.rr.feasible_count
+            scored = (np.asarray(fc[lo:hi]) > 1 if fc is not None
+                      else np.zeros(m, bool))
+            if not scored.any():
+                return
+            for s, name in enumerate(self.scorers):
+                sk = self.sskip.get(name)
+                s_on = (scored if sk is None
+                        else scored & ~np.asarray(sk[lo:hi], bool))
+                rows = np.flatnonzero(s_on)
+                if not rows.size:
+                    continue
+                arr = score_arr_of(s)
+                out["score"][name]["evaluated"] += int(feas_cnt[rows].sum())
+                # masked sum without materializing an int64 product array
+                out["score"][name]["sum"] += int(np.sum(
+                    arr[rows], dtype=np.int64, where=feas[rows]))
+
+    def _prefilter(self) -> None:
+        rr = self.rr
+        cw = rr.cw
+        static = cw.host.get("prefilter_reject", {})
+        dyn = (np.asarray(rr.prefilter_reject)
+               if rr.prefilter_reject is not None
+               else np.zeros(self.p, np.int64))
+        for name in cw.config.prefilters():
+            skips = self.fskip.get(name)
+            evaluated = self.p - (
+                int(np.count_nonzero(np.asarray(skips, bool)))
+                if skips is not None else 0)
+            screened = 0
+            msgs = static.get(name)
+            if msgs is not None:
+                screened += sum(1 for msg in msgs if msg is not None)
+            if name == "VolumeRestrictions":
+                screened += int(np.count_nonzero(
+                    np.asarray(dyn, np.int64) & 1))
+            self.out["prefilter"][name] = {"evaluated": evaluated,
+                                           "screened": screened}
+
+    def finish(self) -> dict | None:
+        """Complete the attribution: tally whatever chunks the worker
+        didn't reach, add the prefilter section. None when broken."""
+        cc = self.rr._compact
+        if cc is not None:
+            for ci in range(len(cc.packed)):
+                self.add_chunk(ci)
+        if self.broken:
+            return None
+        self._prefilter()
+        return self.out
+
+
 def plugin_attribution(rr: ReplayResult) -> dict | None:
     """Per-plugin work attribution reconstructed from the replay tensors
     a wave already holds — no extra device work, no annotation-path
@@ -270,113 +422,23 @@ def plugin_attribution(rr: ReplayResult) -> dict | None:
     (PreFilter-skip) plugins attribute nothing.  Fused device execution
     has no per-plugin wall clock — these WORK units are the per-plugin
     truth, and what the engine's apportioned plugin_execution histogram
-    is derived from (docs/metrics.md)."""
+    is derived from (docs/metrics.md).  The compact path delegates to
+    ChunkAttribution (the streaming committer runs it chunk-at-a-time
+    during the wave; this whole-result entry serves everything else)."""
     cw = rr.cw
-    cfg = cw.config
-    filters = cfg.filters()
-    scorers = cfg.scorers()
-    prefilters = cfg.prefilters()
     p = cw.n_pods
     if p == 0:
         return None
-    fskip = cw.host.get("filter_skip", {})
-    sskip = cw.host.get("score_skip", {})
-    out = {
-        "filter": {n: {"evaluated": 0, "rejects": 0} for n in filters},
-        "score": {n: {"evaluated": 0, "sum": 0} for n in scorers},
-        "prefilter": {},
-    }
-    static = cw.host.get("prefilter_reject", {})
-    dyn = (np.asarray(rr.prefilter_reject)
-           if rr.prefilter_reject is not None else np.zeros(p, np.int64))
-    for name in prefilters:
-        skips = fskip.get(name)
-        evaluated = p - (int(np.count_nonzero(np.asarray(skips, bool)))
-                         if skips is not None else 0)
-        screened = 0
-        msgs = static.get(name)
-        if msgs is not None:
-            screened += sum(1 for m in msgs if m is not None)
-        if name == "VolumeRestrictions":
-            screened += int(np.count_nonzero(
-                np.asarray(dyn, np.int64) & 1))
-        out["prefilter"][name] = {"evaluated": evaluated,
-                                  "screened": screened}
-
     cc = rr._compact
-    f_count = len(filters)
-    fskip_mat = (np.stack([np.asarray(fskip.get(n, np.zeros(p)), bool)
-                           for n in filters])
-                 if f_count else None)  # [F, P]
-    feasible_count = (np.asarray(rr.feasible_count)
-                      if rr.feasible_count is not None
-                      else np.zeros(p, np.int32))
-    static_rows = cw.host.get("static_score_rows", {})
-
-    def _tally(lo: int, hi: int, ffp: np.ndarray,
-               score_arr_of) -> None:
-        """ffp: [m, N] first-fail words (0 == all active filters pass);
-        score_arr_of(s) -> [m, N] int64 raw column for scorer s."""
-        m = hi - lo
-        if f_count:
-            # per-pod histogram of first-fail values 0..F, one bincount
-            flat = (np.arange(m, dtype=np.int64)[:, None] * (f_count + 1)
-                    + ffp).ravel()
-            counts = np.bincount(flat, minlength=m * (f_count + 1)) \
-                .reshape(m, f_count + 1)
-            rejects = counts[:, 1:]                        # [m, F]
-            # plugin f ran on a node iff ffp == 0 or ffp > f:
-            # all-pass nodes + nodes whose first fail is at a later index
-            suff = np.cumsum(rejects[:, ::-1], axis=1)[:, ::-1]
-            ran = counts[:, :1] + suff                     # [m, F]
-            for f, name in enumerate(filters):
-                out["filter"][name]["rejects"] += int(rejects[:, f].sum())
-                col = ran[:, f]
-                skips = fskip_mat[f, lo:hi]
-                if skips.any():
-                    col = np.where(skips, 0, col)
-                out["filter"][name]["evaluated"] += int(col.sum())
-        if scorers:
-            feas = ffp == 0                                # [m, N]
-            feas_cnt = feas.sum(axis=1)
-            scored = feasible_count[lo:hi] > 1
-            if not scored.any():
-                return
-            feas64 = feas.astype(np.int64)
-            for s, name in enumerate(scorers):
-                sk = sskip.get(name)
-                s_on = (scored if sk is None
-                        else scored & ~np.asarray(sk[lo:hi], bool))
-                rows = np.flatnonzero(s_on)
-                if not rows.size:
-                    continue
-                arr = score_arr_of(s)
-                out["score"][name]["evaluated"] += int(feas_cnt[rows].sum())
-                out["score"][name]["sum"] += int(
-                    (arr[rows] * feas64[rows]).sum())
-
     if cc is not None and cc.packed:
-        from .pipeline import PACK_MODES
-
-        _, code_bits, _ = PACK_MODES[cc.pack_mode]
-        for ci in range(len(cc.packed)):
-            lo = ci * cc.chunk
-            hi = min(lo + cc.chunk, p)
-            m = hi - lo
-            ffp = (np.asarray(cc.packed[ci][:m]).astype(np.int64)
-                   >> code_bits)
-
-            def arr_of(s: int, ci=ci, lo=lo, hi=hi, m=m) -> np.ndarray:
-                group, row = cc.score_cols[s]
-                if group == "host":
-                    return np.asarray(static_rows[row][lo:hi], np.int64)
-                return np.asarray(getattr(cc, group)[ci][:m, row, :],
-                                  np.int64)
-
-            _tally(lo, hi, ffp, arr_of)
-        return out
+        return ChunkAttribution(rr).finish()
+    acc = ChunkAttribution(rr)
+    prefilters = cw.config.prefilters()
     if rr._filter_codes is None and rr._score_raw is None:
-        return None if not prefilters else out
+        if not prefilters:
+            return None
+        acc._prefilter()
+        return acc.out
     # full-array layout (the speculative path): derive the first-fail
     # index from the per-plugin codes, same stop-at-first-fail rule
     codes = np.asarray(rr._filter_codes) if rr._filter_codes is not None \
@@ -392,9 +454,11 @@ def plugin_attribution(rr: ReplayResult) -> dict | None:
         # no filter plugins: argmax over the empty axis would raise —
         # every node passes, first-fail is uniformly 0
         ffp_full = np.zeros((p, codes.shape[2]), np.int64)
-    _tally(0, p, ffp_full,
-           lambda s: np.asarray(raw[:, s, :], np.int64))
-    return out
+    acc._tally(0, p, ffp_full, lambda s: np.asarray(raw[:, s, :], np.int64))
+    if acc.broken:
+        return None
+    acc._prefilter()
+    return acc.out
 
 
 def _slice_xs(xs: dict[str, Any], lo: int, hi: int, pad_to: int) -> dict[str, Any]:

@@ -77,7 +77,13 @@ class ResourceWatcherService:
                 # of the listing and double-deliver every object.  Events
                 # racing in between are > list_rv and still buffered, so
                 # nothing is lost.
-                # shared manifests: send() serializes, never mutates
+                # shared manifests: send() serializes, never mutates.
+                # Deferred lazy annotations (store/lazy.py) are drained
+                # first so the initial listing carries the same bytes a
+                # copying read would
+                flush = getattr(self.store, "materialize_reads", None)
+                if flush is not None:
+                    flush(resource)
                 items, list_rv = self.store.list(resource,
                                                  copy_objects=False)
                 q = self.store.watch(resource, since_rv=list_rv)
@@ -95,17 +101,46 @@ class ResourceWatcherService:
 
         def pump(resource, q):
             kind, _ = registry[resource]
+            flush = (getattr(self.store, "materialize_reads", None)
+                     if resource == "pods" else None)
             while not (stop.is_set() or dead.is_set()):
                 ev = q.get()
                 if ev is None:
                     return
                 _, event_type, obj = ev
+                if flush is not None and event_type != "DELETED":
+                    # a watch client is a reader: drain this pod's
+                    # deferred annotations (no-op when none pending) so
+                    # the reflect MODIFIED event follows this one and
+                    # the client converges on the eager path's stream
+                    meta = obj.get("metadata") or {}
+                    flush("pods", meta.get("name"), meta.get("namespace"))
                 if not stream.send(kind, event_type, obj):
                     dead.set()
                     return
 
         for resource, q in queues.items():
             t = threading.Thread(target=pump, args=(resource, q), daemon=True)
+            t.start()
+            threads.append(t)
+        if "pods" in queues and hasattr(self.store, "materialize_reads"):
+            # convergence for watch-only clients: a record queued by a
+            # still-streaming wave is SKIPPED by the per-event flush
+            # (never stall the stream on an in-flight replay), and the
+            # wave emits no further event once it seals — so while this
+            # connection is open, periodically drain whatever became
+            # ready; the resulting reflect MODIFIED events reach the
+            # stream like eager mode's wave-end write-backs would
+            def laggard():
+                while not (stop.is_set() or dead.is_set()):
+                    if stop.wait(0.25) or dead.is_set():
+                        return
+                    try:
+                        self.store.materialize_reads("pods")
+                    except Exception:
+                        pass  # observability of the flush, not the stream
+
+            t = threading.Thread(target=laggard, daemon=True)
             t.start()
             threads.append(t)
         while not (stop.is_set() or dead.is_set()):

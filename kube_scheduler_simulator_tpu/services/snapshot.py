@@ -67,6 +67,11 @@ class SnapshotService:
         from ..cluster.store import list_shared
 
         opts = options or SnapshotOptions()
+        # the export must carry deferred lazy annotations (store/lazy.py)
+        # even though the shared-manifest listing below skips read hooks
+        flush = getattr(self.store, "materialize_reads", None)
+        if flush is not None:
+            flush("pods")
         out: dict = {}
         for field, resource in _FIELDS + self._extra_fields():
             try:

@@ -135,6 +135,300 @@ def update_result_history(pod: dict, result_set: dict[str, str],
     )
 
 
+class _PendingRecord:
+    """One deferred wave write-back for a pod: the uid the wave
+    committed against (the reflect() recreation guard) and the ordered
+    result parts (DeferredResult handles and/or eager dicts, in result
+    -store registration order)."""
+
+    __slots__ = ("uid", "parts")
+
+    def __init__(self, uid: str | None, parts: list):
+        self.uid = uid
+        self.parts = parts
+
+    def ready(self) -> bool:
+        """True when materializing cannot block (every lazy part's wave
+        is sealed).  A record queued by a still-streaming wave is NOT
+        ready: a reader skips it — the bind event it trails is already
+        annotation-less in eager mode too at that point — instead of
+        stalling on the replay; it lands on the first read after the
+        wave seals."""
+        return all(p.ready() for p in self.parts if hasattr(p, "ready"))
+
+    def result_set(self) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for part in self.parts:
+            out.update(part.result_set() if hasattr(part, "result_set")
+                       else part)
+        return out
+
+
+class LazyReflections:
+    """Deferred reflector write-backs, drained by ObjectStore read hooks.
+
+    reflect_batch() queues a _PendingRecord per pod instead of
+    materializing blobs on the wave's critical path; the first read of
+    the pod (GET / copying list / export / the HTTP watch stream)
+    drains its queue — records apply IN ORDER, so a pod scheduled by
+    several waves before anyone reads it gets exactly the eager path's
+    annotation bytes and result-history sequence.  Exactly-once per
+    pod under concurrent readers (in-flight event handshake); the
+    decode and the store write run with NO registry lock held."""
+
+    def __init__(self, store):
+        import threading
+
+        self.store = store
+        self._mu = threading.Lock()
+        self._pending: dict[tuple[str, str], list[_PendingRecord]] = {}
+        self._inflight: dict[tuple[str, str], object] = {}
+
+    def add(self, namespace: str, name: str, uid: str | None,
+            parts: list) -> None:
+        key = (namespace or "default", name)
+        with self._mu:
+            self._pending.setdefault(key, []).append(
+                _PendingRecord(uid, parts))
+
+    def has(self, namespace: str, name: str) -> bool:
+        with self._mu:
+            return (namespace or "default", name) in self._pending
+
+    def pending_count(self) -> int:
+        with self._mu:
+            return sum(len(v) for v in self._pending.values())
+
+    # ------------------------------------------- ObjectStore hook surface
+
+    def flush(self, resource: str | None, name: str | None = None,
+              namespace: str | None = None) -> None:
+        if resource not in (None, "pods"):
+            return
+        if name is not None:
+            self._drain((namespace or "default", name))
+            return
+        self._drain_all()
+
+    def discard(self, resource: str | None, name: str | None = None,
+                namespace: str | None = None) -> None:
+        if resource not in (None, "pods"):
+            return
+        with self._mu:
+            if name is None:
+                self._pending.clear()
+            else:
+                self._pending.pop((namespace or "default", name), None)
+
+    # ---------------------------------------------------------- drain
+
+    @staticmethod
+    def _take_ready_locked(recs: list[_PendingRecord]) -> list[_PendingRecord]:
+        """The longest READY prefix (order must hold: a later record may
+        never land before an earlier one, so an unready record blocks
+        everything after it — but never the reader)."""
+        n = 0
+        for rec in recs:
+            if not rec.ready():
+                break
+            n += 1
+        return recs[:n]
+
+    def _drain(self, key: tuple[str, str]) -> None:
+        import threading
+
+        with self._mu:
+            ev = self._inflight.get(key)
+            if ev is not None:
+                owner = False
+            else:
+                recs = self._pending.get(key)
+                if not recs:
+                    return
+                ready = self._take_ready_locked(recs)
+                if not ready:
+                    return  # in-flight wave's timeline: skip, don't stall
+                if len(ready) == len(recs):
+                    del self._pending[key]
+                else:
+                    self._pending[key] = recs[len(ready):]
+                recs = ready
+                ev = self._inflight[key] = threading.Event()
+                owner = True
+        if not owner:
+            # another reader is applying this pod's records: wait so our
+            # caller's subsequent read observes the written annotations
+            ev.wait()
+            return
+        try:
+            self._apply(key, recs)
+        except BaseException:
+            with self._mu:
+                # put the unapplied records back at the FRONT so order
+                # is preserved for the next reader
+                self._pending.setdefault(key, [])[:0] = recs
+                del self._inflight[key]
+            ev.set()
+            raise
+        with self._mu:
+            del self._inflight[key]
+        ev.set()
+
+    def _drain_all(self) -> None:
+        """Whole-resource flush (copying list / dump / export): ONE
+        snapshot of the pending keys — records a concurrent wave adds
+        mid-flush belong to that wave's timeline, not this read's — and
+        one batched write through the store's apply_batch surface (a
+        10k-pod drain costs one lock hold and one contiguous rv range,
+        like the eager reflect_batch it replaces, instead of 10k
+        conflict-retried updates)."""
+        import threading
+
+        if getattr(self.store, "apply_batch", None) is None:
+            with self._mu:
+                keys = list(self._pending)
+            for key in keys:
+                self._drain(key)
+            return
+        taken: list[tuple[tuple[str, str], list[_PendingRecord]]] = []
+        events: dict[tuple[str, str], threading.Event] = {}
+        busy: list[threading.Event] = []
+        with self._mu:
+            for key in list(self._pending):
+                ev = self._inflight.get(key)
+                if ev is not None:
+                    busy.append(ev)
+                    continue
+                recs = self._pending[key]
+                ready = self._take_ready_locked(recs)
+                if not ready:
+                    continue
+                if len(ready) == len(recs):
+                    del self._pending[key]
+                else:
+                    self._pending[key] = recs[len(ready):]
+                ev = threading.Event()
+                self._inflight[key] = ev
+                events[key] = ev
+                taken.append((key, ready))
+        try:
+            if taken:
+                self._apply_batch(taken)
+        except BaseException:
+            with self._mu:
+                for key, recs in taken:
+                    self._pending.setdefault(key, [])[:0] = recs
+                    del self._inflight[key]
+            for ev in events.values():
+                ev.set()
+            raise
+        with self._mu:
+            for key in events:
+                del self._inflight[key]
+        for ev in events.values():
+            ev.set()
+        for ev in busy:
+            # per-pod drains racing this flush: wait so the caller's
+            # read observes their writes too
+            ev.wait()
+
+    def _apply_batch(self, taken) -> None:
+        """Materialize + write many pods' deferred records through ONE
+        apply_batch call.  The decode and the history-record encode (the
+        escape pass over ~250KB of blobs per pod) run HERE, before the
+        store lock — the mutate callbacks only merge and splice (the
+        PR 2 off-lock rule, same as reflect_batch's prepare phase)."""
+        prepared = []
+        for key, recs in taken:
+            sets = []
+            for rec in recs:
+                result_set = rec.result_set()
+                hist_rec = None
+                skip_history = False
+                try:
+                    hist_rec = encode_history_record(result_set)
+                except ValueError as e:
+                    skip_history = True
+                    import sys
+
+                    print(f"reflector: result-history not updated: {e}",
+                          file=sys.stderr)
+                sets.append((rec.uid, result_set, hist_rec, skip_history))
+            prepared.append((key, sets))
+
+        def mutation(key, sets):
+            def mutate(pod: dict):
+                meta = pod.get("metadata") or {}
+                cur_uid = meta.get("uid")
+                live = [s for s in sets
+                        if not (s[0] and cur_uid not in (None, s[0]))]
+                if not live:
+                    return False
+                annotations = dict(meta.get("annotations") or {})
+                meta["annotations"] = annotations
+                for _uid, result_set, hist_rec, skip_history in live:
+                    annotations.update(result_set)
+                    if skip_history:
+                        continue
+                    try:
+                        update_result_history(pod, result_set, rec=hist_rec)
+                    except ValueError as e:
+                        import sys
+
+                        print(f"reflector: result-history not updated: {e}",
+                              file=sys.stderr)
+                return True
+
+            return mutate
+
+        self.store.apply_batch("pods", [
+            (key[1], key[0], mutation(key, sets))
+            for key, sets in prepared
+        ])
+
+    def _apply(self, key: tuple[str, str], recs: list[_PendingRecord]) -> None:
+        """reflect()'s per-pod semantics for a queue of deferred
+        records: uid guard per record, annotation merge + history
+        append in record order, ONE conflict-retried update."""
+        namespace, name = key
+
+        def attempt() -> tuple[bool, Exception | None]:
+            try:
+                cur = self.store.get("pods", name, namespace,
+                                     copy_object=False)
+            except NotFound:
+                return True, None
+            cur_uid = (cur.get("metadata") or {}).get("uid")
+            live = [r for r in recs
+                    if not (r.uid and cur_uid not in (None, r.uid))]
+            if not live:
+                return True, None
+            pod = dict(cur)
+            meta = dict(cur.get("metadata") or {})
+            annotations = dict(meta.get("annotations") or {})
+            meta["annotations"] = annotations
+            pod["metadata"] = meta
+            for rec in live:
+                result_set = rec.result_set()
+                annotations.update(result_set)
+                try:
+                    update_result_history(pod, result_set)
+                except ValueError as e:
+                    import sys
+
+                    print(f"reflector: result-history not updated: {e}",
+                          file=sys.stderr)
+            try:
+                self.store.update("pods", pod, owned=True)
+            except NotFound:
+                return True, None
+            except Conflict:
+                return False, None  # re-fetch and retry
+            return True, None
+
+        retry_with_exponential_backoff(attempt)
+
+
 def reflect_each(reflect_fn, items) -> None:
     """reflect_fn(ns, name, uid=uid) for EVERY (ns, name, uid) item even
     if an earlier one fails; the first error surfaces after the sweep —
@@ -158,6 +452,23 @@ class StoreReflector:
         self._sleep = sleep  # injectable for tests
         self._watch_thread = None
         self._watch_queue = None
+        self._lazy: LazyReflections | None = None
+
+    def defer_supported(self) -> bool:
+        """True when this reflector can defer wave write-backs: the
+        store offers both the batched-commit surface and the read hooks
+        that make deferred annotations transparent to readers."""
+        return (getattr(self.store, "apply_batch", None) is not None
+                and getattr(self.store, "add_read_hook", None) is not None)
+
+    def lazy_pending(self) -> LazyReflections:
+        """The deferred write-back registry, installed as a store read
+        hook on first use (store/lazy.py module docs)."""
+        if self._lazy is None:
+            reg = LazyReflections(self.store)
+            self.store.add_read_hook(reg)
+            self._lazy = reg
+        return self._lazy
 
     def add_result_store(self, result_store, key: str) -> None:
         """reference: storereflector.go AddResultStore."""
@@ -210,8 +521,12 @@ class StoreReflector:
                     # only fire when some store holds a result for the pod
                     # (the reference's handler re-GETs and no-ops
                     # otherwise; checking first avoids a write cycle per
-                    # unrelated update)
-                    if any(rs.get_stored_result(obj)
+                    # unrelated update).  has_result is the
+                    # non-materializing probe — get_stored_result on a
+                    # lazy entry would decode the pod's chunk per event
+                    if any(rs.has_result(obj)
+                           if hasattr(rs, "has_result")
+                           else rs.get_stored_result(obj)
                            for rs in self.result_stores.values()):
                         try:
                             self.reflect(ns, name, uid=meta.get("uid"))
@@ -244,6 +559,11 @@ class StoreReflector:
         deleted and recreated under the same name since scheduling — the
         reference aborts on UID mismatch (storereflector.go:107-109) so a
         fresh pod never inherits a stale result record."""
+        if self._lazy is not None:
+            # deferred records from earlier waves must land BEFORE this
+            # cycle's result, or the pod's annotations and history would
+            # reorder relative to the eager path
+            self._lazy.flush("pods", name, namespace)
 
         last_pod: dict = {}
 
@@ -322,13 +642,40 @@ class StoreReflector:
         if getattr(self.store, "apply_batch", None) is None:
             reflect_each(self.reflect, items)
             return
+        defer_ok = getattr(self.store, "add_read_hook", None) is not None
         prepared: list[tuple] = []
         for ns, name, uid in items:
             key_pod = {"metadata": {"namespace": ns, "name": name}}
-            result_set: dict[str, str] = {}
+            # lazy entries defer whole: take the consumed snapshot into
+            # the pending registry instead of decoding here — the wave's
+            # critical path carries only tensor handles (store/lazy.py)
+            parts: list = []
+            any_lazy = False
             for rs in self.result_stores.values():
-                m = rs.get_stored_result(key_pod) or {}
-                result_set.update(m)
+                d = None
+                if defer_ok:
+                    taker = getattr(rs, "take_deferred", None)
+                    if taker is not None:
+                        d = taker(ns, name)
+                if d is not None:
+                    parts.append(d)
+                    any_lazy = True
+                else:
+                    m = rs.get_stored_result(key_pod) or {}
+                    if m:
+                        parts.append(m)
+            if not parts:
+                continue
+            if any_lazy:
+                self.lazy_pending().add(ns, name, uid, parts)
+                continue
+            if self._lazy is not None and self._lazy.has(ns, name):
+                # eager result over a pod with older deferred records:
+                # land those first so history order matches eager mode
+                self._lazy.flush("pods", name, ns)
+            result_set: dict[str, str] = {}
+            for part in parts:
+                result_set.update(part)
             if not result_set:
                 continue
             rec = None

@@ -13,6 +13,15 @@ The tensor engine deposits whole decoded result maps via put_decoded()
 methods serve host-side escape hatches (extenders, plugin extenders) and
 API compatibility.  Granular adds and decoded deposits merge: granular
 values overwrite the decoded blob for the touched keys.
+
+Lazy mode (store/lazy.py, the default on the batched wave paths): the
+engine deposits a `(wave, index)` handle via put_lazy() instead of the
+decoded blobs; get_stored_result() materializes the pod's chunk through
+the wave's memoized chunk decode transparently, and take_deferred()
+hands the whole entry to the reflector as a deferred write-back so the
+wave's critical path never decodes at all.  The merge semantics are
+unchanged: the lazily materialized 13 keys are the base, decoded
+deposits overlay them, granular adds overlay both.
 """
 
 from __future__ import annotations
@@ -30,13 +39,16 @@ def _key(namespace: str, pod_name: str) -> str:
     return f"{namespace}/{pod_name}"
 
 
+_FIELDS = (
+    "selected_node", "pre_score", "score", "final_score",
+    "pre_filter_status", "pre_filter_result", "filter", "post_filter",
+    "permit", "permit_timeout", "reserve", "prebind", "bind",
+    "custom", "decoded", "lazy",
+)
+
+
 class _Result:
-    __slots__ = (
-        "selected_node", "pre_score", "score", "final_score",
-        "pre_filter_status", "pre_filter_result", "filter", "post_filter",
-        "permit", "permit_timeout", "reserve", "prebind", "bind",
-        "custom", "decoded",
-    )
+    __slots__ = _FIELDS
 
     def __init__(self):
         self.selected_node = ""
@@ -54,6 +66,118 @@ class _Result:
         self.bind: dict[str, str] = {}
         self.custom: dict[str, str] = {}
         self.decoded: dict[str, str] = {}
+        # (LazyWave, pod index) handle — the wave's tensors stand in for
+        # the 13 decoded blobs until a read materializes them
+        self.lazy: tuple | None = None
+
+
+class _Snapshot:
+    """Reference snapshot of one _Result taken under the store lock —
+    the O(keys) copy get_stored_result pays while holding _mu; the JSON
+    decode/merge/encode of the (potentially ~MB) blobs runs on this
+    detached view after release (the PR 2 encode-off-the-store-lock
+    rule, enforced by kss-analyze serialize-under-lock)."""
+
+    __slots__ = _FIELDS
+
+    def __init__(self, r: _Result):
+        def snap2(d):
+            # two-level snapshot: granular adds mutate the inner
+            # per-node dicts in place, so sharing them outside the lock
+            # would race the marshal in _merge_snapshot
+            return {node: dict(plugins) for node, plugins in d.items()}
+
+        self.decoded = dict(r.decoded)
+        self.lazy = r.lazy
+        self.pre_filter_result = {p: list(v)
+                                  for p, v in r.pre_filter_result.items()}
+        self.pre_filter_status = dict(r.pre_filter_status)
+        self.filter = snap2(r.filter)
+        self.post_filter = snap2(r.post_filter)
+        self.pre_score = dict(r.pre_score)
+        self.score = snap2(r.score)
+        self.final_score = snap2(r.final_score)
+        self.reserve = dict(r.reserve)
+        self.permit = dict(r.permit)
+        self.permit_timeout = dict(r.permit_timeout)
+        self.prebind = dict(r.prebind)
+        self.bind = dict(r.bind)
+        self.custom = dict(r.custom)
+        self.selected_node = r.selected_node
+
+
+def _merge_snapshot(snap: _Snapshot) -> dict[str, str]:
+    """The 13 annotation blobs from a snapshot: lazy-materialized base
+    (one memoized chunk decode on a cold read), decoded deposits over
+    it, granular adds over both — runs with NO lock held."""
+    out: dict[str, str] = {}
+    if snap.lazy is not None:
+        wave, idx = snap.lazy
+        out.update(wave.get(idx))
+    out.update(snap.decoded)
+
+    def put(key, granular, nested=False):
+        """Merge granular adds OVER the decoded blob for the key:
+        a custom plugin's Reserve result must not erase an
+        in-tree plugin's decoded entry under the same key."""
+        if not granular:
+            if key not in out:
+                out[key] = ann.marshal({} if not isinstance(granular, str) else "")
+            return
+        base = {}
+        if key in out:
+            try:
+                base = json.loads(out[key])
+            except ValueError:
+                base = {}
+            if not isinstance(base, dict):
+                base = {}
+        if nested:
+            for node, plugins in granular.items():
+                base.setdefault(node, {}).update(plugins)
+        else:
+            base.update(granular)
+        out[key] = ann.marshal(base)
+
+    put(ann.PRE_FILTER_RESULT, snap.pre_filter_result)
+    put(ann.PRE_FILTER_STATUS_RESULT, snap.pre_filter_status)
+    put(ann.FILTER_RESULT, snap.filter, nested=True)
+    put(ann.POST_FILTER_RESULT, snap.post_filter, nested=True)
+    put(ann.PRE_SCORE_RESULT, snap.pre_score)
+    put(ann.SCORE_RESULT, snap.score, nested=True)
+    put(ann.FINAL_SCORE_RESULT, snap.final_score, nested=True)
+    put(ann.RESERVE_RESULT, snap.reserve)
+    put(ann.PERMIT_STATUS_RESULT, snap.permit)
+    put(ann.PERMIT_TIMEOUT_RESULT, snap.permit_timeout)
+    put(ann.PRE_BIND_RESULT, snap.prebind)
+    put(ann.BIND_RESULT, snap.bind)
+    if snap.selected_node or ann.SELECTED_NODE not in out:
+        out[ann.SELECTED_NODE] = snap.selected_node
+    out.update(snap.custom)
+    return out
+
+
+class DeferredResult:
+    """A consumed result-store entry whose materialization is deferred:
+    the reflector queues these (store/lazy.py LazyReflections) instead
+    of decoding on the wave's critical path; result_set() runs the same
+    merge get_stored_result would have."""
+
+    __slots__ = ("_snap",)
+
+    def __init__(self, snap: _Snapshot):
+        self._snap = snap
+
+    def ready(self) -> bool:
+        """True once materialization cannot block: the backing wave is
+        sealed (or there is no lazy part).  Drains skip unready records
+        — they belong to the in-flight wave's timeline, and applying
+        them would stall the reader until the replay finishes."""
+        lazy = self._snap.lazy
+        return lazy is None or getattr(lazy[0], "sealed", True)
+
+    def result_set(self) -> dict[str, str]:
+        return _merge_snapshot(self._snap)
 
 
 class ResultStore:
@@ -72,7 +196,35 @@ class ResultStore:
 
     def put_decoded(self, namespace: str, pod_name: str, annotations: dict[str, str]):
         with self._mu:
-            self._get(namespace, pod_name).decoded.update(annotations)
+            r = self._get(namespace, pod_name)
+            if ann.SELECTED_NODE in annotations:
+                # a full-cycle deposit (every cycle's 13 keys include
+                # selected-node, "" when unschedulable) fully shadows a
+                # leftover lazy handle — drop it so it stops pinning the
+                # old wave's replay buffers and costing a dead chunk
+                # decode on read; partial overlays (the extender-bind
+                # record) keep the base
+                r.lazy = None
+            r.decoded.update(annotations)
+
+    def has_result(self, pod: dict) -> bool:
+        """True when an entry exists for the pod — the informer's cheap
+        existence check, guaranteed never to materialize a lazy handle
+        (get_stored_result would decode the pod's chunk)."""
+        meta = pod.get("metadata") or {}
+        k = _key(meta.get("namespace") or "default", meta.get("name", ""))
+        with self._mu:
+            return k in self._results
+
+    def put_lazy(self, namespace: str, pod_name: str, wave, index: int):
+        """Deposit a lazy handle: `wave.get(index)` yields the pod's 13
+        decoded blobs on first read (store/lazy.py LazyWave).  Replaces
+        any previous cycle's deposit, like a full put_decoded would;
+        later put_decoded / granular adds overlay it."""
+        with self._mu:
+            r = self._get(namespace, pod_name)
+            r.lazy = (wave, index)
+            r.decoded = {}
 
     def add_filter_result(self, namespace, pod_name, node_name, plugin_name, reason):
         with self._mu:
@@ -147,79 +299,31 @@ class ResultStore:
     def get_stored_result(self, pod: dict) -> dict[str, str] | None:
         meta = pod.get("metadata") or {}
         k = _key(meta.get("namespace") or "default", meta.get("name", ""))
-
-        def snap2(d):
-            # two-level snapshot: granular adds mutate the inner
-            # per-node dicts in place, so sharing them outside the lock
-            # would race the marshal below
-            return {node: dict(plugins) for node, plugins in d.items()}
-
         with self._mu:
             r = self._results.get(k)
             if r is None:
                 return None
-            # the lock hold is ONLY these O(keys) reference snapshots;
-            # the JSON decode/merge/encode of the (potentially ~MB)
-            # blobs runs after release so concurrent granular adds and
-            # the engine's put_decoded never queue behind serialization
-            # (the PR 2 encode-off-the-store-lock rule, enforced by
-            # kss-analyze serialize-under-lock)
-            out = dict(r.decoded)
-            pre_filter_result = {p: list(v)
-                                 for p, v in r.pre_filter_result.items()}
-            pre_filter_status = dict(r.pre_filter_status)
-            filt = snap2(r.filter)
-            post_filter = snap2(r.post_filter)
-            pre_score = dict(r.pre_score)
-            score = snap2(r.score)
-            final_score = snap2(r.final_score)
-            reserve = dict(r.reserve)
-            permit = dict(r.permit)
-            permit_timeout = dict(r.permit_timeout)
-            prebind = dict(r.prebind)
-            bind = dict(r.bind)
-            custom = dict(r.custom)
-            selected_node = r.selected_node
+            snap = _Snapshot(r)
+        # merge (and any lazy chunk decode) runs after release so
+        # concurrent granular adds and the engine's deposits never
+        # queue behind serialization
+        return _merge_snapshot(snap)
 
-        def put(key, granular, nested=False):
-            """Merge granular adds OVER the decoded blob for the key:
-            a custom plugin's Reserve result must not erase an
-            in-tree plugin's decoded entry under the same key."""
-            if not granular:
-                if key not in out:
-                    out[key] = ann.marshal({} if not isinstance(granular, str) else "")
-                return
-            base = {}
-            if key in out:
-                try:
-                    base = json.loads(out[key])
-                except ValueError:
-                    base = {}
-                if not isinstance(base, dict):
-                    base = {}
-            if nested:
-                for node, plugins in granular.items():
-                    base.setdefault(node, {}).update(plugins)
-            else:
-                base.update(granular)
-            out[key] = ann.marshal(base)
-
-        put(ann.PRE_FILTER_RESULT, pre_filter_result)
-        put(ann.PRE_FILTER_STATUS_RESULT, pre_filter_status)
-        put(ann.FILTER_RESULT, filt, nested=True)
-        put(ann.POST_FILTER_RESULT, post_filter, nested=True)
-        put(ann.PRE_SCORE_RESULT, pre_score)
-        put(ann.SCORE_RESULT, score, nested=True)
-        put(ann.FINAL_SCORE_RESULT, final_score, nested=True)
-        put(ann.RESERVE_RESULT, reserve)
-        put(ann.PERMIT_STATUS_RESULT, permit)
-        put(ann.PERMIT_TIMEOUT_RESULT, permit_timeout)
-        put(ann.PRE_BIND_RESULT, prebind)
-        put(ann.BIND_RESULT, bind)
-        if selected_node or ann.SELECTED_NODE not in out:
-            out[ann.SELECTED_NODE] = selected_node
-        out.update(custom)
-        return out
+    def take_deferred(self, namespace: str, pod_name: str) -> DeferredResult | None:
+        """Consume a LAZY entry as a deferred write-back: the snapshot
+        is taken and the entry removed (the delete-after-reflect
+        contract) without materializing anything — the reflector queues
+        the DeferredResult and a later read pays the decode.  Entries
+        without a lazy handle return None; the caller reflects them
+        eagerly as before."""
+        k = _key(namespace or "default", pod_name)
+        with self._mu:
+            r = self._results.get(k)
+            if r is None or r.lazy is None:
+                return None
+            snap = _Snapshot(r)
+            del self._results[k]
+        return DeferredResult(snap)
 
     def delete_data(self, pod: dict) -> None:
         meta = pod.get("metadata") or {}

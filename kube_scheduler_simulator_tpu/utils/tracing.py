@@ -94,6 +94,14 @@ _HELP: dict[str, str] = {
         "Gang groups per vectorized quorum pass, by decision.",
     "decode_path_total":
         "Pods decoded per decoder-ladder path (docs/wave-pipeline.md).",
+    "decode_on_demand_total":
+        "Lazy annotation reads by outcome: miss = the read decoded (or "
+        "waited on) its chunk, hit = the chunk was already materialized "
+        "(docs/wave-pipeline.md lazy-decode stage).",
+    "lazy_decode_cold_read_seconds":
+        "Cold first-read latency of a lazily materialized pod: time from "
+        "the read to its chunk's annotations being available (one "
+        "GIL-released native chunk decode).",
 }
 
 _NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")

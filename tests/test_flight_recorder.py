@@ -321,7 +321,11 @@ def test_engine_wave_populates_upstream_histograms():
                for p, e in plugin_points)
     assert any(e == "score" for _, e in plugin_points)
     assert any(e == "prefilter" for _, e in plugin_points)
-    # decoder-ladder attribution: every decoded pod lands on some path
+    # decoder-ladder attribution: the wave defers decode to first read
+    # (store/lazy.py), so drain a read before asserting that every
+    # decoded pod lands on some ladder path
+    store.list("pods")
+    snap = TRACER.snapshot()
     decode_paths = snap["labeled_counters"]["decode_path_total"]
     assert sum(s["value"] for s in decode_paths) >= 12
 
@@ -516,6 +520,9 @@ def test_mid_chunk_exception_leaves_tracer_balanced(monkeypatch):
     error re-raises on the engine thread, and the /api/v1/trace document
     stays well-formed (docs/static-analysis.md, unbalanced-span rule)."""
     TRACER.reset()
+    # this test poisons put_decoded mid-chunk: pin the EAGER commit
+    # worker (lazy mode deposits handles and never calls it in-wave)
+    monkeypatch.setenv("KSS_TPU_EAGER_DECODE", "1")
     store = ObjectStore()
     for n in make_nodes(6, seed=31):
         store.create("nodes", n)

@@ -33,11 +33,21 @@ from .common import Finding, dotted_name
 HOT_PATH_ROOTS: list[tuple[str, str]] = [
     ("framework.engine", "SchedulerEngine._schedule_wave"),
     ("framework.engine", "SchedulerEngine._profile_wave_run"),
-    ("framework.engine", "_CommitWorker.on_chunk"),
+    ("framework.engine", "_WaveCommitter.on_chunk"),
+    ("framework.engine", "_WaveCommitter._commit"),
     ("framework.replay", "*"),
     ("framework.gang", "quorum_slice"),
     ("store.decode", "decode_chunk_into"),
     ("store.decode", "decode_all_parallel"),
+    # lazy materialization entry points (PR 9): the result-store read
+    # path and the on-demand chunk routing serve API reads concurrently
+    # with live waves — they must stay loop-free and host-sync-free too
+    ("store.resultstore", "ResultStore.get_stored_result"),
+    ("store.resultstore", "ResultStore.take_deferred"),
+    ("store.resultstore", "_merge_snapshot"),
+    ("store.lazy", "*"),
+    ("store.reflector", "LazyReflections._drain"),
+    ("store.reflector", "LazyReflections._apply"),
 ]
 
 BIG_ITERABLES = {"pending", "pods", "nodes"}
