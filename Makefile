@@ -8,7 +8,7 @@
 PY ?= python
 
 .PHONY: codec native-asan native-tsan test test-asan test-tsan analyze \
-        bench bench-check smoke clean \
+        bench bench-check bench-multichip smoke clean \
         parity-fullscale parity-fullscale-device multichip-scaling \
         host-probe tpu-watch
 
@@ -31,6 +31,19 @@ tpu-watch:
 multichip-scaling:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	    $(PY) docs/bench/multichip_scaling.py
+
+# CI-enforceable multichip gate: run the 8-virtual-device scaling
+# harness on the DEVICE-RESIDENT replay path (the default) and assert it
+# actually sharded with full byte-parity — skipped=true or a parity
+# mismatch exits nonzero (docs/wave-pipeline.md device-residency stage)
+bench-multichip:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	    $(PY) docs/bench/multichip_scaling.py /tmp/bench_multichip.json
+	$(PY) -c "import json; d = json.load(open('/tmp/bench_multichip.json')); \
+	    assert not d.get('skipped'), 'multichip harness skipped: %s' % d.get('skip_reason'); \
+	    assert d.get('all_parity_ok') is True, 'sharded parity failed'; \
+	    assert d.get('result_mode') == 'device_resident', d.get('result_mode'); \
+	    print('bench-multichip: ok=true skipped=false (device-resident path, %d devices)' % d['devices'])"
 
 host-probe:
 	$(PY) docs/bench/host_page_backing.py

@@ -555,6 +555,8 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
             "replay_width_retries_total",
             "decode_chunk_calls_total", "decode_native_thread_seconds",
             "wave_attribution_seconds",
+            "wave_d2h_bytes_total", "d2h_on_demand_bytes_total",
+            "device_chunks_spilled_total",
             "gang_groups_admitted_total", "gang_quorum_rollbacks_total",
             "gang_timeout_rejects_total", "gang_quorum_pass_seconds",
         ) if k in summary["counters"]
@@ -579,11 +581,21 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
     deferred = lazy_reg.pending_count() if lazy_reg is not None else 0
     lazy_stats = {"deferred_pods": deferred,
                   "pods_materialized_in_wave": scale_pods - deferred}
+    # device-residency headline (docs/wave-pipeline.md): how few bytes
+    # the WAVE itself moved device->host (decision rows only in the
+    # device-resident default), and what a cold read pays for the full
+    # materialization (D2H + chunk decode + deferred reflect)
+    if counters.get("wave_d2h_bytes_total") is not None:
+        lazy_stats["wave_d2h_bytes"] = int(counters["wave_d2h_bytes_total"])
     if deferred:
+        d2h0 = summary["counters"].get("d2h_on_demand_bytes_total", 0)
         sample = [p["metadata"] for p in pods[:2]]
         t0 = time.perf_counter()
         store.get("pods", sample[0]["name"], sample[0].get("namespace"))
         lazy_stats["cold_read_seconds"] = round(time.perf_counter() - t0, 6)
+        lazy_stats["cold_read_d2h_bytes"] = int(
+            TRACER.summary()["counters"].get("d2h_on_demand_bytes_total", 0)
+            - d2h0)
         if len(sample) > 1:
             # second GET right after: pod 2 is pod 1's chunk-mate at
             # bench chunk sizes, so this is the memoized warm path
@@ -592,7 +604,10 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
             lazy_stats["warm_read_seconds"] = round(
                 time.perf_counter() - t0, 6)
         log(f"  lazy decode: {deferred}/{scale_pods} pods deferred past "
-            f"the wave; first read cold {lazy_stats['cold_read_seconds']*1e3:.1f}ms, "
+            f"the wave; wave D2H "
+            f"{lazy_stats.get('wave_d2h_bytes', 0)/1e6:.1f}MB; first read "
+            f"cold {lazy_stats['cold_read_seconds']*1e3:.1f}ms "
+            f"({lazy_stats['cold_read_d2h_bytes']/1e6:.1f}MB materialized), "
             f"warm {lazy_stats.get('warm_read_seconds', 0)*1e3:.1f}ms")
     snap = TRACER.snapshot()
     return {"pods": scale_pods, "nodes": scale_nodes, "bound": bound,

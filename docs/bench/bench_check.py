@@ -55,6 +55,7 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
         wall = eng["pods"] / eng["cycles_per_sec"]
     eng10k = extra.get("engine_10k_5k") or {}
     lazy = eng.get("lazy") or {}
+    lazy10k = eng10k.get("lazy") or {}
     return {
         "decode_pods_per_sec": (extra.get("decode_pods_per_sec"), "higher"),
         "commit_stream_overlap_seconds":
@@ -67,6 +68,19 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
             (eng10k.get("cycles_per_sec"), "higher"),
         "lazy_cold_first_read_seconds":
             (lazy.get("cold_read_seconds"), "lower"),
+        # device-residency era metrics (absent from pre-PR-10 rounds):
+        # bytes the 10k x 5k wave itself moved device->host (decision
+        # rows only when device-resident — a regression here means the
+        # heavy tensors started crossing in-wave again), the replay
+        # stream span the residency shrinks, and the cold first read
+        # that now includes the on-demand D2H
+        "engine_10k_5k_wave_d2h_bytes":
+            (lazy10k.get("wave_d2h_bytes"), "lower"),
+        "engine_10k_5k_replay_stream_seconds":
+            ((eng10k.get("spans") or {}).get("replay_and_decode_stream"),
+             "lower"),
+        "engine_10k_5k_cold_read_with_d2h_seconds":
+            (lazy10k.get("cold_read_seconds"), "lower"),
     }
 
 

@@ -962,11 +962,15 @@ class SchedulerEngine:
 
         if self._custom_lifecycle_plugins():
             # a custom Reserve/Permit/PreBind can reject mid-wave and abort
-            # the rest — decode per pod so an aborted wave wastes nothing
+            # the rest — decode per pod so an aborted wave wastes nothing.
+            # host-resident: the lifecycle loop consumes every pod's
+            # annotations in order, so deferring the D2H would just move
+            # the whole transfer out of the scan-overlap window
             with TRACER.span("device_replay", pods=len(pending),
                              nodes=len(nodes)) as sp:
                 rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                            mesh=mesh, unroll=self.unroll)
+                            mesh=mesh, unroll=self.unroll,
+                            device_resident=False)
             all_annotations = _LazyDecode(rr)
             self._record_attribution(rr, sp.seconds)
             return self._finish_wave(cw, rr, all_annotations, pending, exclude)
@@ -987,11 +991,15 @@ class SchedulerEngine:
                 with TRACER.span("replay_and_decode_stream",
                                  pods=len(pending), nodes=len(nodes)) as sp:
                     # the worker's commit_stream spans parent under the
-                    # wave's replay span across the thread boundary
+                    # wave's replay span across the thread boundary.
+                    # Lazy waves keep results DEVICE-resident: on_chunk
+                    # is a handoff, the commit consumes decision rows
+                    # only, and the heavy tensors never cross in-wave
                     committer.parent_span = sp.id
                     rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
                                 mesh=mesh, unroll=self.unroll,
-                                on_chunk=committer.on_chunk)
+                                on_chunk=committer.on_chunk,
+                                device_resident=committer.lazy)
             except BaseException:
                 committer.abort()
                 raise
@@ -1002,15 +1010,17 @@ class SchedulerEngine:
 
         if self._wave_lazy_ok():
             # sequential post-pass, lazy: the replay streams only the
-            # compact tensors (no on_chunk decode at all); the commit
-            # below deposits LazyWave handles and defers the reflect —
-            # first read materializes (store/lazy.py)
+            # per-pod decision rows (device-resident results — no heavy
+            # tensor D2H, no on_chunk decode); the commit below deposits
+            # LazyWave handles and defers the reflect — first read
+            # materializes D2H + decode (store/lazy.py)
             from ..store.lazy import LazyWave
 
             with TRACER.span("replay_and_decode_stream", pods=len(pending),
                              nodes=len(nodes)) as sp:
                 rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                            mesh=mesh, unroll=self.unroll)
+                            mesh=mesh, unroll=self.unroll,
+                            device_resident=True)
             self._record_attribution(rr, sp.seconds)
             return self._finish_wave(
                 cw, rr, None, pending, exclude,
@@ -1033,7 +1043,10 @@ class SchedulerEngine:
         """True when this wave may defer annotation decode to first read
         (store/lazy.py): lazy is the default on the batched tensor paths
         — the commit consumes tensor-level decisions only, so decoding
-        on the critical path buys nothing — and turns off when
+        on the critical path buys nothing, and the heavy replay tensors
+        stay DEVICE-resident until a cold read (framework/replay.py
+        device-residency; KSS_TPU_HOST_RESIDENT=1 keeps lazy decode but
+        fetches to host in-wave) — and turns off when
 
           * KSS_TPU_EAGER_DECODE=1 (the golden/parity baseline mode);
           * plugin-extender observers are registered (after_cycle sees

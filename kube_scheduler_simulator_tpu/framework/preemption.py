@@ -204,7 +204,11 @@ class Preemptor:
             namespaces=self._namespaces,
         )
         self._fit_cw = NodeTableReuse(cw)  # shared across fit hypotheses
-        rr = replay(cw, chunk=1, filter_only=True)
+        # host-resident: the oracle reads the single pod's codes right
+        # below, so device residency would just add an unoverlapped
+        # round-trip (plus an attribution reduction nobody consumes)
+        # per fit hypothesis
+        rr = replay(cw, chunk=1, filter_only=True, device_resident=False)
         try:
             j = cw.node_table.names.index(node_name)
         except ValueError:

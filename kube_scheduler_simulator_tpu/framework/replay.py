@@ -21,12 +21,28 @@ scores travel as int16 with an overflow->int32 retry, and finalscore is
 recomputed on host from raw + feasibility (framework/hostnorm.py mirrors,
 bit-identical).  ReplayResult hides all of this behind per-pod accessors.
 
+Device residency (docs/wave-pipeline.md device-residency stage): by
+default, when no streaming consumer decodes in-wave, even the compact
+tensors don't cross — the wave fetches only per-pod DECISION ROWS
+(selected / feasible_count / prefilter_reject / raw_overflow, plus the
+jit'd per-chunk attribution sums) and the heavy packed/raw arrays stay
+live in device memory, materializing per chunk on first cold read
+(_CompactChunks.host, memoized + exactly-once) with an LRU spill budget
+(KSS_TPU_DEVICE_RESULT_BUDGET_MB) bounding HBM across waves.
+KSS_TPU_HOST_RESIDENT=1 / KSS_TPU_EAGER_DECODE=1 are the bit-identical
+host-fetch parity rungs.
+
 The last chunk is padded; padded steps carry `is_pad` and never bind
 (pipeline masks their selection to -1).
 """
 
 from __future__ import annotations
 
+import os
+import threading
+import time
+import weakref
+from collections import OrderedDict
 from typing import Any
 
 import jax
@@ -39,10 +55,22 @@ from ..utils.tracing import TRACER
 
 
 class _CompactChunks:
-    """Per-chunk CompactOut arrays, host-side."""
+    """Per-chunk CompactOut arrays.
+
+    Entry residency (docs/wave-pipeline.md device-residency stage): each
+    chunk's four heavy groups are either host numpy arrays (host-resident
+    mode, or after materialization) or LIVE DEVICE arrays — the
+    device-resident default, where the wave fetches only decision rows
+    and the packed/raw tensors stay (sharded, on a mesh) in device memory
+    until a cold read — or the retention budget's LRU spill — pulls them
+    across.  Consumers never index the group lists directly; host()
+    performs the memoized, exactly-once D2H (contiguous C order — the
+    native codec walks raw pointers)."""
+
+    GROUPS = ("packed", "raw8", "raw16", "raw32")
 
     __slots__ = ("packed", "raw8", "raw16", "raw32", "chunk", "pack_mode",
-                 "score_cols")
+                 "score_cols", "att", "_mu", "_inflight", "__weakref__")
 
     def __init__(self, packed, raw8, raw16, raw32, chunk, pack_mode, score_cols):
         self.packed = packed      # list of [C, N]
@@ -52,6 +80,223 @@ class _CompactChunks:
         self.chunk = chunk
         self.pack_mode = pack_mode
         self.score_cols = score_cols  # per scorer: ("raw8"|"raw16"|"raw32", row)
+        # per chunk: host dict of the on-device attribution sums
+        # (device-resident waves), or None (host tally fallback)
+        self.att: list = []
+        self._mu = threading.Lock()
+        self._inflight: dict[int, threading.Event] = {}
+
+    # ------------------------------------------------------- residency
+
+    def is_device(self, ci: int) -> bool:
+        return not isinstance(self.packed[ci], np.ndarray)
+
+    def device_nbytes(self, ci: int) -> int:
+        """Device bytes pinned by chunk ci (0 once materialized)."""
+        if not self.is_device(ci):
+            return 0
+        return sum(int(getattr(getattr(self, g)[ci], "nbytes", 0))
+                   for g in self.GROUPS)
+
+    def host(self, group: str, ci: int) -> np.ndarray:
+        """Chunk ci's `group` array as host numpy, materializing the
+        whole chunk on first access."""
+        arrs = getattr(self, group)
+        a = arrs[ci]
+        if isinstance(a, np.ndarray):
+            return a
+        self.materialize(ci)
+        return arrs[ci]
+
+    def materialize(self, ci: int, spill: bool = False) -> None:
+        """D2H of chunk ci's four groups, exactly-once under concurrent
+        readers (the fetch runs OUTSIDE the lock; latecomers wait on the
+        owner's event).  spill=True is the retention budget's background
+        path and feeds the spill counter; everything else is an
+        on-demand cold read and feeds the d2h_on_demand taps + the
+        d2h_fetch span under the serving read."""
+        while True:
+            with self._mu:
+                if isinstance(self.packed[ci], np.ndarray):
+                    return
+                ev = self._inflight.get(ci)
+                owner = ev is None
+                if owner:
+                    ev = self._inflight[ci] = threading.Event()
+            if owner:
+                break
+            ev.wait()
+        from ..parallel.mesh import gather_to_host
+
+        from contextlib import nullcontext
+
+        try:
+            t0 = time.perf_counter()
+            # the span IS with-managed — it rides a conditional context
+            # manager (spans only on-demand reads, not background spills),
+            # a form the static balance rule can't see through
+            with (nullcontext() if spill
+                  else TRACER.span("d2h_fetch", chunk=ci)):  # kss-analyze: allow(unbalanced-span)
+                fetched = {g: gather_to_host(getattr(self, g)[ci])
+                           for g in self.GROUPS}
+            dt = time.perf_counter() - t0
+        except BaseException:
+            # transient fetch failure: clear the in-flight slot so the
+            # next reader retries instead of waiting forever
+            with self._mu:
+                del self._inflight[ci]
+            ev.set()
+            raise
+        nbytes = sum(a.nbytes for a in fetched.values())
+        with self._mu:
+            for g in self.GROUPS:
+                getattr(self, g)[ci] = fetched[g]
+            del self._inflight[ci]
+        ev.set()
+        _DEVICE_BUDGET.release(self, ci)
+        if spill:
+            TRACER.count("device_chunks_spilled_total")
+        else:
+            TRACER.count("d2h_on_demand_bytes_total", nbytes)
+            TRACER.observe("d2h_on_demand_seconds", dt)
+
+
+class _DeviceResultBudget:
+    """HBM retention budget for device-resident replay chunks, across
+    waves: KSS_TPU_DEVICE_RESULT_BUDGET_MB caps the total bytes pinned
+    by retained chunks; exceeding it spills the least-recently-retained
+    chunks to host on ONE background thread (reads remove entries, so
+    insertion order IS recency order).  Unset/invalid -> unlimited
+    (chunks stay on device until a cold read materializes them or their
+    wave is dropped); 0 -> retain nothing, spill as chunks land.
+    Entries hold the _CompactChunks weakly — dropping a wave's last
+    handle releases its accounting without any explicit call."""
+
+    def __init__(self):
+        from collections import deque
+
+        self._mu = threading.Lock()
+        # (id(cc), ci) -> [weakref(cc), ci, nbytes, spilling, attempts]
+        self._entries: OrderedDict[tuple[int, int], list] = OrderedDict()
+        self._total = 0
+        self._pool = None
+        # keys whose _CompactChunks died: the weakref finalizer must NOT
+        # take _mu (GC can run it on a thread already inside a locked
+        # section — a non-reentrant self-deadlock), so it only appends
+        # here (deque.append is atomic) and locked entry points prune
+        self._dead: deque = deque()
+
+    @staticmethod
+    def limit_bytes() -> int | None:
+        raw = os.environ.get("KSS_TPU_DEVICE_RESULT_BUDGET_MB")
+        if not raw:
+            return None
+        try:
+            mb = int(float(raw))
+        except ValueError:
+            # fail SAFE on a typo ("512MB"): retain nothing rather than
+            # silently lifting the cap the operator meant to set
+            return 0
+        return None if mb < 0 else mb * (1 << 20)
+
+    def _prune_locked(self) -> None:
+        """Drop entries whose _CompactChunks died (queued by the
+        finalizer); callers hold _mu."""
+        while self._dead:
+            ent = self._entries.pop(self._dead.popleft(), None)
+            if ent is not None:
+                self._total -= ent[2]
+        TRACER.gauge("device_chunks_retained", len(self._entries))
+
+    def retain(self, cc: _CompactChunks, ci: int, nbytes: int) -> None:
+        key = (id(cc), ci)
+
+        def _gone(_ref, key=key):
+            self._dead.append(key)  # lock-free: pruned on next locked op
+
+        with self._mu:
+            # prune BEFORE inserting: a dead chunk's queued key could
+            # collide with this one (id() reuse) and drop the fresh entry
+            self._prune_locked()
+            self._entries[key] = [weakref.ref(cc, _gone), ci, nbytes, False,
+                                  0]
+            self._total += nbytes
+            TRACER.gauge("device_chunks_retained", len(self._entries))
+        self._enforce()
+
+    def release(self, cc: _CompactChunks, ci: int) -> None:
+        with self._mu:
+            ent = self._entries.pop((id(cc), ci), None)
+            if ent is not None:
+                self._total -= ent[2]
+            self._prune_locked()
+
+    def retained_chunks(self) -> int:
+        with self._mu:
+            self._prune_locked()
+            return len(self._entries)
+
+    def _enforce(self) -> None:
+        limit = self.limit_bytes()
+        if limit is None:
+            return
+        to_spill: list[tuple[_CompactChunks, int]] = []
+        with self._mu:
+            self._prune_locked()
+            over = self._total - limit
+            for ent in self._entries.values():
+                if over <= 0:
+                    break
+                if ent[3]:
+                    over -= ent[2]  # already queued for spill
+                    continue
+                cc = ent[0]()
+                if cc is None:
+                    continue  # the weakref callback prunes it
+                ent[3] = True
+                to_spill.append((cc, ent[1]))
+                over -= ent[2]
+        for cc, ci in to_spill:
+            self._spill_pool().submit(self._spill_one, cc, ci)
+
+    _SPILL_RETRIES = 3
+
+    def _spill_one(self, cc: _CompactChunks, ci: int) -> None:
+        try:
+            cc.materialize(ci, spill=True)
+        except Exception:
+            # transient fetch failure: clear the in-flight mark and
+            # re-enforce (bounded — after _SPILL_RETRIES the chunk stays
+            # pinned until a cold read materializes it, the documented
+            # fallback, instead of hot-looping the spill thread)
+            retry = False
+            with self._mu:
+                ent = self._entries.get((id(cc), ci))
+                if ent is not None:
+                    ent[4] += 1
+                    retry = ent[4] < self._SPILL_RETRIES
+                    ent[3] = not retry  # give up: never re-queue
+            if retry:
+                time.sleep(0.05)
+                self._enforce()
+
+    def _spill_pool(self):
+        with self._mu:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="d2h-spill")
+            return self._pool
+
+    def drain(self) -> None:
+        """Block until every queued spill has landed (tests/bench)."""
+        pool = self._pool
+        if pool is not None:
+            pool.submit(lambda: None).result()
+
+
+_DEVICE_BUDGET = _DeviceResultBudget()
 
 
 class ReplayResult:
@@ -145,7 +390,7 @@ class ReplayResult:
 
         cc = self._compact
         if d is None:
-            packed = np.asarray(cc.packed[ci])
+            packed = cc.host("packed", ci)
             c, n = packed.shape
             f = len(self.cw.config.filters())
             _, code_bits, ff_bits = PACK_MODES[cc.pack_mode]
@@ -179,7 +424,7 @@ class ReplayResult:
                         skip = np.asarray(sskip[row][lo:hi], bool)
                         raw[:m, s, :] = np.where(skip[:, None], 0, src[lo:hi])
                     continue
-                raw[:, s, :] = getattr(cc, group)[ci][:, row, :]
+                raw[:, s, :] = cc.host(group, ci)[:, row, :]
             d["raw"] = raw
             d["final"] = hostnorm.finalize_chunk(
                 self.cw, raw, d["feasible"], d["ignored"], ci * cc.chunk)
@@ -283,22 +528,94 @@ class ChunkAttribution:
             "score": {n: {"evaluated": 0, "sum": 0} for n in self.scorers},
             "prefilter": {},
         }
+        cc = getattr(rr, "_compact", None)
+        cols = cc.score_cols if cc is not None else ()
+        # scorer indices by residency of their raw column: device columns
+        # fold from the on-device reduction's limb sums, host columns
+        # (precompiled static rows, never transferred) tally here
+        self._dev_cols = [s for s, (g, _r) in enumerate(cols) if g != "host"]
+        self._host_cols = [s for s, (g, _r) in enumerate(cols) if g == "host"]
         self._done: set[int] = set()
         self.broken = False
 
     def add_chunk(self, ci: int) -> None:
         """Tally compact chunk ci (idempotent; width-tier re-deliveries
-        are bit-identical so first-tally wins)."""
+        are bit-identical so first-tally wins).  Device-resident chunks
+        fold the jit'd per-chunk sums fetched with the decision rows —
+        no compact host tensors are touched; chunks without device sums
+        (host-resident/eager waves) take the host tally."""
         cc = self.rr._compact
         if self.broken or cc is None or ci in self._done:
             return
         if ci >= len(cc.packed):
             return  # not ingested (defensive; callers pass delivered chunks)
+        if not self.filters and not self.scorers:
+            self._done.add(ci)
+            return  # nothing to tally; never touch the tensors
         self._done.add(ci)
         try:
-            self._tally_chunk(ci, cc)
+            att = cc.att[ci] if ci < len(cc.att) else None
+            if att is not None:
+                self._fold_device(ci, cc, att)
+            else:
+                self._tally_chunk(ci, cc)
         except Exception:  # noqa: BLE001 — observability must not fail waves
             self.broken = True
+
+    def _fold_device(self, ci: int, cc: _CompactChunks, dev: dict) -> None:
+        """Fold one chunk's on-device attribution sums (the decision-row
+        fetch's tiny arrays): filter counts are chunk scalars; score
+        sums arrive as per-pod int32 row sums (narrow columns) or
+        base-2^11 limb triples (wide columns — int32-safe on device
+        without x64), recombined exactly into int64 here."""
+        lo = ci * cc.chunk
+        hi = min(lo + cc.chunk, self.p)
+        m = hi - lo
+        out = self.out
+        for f, name in enumerate(self.filters):
+            out["filter"][name]["rejects"] += int(dev["f_rejects"][f])
+            out["filter"][name]["evaluated"] += int(dev["f_evaluated"][f])
+        if self._dev_cols:
+            n = self.rr.cw.n_nodes
+            sums = (dev["s_sums"][:m].astype(np.int64).sum(axis=0)
+                    if "s_sums" in dev else None)
+            limbs = (dev["s_limbs"][:m].astype(np.int64).sum(axis=0)
+                     if "s_limbs" in dev else None)
+            qn = qw = 0
+            for q, s in enumerate(self._dev_cols):
+                name = self.scorers[s]
+                out["score"][name]["evaluated"] += int(dev["s_evaluated"][q])
+                if _col_needs_limbs(cc.score_cols[s][0], n):
+                    out["score"][name]["sum"] += (
+                        (int(limbs[qw, 2]) << 22)
+                        + (int(limbs[qw, 1]) << 11) + int(limbs[qw, 0]))
+                    qw += 1
+                else:
+                    out["score"][name]["sum"] += int(sums[qn])
+                    qn += 1
+        if self._host_cols:
+            # host-resident static score rows never travel: their sums
+            # need only the feasibility BITMAP (N/8 bytes per pod),
+            # packed on device and fetched with the decision rows
+            n = self.rr.cw.n_nodes
+            feas = np.unpackbits(dev["feas_packed"][:m], axis=1,
+                                 bitorder="little")[:, :n].astype(bool)
+            feas_cnt = feas.sum(axis=1)
+            fc = self.rr.feasible_count
+            scored = (np.asarray(fc[lo:hi]) > 1 if fc is not None
+                      else np.zeros(m, bool))
+            for s in self._host_cols:
+                name = self.scorers[s]
+                sk = self.sskip.get(name)
+                s_on = (scored if sk is None
+                        else scored & ~np.asarray(sk[lo:hi], bool))
+                rows = np.flatnonzero(s_on)
+                if not rows.size:
+                    continue
+                arr = np.asarray(self.static_rows[cc.score_cols[s][1]][lo:hi])
+                out["score"][name]["evaluated"] += int(feas_cnt[rows].sum())
+                out["score"][name]["sum"] += int(np.sum(
+                    arr[rows], dtype=np.int64, where=feas[rows]))
 
     def _tally_chunk(self, ci: int, cc: _CompactChunks) -> None:
         from .pipeline import PACK_MODES
@@ -307,7 +624,7 @@ class ChunkAttribution:
         lo = ci * cc.chunk
         hi = min(lo + cc.chunk, self.p)
         m = hi - lo
-        ffp = (np.asarray(cc.packed[ci][:m]).astype(np.int64) >> code_bits)
+        ffp = (cc.host("packed", ci)[:m].astype(np.int64) >> code_bits)
 
         def arr_of(s: int) -> np.ndarray:
             group, row = cc.score_cols[s]
@@ -315,7 +632,7 @@ class ChunkAttribution:
                 return np.asarray(self.static_rows[row][lo:hi])
             # native-dtype slice view: the sum below accumulates into
             # int64 via dtype=, no whole-column up-conversion copy
-            return getattr(cc, group)[ci][:m, row, :]
+            return cc.host(group, ci)[:m, row, :]
 
         self._tally(lo, hi, ffp, arr_of)
 
@@ -548,7 +865,11 @@ def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1, mesh=None,
               wide: bool = False):
     key = (*_workload_scan_key(cw, chunk, mesh), unroll, "compact", pack_mode,
            score_dtypes, wide)
-    scan_jit = _SCAN_CACHE.get(key)
+    # LRU, not FIFO: pop-and-reinsert on hit moves the entry to the
+    # recent end, so two workload shapes alternating at _SCAN_CACHE_MAX
+    # entries never evict each other's still-hot compiles (insertion-
+    # order eviction used to thrash exactly that pattern)
+    scan_jit = _SCAN_CACHE.pop(key, None)
     if scan_jit is None:
         step = build_step(_SlimWorkload(cw), out_mode="compact",
                           pack_mode=pack_mode, score_dtypes=score_dtypes,
@@ -558,27 +879,230 @@ def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1, mesh=None,
             return jax.lax.scan(step, carry, xs_chunk, unroll=unroll)
 
         scan_jit = jax.jit(scan_chunk, donate_argnums=(0,))
-        if len(_SCAN_CACHE) >= _SCAN_CACHE_MAX:
+        while len(_SCAN_CACHE) >= _SCAN_CACHE_MAX:
             _SCAN_CACHE.pop(next(iter(_SCAN_CACHE)))
-        _SCAN_CACHE[key] = scan_jit
+    _SCAN_CACHE[key] = scan_jit
     return scan_jit
 
 
 def _fetch_chunk(out) -> dict[str, np.ndarray]:
-    """Blocking D2H of one chunk's outputs (runs on a fetch thread so the
-    transfer overlaps later chunks' device compute — the copy starts the
-    moment the chunk's results exist, and np.asarray releases the GIL
-    while it waits on the tunnel).  ascontiguousarray: on TPU the fetched
-    array keeps the DEVICE layout (e.g. strides (1,10,5) for a [C,S,N]
-    int8), and the native codec walks raw pointers assuming C order — a
-    strided buffer silently decodes neighboring pods' values."""
-    return {f: np.ascontiguousarray(np.asarray(getattr(out, f)))
-            for f in out._fields}
+    """Blocking D2H of one chunk's FULL outputs — the host-resident mode
+    (runs on a fetch thread so the transfer overlaps later chunks'
+    device compute — the copy starts the moment the chunk's results
+    exist, and np.asarray releases the GIL while it waits on the
+    tunnel).  ascontiguousarray: on TPU the fetched array keeps the
+    DEVICE layout (e.g. strides (1,10,5) for a [C,S,N] int8), and the
+    native codec walks raw pointers assuming C order — a strided buffer
+    silently decodes neighboring pods' values."""
+    c = {f: np.ascontiguousarray(np.asarray(getattr(out, f)))
+         for f in out._fields}
+    c["_d2h_bytes"] = sum(a.nbytes for a in c.values())
+    return c
+
+
+_DECISION_FIELDS = ("selected", "feasible_count", "prefilter_reject",
+                    "raw_overflow")
+
+
+def _fetch_decisions(out, att) -> dict[str, np.ndarray]:
+    """Decision-row-only D2H for a device-resident chunk: the per-pod
+    scalars commit/bind/gang quorum actually consume — O(chunk) bytes
+    plus the tiny on-device attribution sums — instead of the
+    O(chunk x plugins x nodes) compact tensors, which stay live on
+    device until a cold read materializes them (docs/wave-pipeline.md
+    device-residency stage)."""
+    c = {f: np.ascontiguousarray(np.asarray(getattr(out, f)))
+         for f in _DECISION_FIELDS}
+    nbytes = sum(a.nbytes for a in c.values())
+    if att is not None:
+        att_host = {k: np.asarray(v) for k, v in att.items()}
+        nbytes += sum(a.nbytes for a in att_host.values())
+        c["att"] = att_host
+    c["_d2h_bytes"] = nbytes
+    return c
+
+
+# jit'd per-chunk attribution reductions, shared across workloads with
+# the same static layout (the function retraces per input shape anyway,
+# so only closure statics key the cache)
+_ATT_CACHE: dict = {}
+_ATT_CACHE_MAX = 32
+
+
+def _att_fn_for(chunk: int, n: int, code_bits: int, n_filters: int,
+                dev_groups: tuple, want_feas_pack: bool):
+    key = (chunk, n, code_bits, n_filters, dev_groups, want_feas_pack)
+    fn = _ATT_CACHE.pop(key, None)
+    if fn is None:
+        fn = jax.jit(_build_att_fn(chunk, n, code_bits, n_filters,
+                                   dev_groups, want_feas_pack))
+        while len(_ATT_CACHE) >= _ATT_CACHE_MAX:
+            _ATT_CACHE.pop(next(iter(_ATT_CACHE)))
+    _ATT_CACHE[key] = fn
+    return fn
+
+
+def _col_needs_limbs(group: str, n: int) -> bool:
+    """Whether a per-pod masked row sum of this raw group can overflow
+    int32 at n nodes — the STATIC rule deciding single-int32 vs
+    base-2^11 limb-triple travel for a score column's device sums
+    (shared by the reduction builder and ChunkAttribution's fold)."""
+    bound = {"raw8": 128, "raw16": 1 << 15}.get(group)
+    return bound is None or n * bound >= (1 << 31)
+
+
+def _build_att_fn(chunk: int, n: int, code_bits: int, n_filters: int,
+                  dev_groups: tuple, want_feas_pack: bool):
+    """The per-chunk on-device attribution reduction: per-filter
+    reject/evaluated counts and per-scorer masked sums straight from the
+    chunk's device tensors, returned with the decision rows so the host
+    never needs the heavy arrays for attribution.
+
+    Sums stay exact without x64: per-chunk counts are < 2^31 by
+    construction (chunk x nodes); narrow (int8/int16) raw columns ship
+    plain per-pod int32 row sums (provably no overflow at this n —
+    _col_needs_limbs), and wide columns travel as PER-POD base-2^11
+    limb triples (|limb sum| <= nodes x 2^11 per pod), which
+    ChunkAttribution._fold_device recombines into int64.  Cost
+    discipline: ONE F x chunk x nodes pass for the filter counts (the
+    per-pod first-fail histogram; `ran` derives from its suffix sums,
+    not a second pass) and ~two chunk x nodes passes per score column.
+    All reductions are over the node axis, so on a mesh GSPMD lowers
+    them to the same ICI all-reduces the scan's selection already pays."""
+    n8 = ((n + 7) // 8) * 8
+
+    def fn(packed, raw8, raw16, raw32, fc, fskip_c, sskip_c, m):
+        raws = {"raw8": raw8, "raw16": raw16, "raw32": raw32}
+        valid = jnp.arange(chunk, dtype=jnp.int32) < m          # [C]
+        ffp = packed.astype(jnp.int32) >> code_bits             # [C, N]
+        feas = (ffp == 0) & valid[:, None]                      # [C, N]
+        feas_cnt = jnp.sum(feas, axis=1, dtype=jnp.int32)       # [C]
+        out = {}
+        if n_filters:
+            # per-pod first-fail histogram, one F x C x N pass: rejects
+            # per (filter, pod); "plugin f ran on a node" = all-pass or
+            # first fail at a later index = feas_cnt + suffix sums of
+            # the histogram (host-tally semantics); per-pod
+            # PreFilter-skips zero the pod's contribution
+            fidx = jnp.arange(n_filters, dtype=jnp.int32)[:, None, None]
+            rej_pp = jnp.sum(ffp[None] == fidx + 1, axis=2,
+                             dtype=jnp.int32)                   # [F, C]
+            rej_pp = rej_pp * valid[None, :]
+            out["f_rejects"] = jnp.sum(rej_pp, axis=1)
+            suffix = jnp.cumsum(rej_pp[::-1], axis=0)[::-1]     # [F, C]
+            ran_pp = feas_cnt[None, :] + suffix
+            out["f_evaluated"] = jnp.sum(
+                jnp.where(fskip_c, 0, ran_pp), axis=1)
+        if dev_groups:
+            scored = (fc > 1) & valid                           # [C]
+            evaluated, sums, limbs = [], [], []
+            for s, group, row in dev_groups:
+                s_on = scored & ~sskip_c[s]
+                mask = feas & s_on[:, None]
+                xm = jnp.where(mask, raws[group][:, row, :], 0) \
+                    .astype(jnp.int32)                          # [C, N]
+                if _col_needs_limbs(group, n):
+                    limbs.append(jnp.stack([
+                        jnp.sum(xm & 0x7FF, axis=1, dtype=jnp.int32),
+                        jnp.sum((xm >> 11) & 0x7FF, axis=1,
+                                dtype=jnp.int32),
+                        jnp.sum(xm >> 22, axis=1, dtype=jnp.int32),
+                    ], axis=-1))                                # [C, 3]
+                else:
+                    sums.append(jnp.sum(xm, axis=1, dtype=jnp.int32))
+                evaluated.append(jnp.sum(jnp.where(s_on, feas_cnt, 0),
+                                         dtype=jnp.int32))
+            out["s_evaluated"] = jnp.stack(evaluated)
+            if sums:
+                out["s_sums"] = jnp.stack(sums, axis=1)         # [C, Sn]
+            if limbs:
+                out["s_limbs"] = jnp.stack(limbs, axis=1)       # [C, Sw, 3]
+        if want_feas_pack:
+            # host-resident score columns need the [C, N] feasibility on
+            # host: bit-pack it (N/8 bytes per pod) instead of shipping
+            # bools — ChunkAttribution unpacks with bitorder="little"
+            pad = jnp.zeros((chunk, n8 - n), dtype=feas.dtype)
+            fr = jnp.concatenate([feas, pad], axis=1) \
+                .reshape(chunk, n8 // 8, 8).astype(jnp.int32)
+            bits = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.int32)
+            out["feas_packed"] = jnp.sum(
+                fr * bits[None, None, :], axis=-1).astype(jnp.uint8)
+        return out
+
+    return fn
+
+
+class _DeviceAttribution:
+    """Per-replay-run context for the on-device attribution reduction:
+    pads the per-pod PreFilter/score skip masks to the chunk grid, puts
+    them on device ONCE, and runs the cached jit'd per-chunk sums whose
+    outputs ride the decision-row fetch (cc.att)."""
+
+    __slots__ = ("enabled", "chunk", "p", "fskip_dev", "sskip_dev", "_fn")
+
+    def __init__(self, cw: CompiledWorkload, chunk: int, pack_mode: str,
+                 score_cols: tuple):
+        from .pipeline import PACK_MODES
+
+        f_names = cw.config.filters()
+        s_names = cw.config.scorers()
+        self.enabled = bool(f_names or s_names)
+        if not self.enabled:
+            return
+        dev_groups = tuple((s, g, r) for s, (g, r) in enumerate(score_cols)
+                           if g != "host")
+        want_pack = any(g == "host" for g, _r in score_cols)
+        p = cw.n_pods
+        self.p = p
+        self.chunk = chunk
+        ppad = max(1, -(-p // chunk)) * chunk
+        # pad rows read as "skipped": they contribute nothing even
+        # before the valid mask cuts them
+        fmat = np.ones((len(f_names), ppad), np.bool_)
+        fskip = cw.host.get("filter_skip", {})
+        for f, nm in enumerate(f_names):
+            fmat[f, :p] = np.asarray(fskip.get(nm, np.zeros(p)), bool)
+        smat = np.ones((max(len(s_names), 1), ppad), np.bool_)
+        sskip = cw.host.get("score_skip", {})
+        for s, nm in enumerate(s_names):
+            smat[s, :p] = np.asarray(sskip.get(nm, np.zeros(p)), bool)
+        self.fskip_dev = jnp.asarray(fmat)
+        self.sskip_dev = jnp.asarray(smat)
+        self._fn = _att_fn_for(chunk, cw.n_nodes,
+                               PACK_MODES[pack_mode][1], len(f_names),
+                               dev_groups, want_pack)
+
+    def run(self, out, lo: int):
+        fskip_c = self.fskip_dev[:, lo:lo + self.chunk]
+        sskip_c = self.sskip_dev[:, lo:lo + self.chunk]
+        m = np.int32(min(lo + self.chunk, self.p) - lo)
+        return self._fn(out.packed_filter, out.raw8, out.raw16, out.raw32,
+                        out.feasible_count, fskip_c, sskip_c, m)
+
+
+def _resolve_device_resident(device_resident: bool | None, collect: bool,
+                             on_chunk) -> bool:
+    """Result-residency mode for one replay: device-resident is the
+    default whenever no streaming consumer decodes in-wave (on_chunk is
+    None, or the caller — the lazy streaming committer — asked for it
+    explicitly).  KSS_TPU_EAGER_DECODE=1 and KSS_TPU_HOST_RESIDENT=1
+    force the host-resident fetch engine-wide: the bit-identical parity
+    rungs (docs/wave-pipeline.md device-residency stage)."""
+    if not collect:
+        return False
+    if os.environ.get("KSS_TPU_EAGER_DECODE") == "1":
+        return False
+    if os.environ.get("KSS_TPU_HOST_RESIDENT") == "1":
+        return False
+    if device_resident is None:
+        return on_chunk is None
+    return bool(device_resident)
 
 
 def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
            unroll: int = 1, filter_only: bool = False,
-           mesh=None, on_chunk=None) -> ReplayResult:
+           mesh=None, on_chunk=None,
+           device_resident: bool | None = None) -> ReplayResult:
     """Run the full queue; returns host-side result arrays.
 
     collect=False skips device->host transfer of the per-node tensors
@@ -605,7 +1129,14 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
     that were already delivered (i.e. passed the overflow check) carry
     bit-identical values on the wider re-run, which is what lets a
     commit consumer keep a watermark and skip re-delivered pods.
+    device_resident: keep the heavy compact tensors as live device
+    arrays and fetch only per-pod decision rows in-wave (the default
+    when no on_chunk consumer decodes in-wave); a cold read performs the
+    memoized D2H per chunk.  None = auto; KSS_TPU_EAGER_DECODE=1 /
+    KSS_TPU_HOST_RESIDENT=1 force the host-resident fetch regardless.
     """
+    device_resident = _resolve_device_resident(device_resident, collect,
+                                               on_chunk)
     if mesh is not None:
         from ..parallel.mesh import shard_workload
 
@@ -627,7 +1158,8 @@ def replay(cw: CompiledWorkload, chunk: int = 512, collect: bool = True,
              else (None, "i32", "i64"))
     for wide in tiers:
         result = _replay_run(cw, chunk, collect, unroll, mesh, wide=wide,
-                             on_chunk=on_chunk)
+                             on_chunk=on_chunk,
+                             device_resident=device_resident)
         if result is not None:
             return result
         TRACER.count("replay_width_retries_total")
@@ -659,8 +1191,13 @@ def _compact_plan(cw: CompiledWorkload, wide: str | None):
 
 
 # chunks allowed in flight before the dispatch loop waits on the oldest
-# fetch: bounds device memory at O(inflight x chunk x N) even when D2H is
-# slower than device compute (the module-docstring invariant)
+# fetch.  Host-resident mode: bounds device memory at
+# O(inflight x chunk x N) even when D2H is slower than device compute
+# (the module-docstring invariant).  Device-resident mode: drained
+# chunks stay on device BY DESIGN, so this only throttles undrained
+# decision-row fetches — every retained chunk registers its bytes with
+# _DEVICE_BUDGET as it lands, and the KSS_TPU_DEVICE_RESULT_BUDGET_MB
+# LRU spill is what bounds HBM across waves
 _MAX_INFLIGHT = 4
 
 
@@ -677,7 +1214,8 @@ class _TinyOut:
 
 
 def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
-                mesh, wide: str | None, on_chunk=None) -> ReplayResult | None:
+                mesh, wide: str | None, on_chunk=None,
+                device_resident: bool = False) -> ReplayResult | None:
     p = cw.n_pods
     chunk = min(chunk, max(p, 1))
     pack_mode, score_dtypes, score_cols = _compact_plan(cw, wide)
@@ -730,16 +1268,33 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
         prefilter_reject=prefilter_reject, compact=compact,
     )
     check_overflow = wide != "i64"
+    att_ctx = (_DeviceAttribution(cw, chunk, pack_mode, score_cols)
+               if device_resident else None)
+    if att_ctx is not None and not att_ctx.enabled:
+        att_ctx = None
 
-    def ingest(c: dict, lo: int) -> bool:
+    def ingest(c: dict, lo: int, dev_out) -> bool:
         if check_overflow and c["raw_overflow"].any():
             return False  # caller reruns at the next width tier
         hi = min(lo + chunk, p)
         m = hi - lo
-        compact.packed.append(c["packed_filter"])
-        compact.raw8.append(c["raw8"])
-        compact.raw16.append(c["raw16"])
-        compact.raw32.append(c["raw32"])
+        if dev_out is not None:
+            # device-resident: retain the chunk's heavy tensors as live
+            # device arrays (budget-accounted); only the decision rows
+            # in `c` crossed to host
+            compact.packed.append(dev_out.packed_filter)
+            compact.raw8.append(dev_out.raw8)
+            compact.raw16.append(dev_out.raw16)
+            compact.raw32.append(dev_out.raw32)
+            ci = len(compact.packed) - 1
+            _DEVICE_BUDGET.retain(compact, ci, compact.device_nbytes(ci))
+        else:
+            compact.packed.append(c["packed_filter"])
+            compact.raw8.append(c["raw8"])
+            compact.raw16.append(c["raw16"])
+            compact.raw32.append(c["raw32"])
+        compact.att.append(c.get("att"))
+        TRACER.count("wave_d2h_bytes_total", c.get("_d2h_bytes", 0))
         selected[lo:hi] = c["selected"][:m]
         feasible_count[lo:hi] = c["feasible_count"][:m]
         prefilter_reject[lo:hi] = c["prefilter_reject"][:m]
@@ -766,6 +1321,7 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
             on_chunk(rr, lo, hi)
 
     futures: list = []
+    heavy: list = []   # device-resident: the chunk's CompactOut (device refs)
     drained = 0
     with ThreadPoolExecutor(max_workers=3) as pool:
         for lo in range(0, p, chunk):
@@ -774,16 +1330,29 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
             xs_chunk["is_pad"] = (jnp.arange(chunk) >= (hi - lo))
             carry, out = scan_jit(carry, xs_chunk)
             # dispatch returns immediately; a fetch thread blocks on this
-            # chunk's transfer while the device runs later chunks
-            futures.append(pool.submit(_fetch_chunk, out))
+            # chunk's transfer while the device runs later chunks.  In
+            # device-resident mode that transfer is the decision rows +
+            # the jit'd attribution sums only
+            if device_resident:
+                att_out = att_ctx.run(out, lo) if att_ctx is not None \
+                    else None
+                futures.append(pool.submit(_fetch_decisions, out, att_out))
+                heavy.append(out)
+            else:
+                futures.append(pool.submit(_fetch_chunk, out))
+                heavy.append(None)
             del out
             while len(futures) - drained > _MAX_INFLIGHT:
-                if not ingest(futures[drained].result(), drained * chunk):
+                if not ingest(futures[drained].result(), drained * chunk,
+                              heavy[drained]):
                     return None
+                heavy[drained] = None
                 drained += 1
         while drained < len(futures):
-            if not ingest(futures[drained].result(), drained * chunk):
+            if not ingest(futures[drained].result(), drained * chunk,
+                          heavy[drained]):
                 return None
+            heavy[drained] = None
             drained += 1
     if defer_chunks:
         for lo, hi in defer_chunks:

@@ -67,6 +67,16 @@ def _node_axis_spec(x, n_nodes: int, skip_leading: bool):
     return P(*spec)
 
 
+def gather_to_host(x) -> np.ndarray:
+    """One replay output as a contiguous C-order host array — the single
+    device->host crossing for device-resident results (framework/replay.py
+    `_CompactChunks.materialize`).  Sharded arrays (a wave run on a mesh)
+    gather their node-axis shards here, and accelerator fetches that
+    arrive with device strides are re-laid C-order because the native
+    codec walks raw pointers assuming C layout."""
+    return np.ascontiguousarray(np.asarray(x))
+
+
 def can_shard(n_nodes: int, mesh: Mesh | None) -> bool:
     """Whether shard_workload accepts this node count on this mesh — the
     single divisibility predicate shared with callers that degrade to an

@@ -23,15 +23,21 @@ of truth and defers the three heavy per-pod blobs to first read:
     observes exactly the eager path's bytes (docs/api.md).
 
 Buffer lifetime (docs/wave-pipeline.md): a LazyWave pins its
-ReplayResult — the per-chunk compact host buffers (`rr._compact`), the
+ReplayResult — the per-chunk compact buffers (`rr._compact`: live
+DEVICE arrays in the device-resident default, host numpy after the
+first cold read or a budget spill — framework/replay.py), the
 CompiledWorkload's host tables (skip masks, prefilter rejects, message
 LUT context) and the node table — across the wave boundary until every
 holder of a handle is read, overwritten or deleted.  All of that state
 is written once by the wave and never mutated afterwards (later waves
 build fresh CompiledWorkloads; `NodeTableReuse` shares only the
 immutable node table), so deferred decode is bit-identical to eager
-decode of the same wave.  `KSS_TPU_EAGER_DECODE=1` disables deferral
-engine-wide (the golden/parity baseline).
+decode of the same wave; a cold read first performs the chunk's
+memoized D2H (`d2h_fetch` span under `decode_lazy`), then the one
+GIL-released chunk decode.  `KSS_TPU_EAGER_DECODE=1` disables deferral
+engine-wide (the golden/parity baseline); `KSS_TPU_HOST_RESIDENT=1`
+keeps the lazy decode but fetches the compact tensors to host in-wave
+(the PR 9 behavior, the middle parity rung).
 """
 
 from __future__ import annotations

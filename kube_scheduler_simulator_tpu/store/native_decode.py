@@ -289,7 +289,9 @@ def decode_chunk_start(ctx: _NativeCtx, rr, lo: int, hi: int,
     cc = rr._compact
     c = hi - lo
     ci, r_lo = divmod(lo, cc.chunk)
-    packed = cc.packed[ci]
+    # cc.host(): device-resident chunks materialize here — the memoized
+    # D2H this read path exists to defer (framework/replay.py)
+    packed = cc.host("packed", ci)
     if not packed.flags["C_CONTIGUOUS"]:
         # device-layout fetch (TPU backends can return strided host
         # arrays); the C codec walks raw pointers in C order
@@ -326,7 +328,7 @@ def decode_chunk_start(ctx: _NativeCtx, rr, lo: int, hi: int,
                 col_stride[q] = n * e
                 col_elem[q] = e
             else:
-                arr = getattr(cc, group)[ci]   # [C, S_g, N]
+                arr = cc.host(group, ci)       # [C, S_g, N]
                 if not arr.flags["C_CONTIGUOUS"]:
                     arr = np.ascontiguousarray(arr)
                     getattr(cc, group)[ci] = arr
@@ -413,7 +415,8 @@ def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
 
     cc = rr._compact
     ci, r = divmod(i, cc.chunk)
-    packed = cc.packed[ci]
+    # cc.host(): device-resident chunks materialize here (memoized D2H)
+    packed = cc.host("packed", ci)
     if not packed.flags["C_CONTIGUOUS"]:
         # device-layout fetch (TPU backends can return strided host
         # arrays); the C codec walks raw pointers in C order
@@ -438,7 +441,7 @@ def decode_pod_fused(ctx: _NativeCtx, rr, i: int, hi: int,
                 col_ptrs[q] = col.ctypes.data
                 col_elem[q] = src.dtype.itemsize
                 continue
-            arr = getattr(cc, group)[ci]
+            arr = cc.host(group, ci)
             if not arr.flags["C_CONTIGUOUS"]:
                 arr = np.ascontiguousarray(arr)
                 getattr(cc, group)[ci] = arr

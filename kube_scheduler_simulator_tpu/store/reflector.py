@@ -174,7 +174,10 @@ class LazyReflections:
     several waves before anyone reads it gets exactly the eager path's
     annotation bytes and result-history sequence.  Exactly-once per
     pod under concurrent readers (in-flight event handshake); the
-    decode and the store write run with NO registry lock held."""
+    decode — including the chunk's device->host materialization when
+    the wave's results are device-resident (framework/replay.py, the
+    `d2h_fetch` span) — and the store write run with NO registry lock
+    held."""
 
     def __init__(self, store):
         import threading

@@ -17,11 +17,13 @@ values overwrite the decoded blob for the touched keys.
 Lazy mode (store/lazy.py, the default on the batched wave paths): the
 engine deposits a `(wave, index)` handle via put_lazy() instead of the
 decoded blobs; get_stored_result() materializes the pod's chunk through
-the wave's memoized chunk decode transparently, and take_deferred()
-hands the whole entry to the reflector as a deferred write-back so the
-wave's critical path never decodes at all.  The merge semantics are
-unchanged: the lazily materialized 13 keys are the base, decoded
-deposits overlay them, granular adds overlay both.
+the wave's memoized chunk decode transparently — including the chunk's
+device->host fetch when the wave left its results device-resident
+(framework/replay.py) — and take_deferred() hands the whole entry to
+the reflector as a deferred write-back so the wave's critical path
+never decodes (or transfers the heavy tensors) at all.  The merge
+semantics are unchanged: the lazily materialized 13 keys are the base,
+decoded deposits overlay them, granular adds overlay both.
 """
 
 from __future__ import annotations

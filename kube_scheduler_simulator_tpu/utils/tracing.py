@@ -102,6 +102,23 @@ _HELP: dict[str, str] = {
         "Cold first-read latency of a lazily materialized pod: time from "
         "the read to its chunk's annotations being available (one "
         "GIL-released native chunk decode).",
+    "d2h_on_demand_bytes_total":
+        "Bytes copied device->host by on-demand materialization of "
+        "device-resident replay chunks (cold reads; docs/wave-pipeline.md "
+        "device-residency stage).",
+    "d2h_on_demand_seconds":
+        "On-demand device->host materialization latency of one "
+        "device-resident replay chunk (gather included on meshes).",
+    "wave_d2h_bytes_total":
+        "Bytes the wave itself copied device->host while streaming: "
+        "decision rows + attribution sums only in device-resident mode, "
+        "the full compact tensors in host-resident/eager modes.",
+    "device_chunks_retained":
+        "Replay chunks currently retained as live device arrays "
+        "(KSS_TPU_DEVICE_RESULT_BUDGET_MB bounds the bytes behind them).",
+    "device_chunks_spilled_total":
+        "Device-resident replay chunks spilled to host by the retention "
+        "budget's background LRU writer.",
 }
 
 _NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -171,6 +188,9 @@ class Tracer:
         self._events: deque = deque(maxlen=capacity)
         self._agg: dict[str, dict] = {}
         self._counters: dict[str, float] = {}
+        # gauges: absolute values set by gauge() (current device-retained
+        # chunk count etc.), exported with TYPE gauge
+        self._gauges: dict[str, float] = {}
         # labeled counters: name -> {((k, v), ...) sorted: value}
         self._lcounters: dict[str, dict[tuple, float]] = {}
         # histograms: name -> {((k, v), ...) sorted: _Hist}
@@ -244,6 +264,12 @@ class Tracer:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
 
+    def gauge(self, name: str, value: float) -> None:
+        """Set a gauge to an absolute value (unlike count(), which
+        accumulates): the exporter emits it with TYPE gauge."""
+        with self._lock:
+            self._gauges[name] = value
+
     def inc(self, name: str, n: float = 1, **labels) -> None:
         """Labeled counter increment; identical label sets merge
         regardless of keyword order."""
@@ -303,6 +329,7 @@ class Tracer:
         out = self.summary()
         with self._lock:
             out["time"] = time.time()
+            out["gauges"] = dict(self._gauges)
             out["labeled_counters"] = {
                 name: [{"labels": dict(key), "value": v}
                        for key, v in sorted(series.items())]
@@ -338,6 +365,7 @@ class Tracer:
         buckets ending at +Inf."""
         with self._lock:
             counters = dict(self._counters)
+            gauges = dict(self._gauges)
             lcounters = {n: dict(s) for n, s in self._lcounters.items()}
             hists = {
                 n: (self._hist_bounds[n],
@@ -357,6 +385,9 @@ class Tracer:
 
         for name, v in sorted(counters.items()):
             m = family(name, "counter")
+            out.append(f"{m} {_fmt_float(v)}")
+        for name, v in sorted(gauges.items()):
+            m = family(name, "gauge")
             out.append(f"{m} {_fmt_float(v)}")
         for name, series in sorted(lcounters.items()):
             m = family(name, "counter")
@@ -426,6 +457,7 @@ class Tracer:
             self._events.clear()
             self._agg.clear()
             self._counters.clear()
+            self._gauges.clear()
             self._lcounters.clear()
             self._hists.clear()
             self._hist_bounds.clear()

@@ -40,3 +40,16 @@ def allowed_loop(pods):
     for p in pods:
         out += 1
     return out
+
+
+import numpy as np  # noqa: E402
+
+
+def eager_compact_fetch(cc, ci):
+    # compact-host-sync: an eager D2H of a replay compact field outside
+    # _CompactChunks.materialize re-pins the heavy tensors on host
+    return np.asarray(cc.packed[ci])
+
+
+def contiguous_compact_fetch(cc, ci):
+    return np.ascontiguousarray(cc.raw16[ci][:8])

@@ -107,6 +107,21 @@ def test_unreachable_and_allowed_not_flagged():
     assert not any(f.qualname == "allowed_loop" for f in res["findings"])
 
 
+def test_compact_host_sync_detected():
+    """Eager np.asarray/np.ascontiguousarray on a replay compact field
+    (.packed/.raw8/.raw16/.raw32) outside _CompactChunks.materialize is
+    flagged: device-resident chunks must cross D2H only through
+    cc.host()/materialize() (docs/wave-pipeline.md device residency)."""
+    roots = _PURITY_ROOTS + [("bad_purity", "eager_compact_fetch"),
+                             ("bad_purity", "contiguous_compact_fetch")]
+    res = _fixture_result("bad_purity.py", purity_roots=roots)
+    hits = [f for f in res["findings"] if f.rule == "compact-host-sync"]
+    assert any(f.qualname == "eager_compact_fetch" and "packed" in f.detail
+               for f in hits), hits
+    assert any(f.qualname == "contiguous_compact_fetch"
+               and "raw16" in f.detail for f in hits), hits
+
+
 # ------------------------------------------------------------ span rules
 
 

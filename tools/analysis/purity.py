@@ -48,12 +48,30 @@ HOT_PATH_ROOTS: list[tuple[str, str]] = [
     ("store.lazy", "*"),
     ("store.reflector", "LazyReflections._drain"),
     ("store.reflector", "LazyReflections._apply"),
+    # device-resident results (PR 10): the D2H entry points serve API
+    # reads concurrently with live waves, and the device-side
+    # attribution reduction runs per chunk inside the wave — both must
+    # stay loop-free and host-sync-free (framework.replay is a root
+    # already and covers _CompactChunks.materialize/_DeviceAttribution)
+    ("store.native_decode", "decode_chunk_start"),
+    ("store.native_decode", "decode_pod_fused"),
 ]
 
 BIG_ITERABLES = {"pending", "pods", "nodes"}
 HOST_SYNC_METHODS = {"item"}
 HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
+
+# compact-host-sync: the replay's heavy per-chunk groups may be LIVE
+# DEVICE arrays (device-resident results); an eager np.asarray /
+# np.ascontiguousarray on one of these fields outside the materialization
+# path silently re-introduces the in-wave D2H the residency design
+# removed.  _CompactChunks.host()/materialize() (which route through
+# parallel.mesh.gather_to_host on a generic value, not a field access)
+# are the only sanctioned crossings.
+COMPACT_FIELDS = {"packed", "raw8", "raw16", "raw32"}
+COMPACT_SYNC_CALLS = HOST_SYNC_CALLS | {
+    "np.ascontiguousarray", "numpy.ascontiguousarray", "jax.device_get"}
 
 
 def resolve_roots(graph: CallGraph,
@@ -100,6 +118,17 @@ class PurityAnalyzer:
             elif isinstance(node, ast.Call):
                 name = dotted_name(node.func) or ""
                 last = name.split(".")[-1]
+                if (name in COMPACT_SYNC_CALLS
+                        and self._compact_field_arg(node)):
+                    out.append(Finding(
+                        rule="compact-host-sync", path=info.module.path,
+                        qualname=info.qualname,
+                        detail=f"{name}({self._compact_field_arg(node)})",
+                        lineno=node.lineno,
+                        message=f"{name} on a replay compact field outside "
+                                "_CompactChunks.materialize: device-resident "
+                                "chunks must cross D2H only through "
+                                "cc.host()/materialize()"))
                 if last in HOST_SYNC_METHODS and "." in name:
                     out.append(Finding(
                         rule="host-sync", path=info.module.path,
@@ -123,6 +152,17 @@ class PurityAnalyzer:
                         message=f"{name}() inside jitted code bakes a "
                                 "trace-time value into the executable"))
         return out
+
+    @staticmethod
+    def _compact_field_arg(call: ast.Call) -> str | None:
+        """The `.packed`/`.raw*` attribute inside the call's arguments,
+        if any (e.g. np.asarray(cc.packed[ci][:m]) -> "packed")."""
+        for arg in call.args:
+            for sub in ast.walk(arg):
+                if (isinstance(sub, ast.Attribute)
+                        and sub.attr in COMPACT_FIELDS):
+                    return sub.attr
+        return None
 
     def _big_iterable(self, it: ast.AST) -> str | None:
         name = dotted_name(it)
