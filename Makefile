@@ -8,8 +8,8 @@
 PY ?= python
 
 .PHONY: codec native-asan native-tsan test test-asan test-tsan analyze \
-        bench bench-check bench-multichip smoke clean \
-        parity-fullscale parity-fullscale-device multichip-scaling \
+        bench bench-check bench-gang bench-serve bench-multichip smoke \
+        clean parity-fullscale parity-fullscale-device multichip-scaling \
         host-probe tpu-watch
 
 # measurement artifacts (committed under docs/bench/; see BASELINE.md)
@@ -93,6 +93,19 @@ bench-check:
 # BENCH rounds can track gang throughput
 bench-gang:
 	$(PY) bench.py --gang
+
+# multi-session serving shape (docs/api.md sessions surface): K>=4
+# concurrent isolated sessions on one device, reporting aggregate + p99
+# per-session cycles/s and the cross-session compile-cache hit rate
+# (asserted >= (K-1)/K: each scan shape compiles once per process)
+bench-serve:
+	$(PY) bench.py --serve | tee /tmp/bench_serve.json
+	$(PY) -c "import json; d = [json.loads(l) for l in open('/tmp/bench_serve.json') if l.startswith('{')][-1]; \
+	    s = d['extra']['serve']; cc = s['compile_cache']; \
+	    assert s['sessions'] >= 4, s['sessions']; \
+	    assert cc['hit_rate'] >= cc['floor'], (cc, 'hit rate under (K-1)/K'); \
+	    print('bench-serve: %d sessions, warm aggregate %.0f cycles/s, p99 %.0f, cache hit rate %.2f (floor %.2f)' \
+	        % (s['sessions'], s['warm']['aggregate_cycles_per_sec'], s['warm']['p99_session_cycles_per_sec'], cc['hit_rate'], cc['floor']))"
 
 smoke:
 	$(PY) bench.py --smoke

@@ -56,6 +56,7 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
     eng10k = extra.get("engine_10k_5k") or {}
     lazy = eng.get("lazy") or {}
     lazy10k = eng10k.get("lazy") or {}
+    serve = extra.get("serve") or {}
     return {
         "decode_pods_per_sec": (extra.get("decode_pods_per_sec"), "higher"),
         "commit_stream_overlap_seconds":
@@ -81,6 +82,19 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
              "lower"),
         "engine_10k_5k_cold_read_with_d2h_seconds":
             (lazy10k.get("cold_read_seconds"), "lower"),
+        # multi-session serving era metrics (absent from pre-session
+        # rounds — the union/skip semantics carry them): warm-round
+        # aggregate and slowest-session throughput across K concurrent
+        # sessions, and the cross-session compile-cache hit rate (a drop
+        # means sessions started recompiling shapes they used to share)
+        "serve_aggregate_cycles_per_sec":
+            ((serve.get("warm") or {}).get("aggregate_cycles_per_sec"),
+             "higher"),
+        "serve_p99_session_cycles_per_sec":
+            ((serve.get("warm") or {}).get("p99_session_cycles_per_sec"),
+             "higher"),
+        "serve_compile_cache_hit_rate":
+            ((serve.get("compile_cache") or {}).get("hit_rate"), "higher"),
     }
 
 

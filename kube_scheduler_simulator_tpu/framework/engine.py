@@ -248,6 +248,9 @@ class _WaveCommitter:
         self._stop = False      # abort(): drop queued chunks uncommitted
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._reflects = _ReflectBatcher(engine, len(pending), use_batch=True)
+        # the worker inherits the engine's session scope (its own thread:
+        # thread-local scopes don't cross the boundary by themselves)
+        self._session = getattr(engine, "session", None)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="commit-stream")
         self._thread.start()
@@ -327,21 +330,22 @@ class _WaveCommitter:
     # ---------------------------------------------- worker-thread side
 
     def _run(self) -> None:
-        while True:
-            item = self._q.get()
-            if item is None:
-                return
-            if self._exc is not None or self._stop:
-                continue  # keep draining so finish() never blocks
-            try:
-                t0 = time.perf_counter()
-                wave, lo, hi, selected = item
-                with TRACER.span("commit_stream", parent=self.parent_span,
-                                 lo=lo, hi=hi):
-                    self._commit(wave, lo, hi, selected)
-                self._busy.append((t0, time.perf_counter()))
-            except BaseException as e:  # noqa: BLE001 — re-raised in finish()
-                self._exc = e
+        with TRACER.session_scope(self._session):
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                if self._exc is not None or self._stop:
+                    continue  # keep draining so finish() never blocks
+                try:
+                    t0 = time.perf_counter()
+                    wave, lo, hi, selected = item
+                    with TRACER.span("commit_stream", parent=self.parent_span,
+                                     lo=lo, hi=hi):
+                        self._commit(wave, lo, hi, selected)
+                    self._busy.append((t0, time.perf_counter()))
+                except BaseException as e:  # noqa: BLE001 — finish() re-raises
+                    self._exc = e
 
     def _put_result(self, wave, i: int, ns: str, name: str) -> None:
         """Deposit pod i's wave result: a lazy handle (tensor-backed,
@@ -508,6 +512,13 @@ class SchedulerEngine:
         # injectable for tests (forced-conflict soak asserts the backoff
         # schedule without waiting out real 100ms x 3^n sleeps)
         self._retry_sleep = time.sleep
+        # multi-session serving (server/sessions.py): the owning
+        # session's id, or None for direct engine use.  schedule_pending
+        # and the engine's worker threads enter this session's tracer
+        # scope, so every span/counter the wave records carries the
+        # session label and the device-result budget attributes retained
+        # chunks to the right per-session share
+        self.session: str | None = None
 
     def set_plugin_config(self, cfg: PluginSetConfig) -> None:
         """Legacy single-profile API: one plugin set for every pod.
@@ -709,7 +720,8 @@ class SchedulerEngine:
         """One scheduling wave over all pending pods (plus retry waves for
         pods unblocked by preemption, and re-runs after a custom
         Reserve/Permit/PreBind rejected a speculative placement). Returns
-        #bound.
+        #bound.  Runs under the owning session's tracer scope (self.session;
+        a no-op for direct engine use).
 
         Pods parked by Permit "wait" do NOT stall the wave: their binding
         cycle finishes on a waiter thread when allowed/rejected/timed out
@@ -721,6 +733,10 @@ class SchedulerEngine:
         gang may complete in a later call's wave); expired ones are
         timeout-rejected — whole gangs at a time — at the top of every
         call (docs/gang-scheduling.md)."""
+        with TRACER.session_scope(self.session):
+            return self._schedule_pending_scoped()
+
+    def _schedule_pending_scoped(self) -> int:
         n_bound = self._gang_maintain()
         if n_bound:
             TRACER.count("pods_scheduled_total", n_bound)
@@ -1697,6 +1713,12 @@ class SchedulerEngine:
         has fully landed — popping earlier would let a concurrent retry
         wave re-schedule it.  Any exception resolves to "rejected" (with
         unreserve) rather than silently killing the thread."""
+        with TRACER.session_scope(self.session):
+            self._waiter_finish_scoped(wp, waits, pod, ns, name, node_name,
+                                       node, plugins, emap, unreserve_all)
+
+    def _waiter_finish_scoped(self, wp, waits, pod, ns, name, node_name,
+                              node, plugins, emap, unreserve_all) -> None:
         outcome = "rejected"
         try:
             rejection = wp.wait()

@@ -155,7 +155,14 @@ class _CompactChunks:
         ev.set()
         _DEVICE_BUDGET.release(self, ci)
         if spill:
-            TRACER.count("device_chunks_spilled_total")
+            # labeled by session when the budget attributed the chunk to
+            # one (the spill thread enters the owner's session scope):
+            # one fat session's spills must be visible as ITS spills
+            sid = TRACER.current_session()
+            if sid is not None:
+                TRACER.inc("device_chunks_spilled_total", session=sid)
+            else:
+                TRACER.count("device_chunks_spilled_total")
         else:
             TRACER.count("d2h_on_demand_bytes_total", nbytes)
             TRACER.observe("d2h_on_demand_seconds", dt)
@@ -170,13 +177,24 @@ class _DeviceResultBudget:
     (chunks stay on device until a cold read materializes them or their
     wave is dropped); 0 -> retain nothing, spill as chunks land.
     Entries hold the _CompactChunks weakly — dropping a wave's last
-    handle releases its accounting without any explicit call."""
+    handle releases its accounting without any explicit call.
+
+    Multi-session serving (server/sessions.py): each retained chunk is
+    attributed to the session whose wave produced it (the tracer's
+    session scope at retain time; None for direct engine use).  The
+    global pool divides EQUALLY among the sessions currently holding
+    entries, and enforcement is per-session against that share — a fat
+    session spills its own least-recent chunks and never evicts a small
+    neighbor's.  With a single bucket (the sessionless pre-session
+    behavior) the share IS the whole pool, so nothing changes for
+    direct engine use."""
 
     def __init__(self):
         from collections import deque
 
         self._mu = threading.Lock()
-        # (id(cc), ci) -> [weakref(cc), ci, nbytes, spilling, attempts]
+        # (id(cc), ci) -> [weakref(cc), ci, nbytes, spilling, attempts,
+        #                  session]
         self._entries: OrderedDict[tuple[int, int], list] = OrderedDict()
         self._total = 0
         self._pool = None
@@ -210,6 +228,7 @@ class _DeviceResultBudget:
 
     def retain(self, cc: _CompactChunks, ci: int, nbytes: int) -> None:
         key = (id(cc), ci)
+        session = TRACER.current_session()
 
         def _gone(_ref, key=key):
             self._dead.append(key)  # lock-free: pruned on next locked op
@@ -219,7 +238,7 @@ class _DeviceResultBudget:
             # collide with this one (id() reuse) and drop the fresh entry
             self._prune_locked()
             self._entries[key] = [weakref.ref(cc, _gone), ci, nbytes, False,
-                                  0]
+                                  0, session]
             self._total += nbytes
             TRACER.gauge("device_chunks_retained", len(self._entries))
         self._enforce()
@@ -236,34 +255,59 @@ class _DeviceResultBudget:
             self._prune_locked()
             return len(self._entries)
 
+    def retained_by_session(self) -> dict:
+        """{session (None = sessionless): (chunks, bytes)} currently
+        retained — the per-session accounting behind the shares
+        (tests, /api/v1/sessions)."""
+        out: dict = {}
+        with self._mu:
+            self._prune_locked()
+            for ent in self._entries.values():
+                c, b = out.get(ent[5], (0, 0))
+                out[ent[5]] = (c + 1, b + ent[2])
+        return out
+
     def _enforce(self) -> None:
         limit = self.limit_bytes()
         if limit is None:
             return
-        to_spill: list[tuple[_CompactChunks, int]] = []
+        to_spill: list[tuple[_CompactChunks, int, str | None]] = []
         with self._mu:
             self._prune_locked()
-            over = self._total - limit
+            # equal split of the global pool across the sessions holding
+            # entries: each bucket is enforced against ITS share, in LRU
+            # order WITHIN the bucket — a fat session spills its own
+            # chunks, never a neighbor's.  One bucket -> share == limit,
+            # the pre-session behavior.
+            totals: dict = {}
             for ent in self._entries.values():
-                if over <= 0:
-                    break
+                totals[ent[5]] = totals.get(ent[5], 0) + ent[2]
+            share = limit // max(1, len(totals))
+            over = {s: t - share for s, t in totals.items()}
+            for ent in self._entries.values():
+                if over.get(ent[5], 0) <= 0:
+                    continue
                 if ent[3]:
-                    over -= ent[2]  # already queued for spill
+                    over[ent[5]] -= ent[2]  # already queued for spill
                     continue
                 cc = ent[0]()
                 if cc is None:
                     continue  # the weakref callback prunes it
                 ent[3] = True
-                to_spill.append((cc, ent[1]))
-                over -= ent[2]
-        for cc, ci in to_spill:
-            self._spill_pool().submit(self._spill_one, cc, ci)
+                to_spill.append((cc, ent[1], ent[5]))
+                over[ent[5]] -= ent[2]
+        for cc, ci, session in to_spill:
+            self._spill_pool().submit(self._spill_one, cc, ci, session)
 
     _SPILL_RETRIES = 3
 
-    def _spill_one(self, cc: _CompactChunks, ci: int) -> None:
+    def _spill_one(self, cc: _CompactChunks, ci: int,
+                   session: str | None = None) -> None:
         try:
-            cc.materialize(ci, spill=True)
+            # the spill thread adopts the owning session's scope so the
+            # spill counter lands as device_chunks_spilled_total{session=}
+            with TRACER.session_scope(session):
+                cc.materialize(ci, spill=True)
         except Exception:
             # transient fetch failure: clear the in-flight mark and
             # re-enforce (bounded — after _SPILL_RETRIES the chunk stays
@@ -789,18 +833,93 @@ def _slice_xs(xs: dict[str, Any], lo: int, hi: int, pad_to: int) -> dict[str, An
     return jax.tree.map(cut, xs)
 
 
-# jitted scans shared across CompiledWorkload instances.  jax.jit keys on
-# function identity, so a per-workload build_step closure would retrace and
-# recompile on every compile_workload() (first TPU compile is tens of
-# seconds) — even though successive scheduler waves, and preemption's
-# dry-run hypotheses, produce workloads with byte-identical statics and
-# shapes.  The key therefore hashes the statics CONTENT (the step closure
-# bakes them in as constants) plus the xs/carry shape signature and the
-# plugin-set signature; any mismatch falls through to a fresh compile.
-# The statics fingerprint is computed once per CompiledWorkload (cached in
-# cw.host), not on every replay() call.
-_SCAN_CACHE: dict = {}
-_SCAN_CACHE_MAX = 64
+# jitted scans shared across CompiledWorkload instances — and across
+# SESSIONS (server/sessions.py): the registry is process-level BY DESIGN,
+# so N isolated simulations serving the same workload shape pay the
+# ~0.95s XLA compile once and every other session's first wave reuses the
+# executable.  jax.jit keys on function identity, so a per-workload
+# build_step closure would retrace and recompile on every
+# compile_workload() (first TPU compile is tens of seconds) — even though
+# successive scheduler waves, and preemption's dry-run hypotheses,
+# produce workloads with byte-identical statics and shapes.  The key
+# therefore hashes the statics CONTENT (the step closure bakes them in as
+# constants) plus the xs/carry shape signature and the plugin-set
+# signature; any mismatch falls through to a fresh compile.  The statics
+# fingerprint is computed once per CompiledWorkload (cached in cw.host),
+# not on every replay() call.
+
+
+class _ScanCacheRegistry:
+    """Process-level LRU registry of jitted scan callables, keyed by
+    workload shape (_workload_scan_key).  Concurrent sessions' waves hit
+    it from different threads, so — unlike the bare module dict it grew
+    from — lookups are locked, and a miss REGISTERS an in-flight build
+    before releasing the lock: a second session racing the same key
+    waits for the winner's callable instead of double-compiling (the
+    compile-once guarantee `make bench-serve` measures as its
+    (K-1)/K hit rate).  LRU semantics unchanged: pop-and-reinsert on
+    hit, so two shapes alternating at capacity never evict each other's
+    still-hot compiles."""
+
+    def __init__(self, max_entries: int = 64):
+        self.max_entries = max_entries
+        self._mu = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        self._building: dict = {}   # key -> threading.Event
+        self.hits = 0
+        self.misses = 0
+
+    def stats(self) -> dict:
+        with self._mu:
+            total = self.hits + self.misses
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses,
+                    "hit_rate": round(self.hits / total, 4) if total else None}
+
+    def get_or_build(self, key, builder):
+        while True:
+            with self._mu:
+                scan_jit = self._entries.pop(key, None)
+                if scan_jit is not None:
+                    self._entries[key] = scan_jit  # re-insert: most recent
+                    self.hits += 1
+                    TRACER.inc("scan_compile_cache_total", result="hit")
+                    return scan_jit
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = self._building[key] = threading.Event()
+                    self.misses += 1
+                    TRACER.inc("scan_compile_cache_total", result="miss")
+                    break
+            # another thread is building this key: its executable is
+            # seconds away — waiting IS the cross-session compile shave
+            ev.wait()
+        try:
+            # the jax.jit wrapper builds OUTSIDE the lock (kss-analyze
+            # device-under-lock; jit is lazy but build_step touches jnp)
+            scan_jit = builder()
+        except BaseException:
+            with self._mu:
+                del self._building[key]
+            ev.set()    # waiters retry; they'll become builders
+            raise
+        with self._mu:
+            while len(self._entries) >= self.max_entries:
+                self._entries.popitem(last=False)
+            self._entries[key] = scan_jit
+            del self._building[key]
+        ev.set()
+        return scan_jit
+
+
+_SCAN_CACHE = _ScanCacheRegistry()
+
+
+def scan_cache_stats() -> dict:
+    """Process-level compile-cache stats ({entries, hits, misses,
+    hit_rate}) — the /api/v1/sessions surface and `make bench-serve`
+    report these."""
+    return _SCAN_CACHE.stats()
 
 
 def _statics_fingerprint(cw: CompiledWorkload) -> str:
@@ -865,12 +984,8 @@ def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1, mesh=None,
               wide: bool = False):
     key = (*_workload_scan_key(cw, chunk, mesh), unroll, "compact", pack_mode,
            score_dtypes, wide)
-    # LRU, not FIFO: pop-and-reinsert on hit moves the entry to the
-    # recent end, so two workload shapes alternating at _SCAN_CACHE_MAX
-    # entries never evict each other's still-hot compiles (insertion-
-    # order eviction used to thrash exactly that pattern)
-    scan_jit = _SCAN_CACHE.pop(key, None)
-    if scan_jit is None:
+
+    def build():
         step = build_step(_SlimWorkload(cw), out_mode="compact",
                           pack_mode=pack_mode, score_dtypes=score_dtypes,
                           wide_raw=wide)
@@ -878,11 +993,9 @@ def _scan_for(cw: CompiledWorkload, chunk: int, unroll: int = 1, mesh=None,
         def scan_chunk(carry, xs_chunk):
             return jax.lax.scan(step, carry, xs_chunk, unroll=unroll)
 
-        scan_jit = jax.jit(scan_chunk, donate_argnums=(0,))
-        while len(_SCAN_CACHE) >= _SCAN_CACHE_MAX:
-            _SCAN_CACHE.pop(next(iter(_SCAN_CACHE)))
-    _SCAN_CACHE[key] = scan_jit
-    return scan_jit
+        return jax.jit(scan_chunk, donate_argnums=(0,))
+
+    return _SCAN_CACHE.get_or_build(key, build)
 
 
 def _fetch_chunk(out) -> dict[str, np.ndarray]:

@@ -7,11 +7,26 @@ the one-shot importer, syncer, and replayer.  Extra here: the scheduling
 loop thread, which replaces the reference's separate debuggable-scheduler
 container by running the tensor engine in-process whenever pods await
 scheduling.
+
+Multi-session serving (server/sessions.py): a DIContainer IS the
+per-session context — everything it owns (store, reflector, engine,
+result store, scheduling loop, service set) is private to one simulated
+cluster.  What it does NOT own is shared process-wide by design: the
+compiled-scan registry (framework/replay._SCAN_CACHE — sessions at the
+same workload shape reuse one XLA executable) and the device-result
+retention budget (framework/replay._DEVICE_BUDGET — one
+KSS_TPU_DEVICE_RESULT_BUDGET_MB pool split into per-session shares).
+The `session` argument stamps the engine so waves record under that
+session's tracer scope.
 """
 
 from __future__ import annotations
 
 import threading
+import time
+import traceback
+
+from ..utils.tracing import TRACER
 
 from ..cluster.store import ADDED, MODIFIED, ObjectStore
 from ..config.config import SimulatorConfiguration
@@ -44,6 +59,10 @@ class SchedulingLoop:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._q = None
+        # last wave crash ({time, error, traceback}) — the loop survives
+        # engine exceptions, but a silently wedged loop is unobservable;
+        # /readyz surfaces this and scheduling_loop_crashes_total counts
+        self.last_crash: dict | None = None
 
     def start(self):
         self._q = self.store.watch("pods")
@@ -79,16 +98,28 @@ class SchedulingLoop:
             self._stop.wait(self.debounce)  # batch bursts
             try:
                 self.engine.schedule_pending()
-            except Exception:  # keep the loop alive like a crashed-and-restarted pod
-                import traceback
-
+            except Exception as e:  # keep the loop alive like a crashed-and-restarted pod
+                tb = traceback.format_exc()
+                self.last_crash = {
+                    "time": time.time(),
+                    "error": f"{type(e).__name__}: {e}",
+                    "traceback": tb,
+                }
+                session = getattr(self.engine, "session", None)
+                if session is not None:
+                    TRACER.inc("scheduling_loop_crashes_total",
+                               session=session)
+                else:
+                    TRACER.count("scheduling_loop_crashes_total")
                 traceback.print_exc()
 
 
 class DIContainer:
     def __init__(self, cfg: SimulatorConfiguration | None = None,
                  source_store: ObjectStore | None = None,
-                 start_scheduler: bool = True):
+                 start_scheduler: bool = True,
+                 session: str | None = None):
+        self.session = session
         self.cfg = cfg or SimulatorConfiguration()
         self.store = ObjectStore(
             extra_resources=getattr(self.cfg, "extra_resources", None))
@@ -105,6 +136,7 @@ class DIContainer:
         self.applier = ResourceApplier(self.store)
         self.reflector = StoreReflector(self.store)
         self.engine = SchedulerEngine(self.store, reflector=self.reflector)
+        self.engine.session = session
         initial_scheduler_cfg = self.cfg.initial_scheduler_config()
         self.scheduler_service = SchedulerService(self.engine, initial_scheduler_cfg)
         self.snapshot_service = SnapshotService(self.store, self.scheduler_service)

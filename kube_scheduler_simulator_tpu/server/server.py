@@ -20,14 +20,28 @@ framework embeds the cluster, so the same CRUD is exposed at
   /api/v1/namespaces | nodes | pods | ... (GET list, POST create)
   /api/v1/<resource>/<ns>/<name> or /api/v1/<resource>/<name>
   (GET, PUT update, DELETE)
+Multi-session serving (server/sessions.py, docs/api.md):
+  GET/POST /api/v1/sessions                -> list / create sessions
+  GET/DELETE /api/v1/sessions/<id>         -> session info / evict
+  ANY /api/v1/sessions/<id>/<subpath>      -> EVERY route above, scoped
+       to that session's isolated simulation; the bare /api/v1 paths
+       alias the pinned `default` session, so pre-session clients keep
+       working unchanged.
 Observability surface (docs/metrics.md):
   GET  /metrics                 -> Prometheus text exposition
-  GET  /api/v1/metrics          -> full tracer snapshot JSON
+  GET  /api/v1/metrics          -> full tracer snapshot JSON (?session=)
   GET  /api/v1/metrics/stream   -> SSE snapshots (?interval=S&count=N)
-  GET  /api/v1/trace            -> Perfetto/chrome://tracing JSON (?limit=N)
+  GET  /api/v1/trace            -> Perfetto/chrome://tracing JSON
+                                   (?limit=N&session=)
   POST /api/v1/profile          -> XLA profile start/stop (409 on bad state)
   GET  /healthz | /readyz       -> liveness / scheduling-loop readiness
+                                   (readyz surfaces the last loop crash)
 Middleware: request logging + CORS (reference: server.go:27-37).
+
+Long-lived responses (the chunked list-watch and the SSE metrics
+stream) register a stop event with the server AND their session, so
+`SimulatorServer.shutdown()` and session eviction close them promptly
+instead of leaving handler threads sleeping into a dead simulation.
 """
 
 from __future__ import annotations
@@ -35,7 +49,6 @@ from __future__ import annotations
 import json
 import re
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -43,17 +56,34 @@ from ..cluster.store import ApiError
 from ..services.resourcewatcher import StreamWriter, WATCH_PARAMS
 from ..services.snapshot import SnapshotOptions
 from .di import DIContainer
+from .sessions import SessionManager, StreamRegistry
 
 # query-param names per kind (reference: handler/watcher.go:26-34 — note
 # "namespaceLastResourceVersion" is singular in the reference)
 class SimulatorServer:
-    def __init__(self, di: DIContainer, port: int | None = None):
-        self.di = di
-        self.port = port if port is not None else di.cfg.port
+    def __init__(self, di: DIContainer | SessionManager | None = None,
+                 port: int | None = None):
+        # accept either the pre-session shape (a DIContainer, adopted as
+        # the pinned default session) or a SessionManager
+        if isinstance(di, SessionManager):
+            self.manager = di
+        else:
+            self.manager = SessionManager(default_di=di)
+        self.port = port if port is not None else self.manager.cfg.port
         self.httpd: ThreadingHTTPServer | None = None
+        # live long-poll/SSE responses across ALL sessions; shutdown()
+        # fires every event so no handler thread outlives the server
+        # sleeping on an interval (each session holds its own registry
+        # for eviction — handlers register with both)
+        self.streams = StreamRegistry()
+
+    @property
+    def di(self) -> DIContainer:
+        """The default session's container (pre-session accessor)."""
+        return self.manager.default.di
 
     def start(self, block: bool = True):
-        handler = _make_handler(self.di)
+        handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
         self.port = self.httpd.server_address[1]
         if block:
@@ -62,13 +92,17 @@ class SimulatorServer:
             threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
 
     def shutdown(self):
+        # streams first: a chunked watch or SSE loop parked on its
+        # interval must wake and finish before the sessions tear down
+        self.streams.close_all()
         if self.httpd:
             self.httpd.shutdown()
-        self.di.shutdown()
+        self.manager.shutdown()
 
 
-def _make_handler(di: DIContainer):
-    cors_origins = di.cfg.cors_allowed_origin_list
+def _make_handler(server: SimulatorServer):
+    manager = server.manager
+    cors_origins = manager.cfg.cors_allowed_origin_list
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -132,55 +166,37 @@ def _make_handler(di: DIContainer):
             url = urlparse(self.path)
             path = url.path.rstrip("/")
             try:
-                if path in ("", "/", "/ui") and method == "GET":
-                    return self._index()
-                if path.startswith("/web/") and method == "GET":
-                    return self._static(path[len("/web/"):])
-                if path == "/metrics" and method == "GET":
-                    return self._metrics_text()
-                if path in ("/healthz", "/readyz") and method == "GET":
-                    return self._health(path)
-                if path == "/api/v1/metrics" and method == "GET":
-                    from ..utils.tracing import TRACER
-
-                    return self._json(200, TRACER.snapshot())
-                if path == "/api/v1/metrics/stream" and method == "GET":
-                    return self._metrics_stream(url)
-                if path == "/api/v1/trace" and method == "GET":
-                    return self._trace(url)
-                if path == "/api/v1/profile" and method == "POST":
-                    return self._profile()
-                if path == "/api/v1/schedulerconfiguration":
-                    if method == "GET":
-                        return self._json(200, di.scheduler_service.get_config())
-                    if method == "POST":
-                        return self._apply_scheduler_config()
-                elif path == "/api/v1/reset" and method == "PUT":
-                    di.reset_service.reset()
-                    return self._json(202)
-                elif path == "/api/v1/export" and method == "GET":
-                    opts = SnapshotOptions(
-                        ignore_err="ignoreErr" in parse_qs(url.query))
-                    return self._json(200, di.snapshot_service.snap(opts))
-                elif path == "/api/v1/import" and method == "POST":
-                    opts = SnapshotOptions(
-                        ignore_err="ignoreErr" in parse_qs(url.query),
-                        ignore_scheduler_configuration="ignoreSchedulerConfiguration"
-                        in parse_qs(url.query),
-                    )
-                    di.snapshot_service.load(self._body() or {}, opts)
-                    return self._json(200)
-                elif path == "/api/v1/listwatchresources" and method == "GET":
-                    return self._list_watch(url)
-                elif path.startswith("/api/v1/extender/") and method == "POST":
-                    return self._extender(path)
-                elif path == "/api/v1/scenarios" or path.startswith("/api/v1/scenarios/"):
-                    return self._scenarios(method, path)
+                # ------- session surface + per-session aliasing -------
+                # /api/v1/sessions[/<id>[/<subpath>]]: the CRUD surface,
+                # and a full alias of every route below scoped to one
+                # session.  Bare paths resolve to the pinned default
+                # session (sessions.py), so pre-session clients are
+                # untouched.
+                routed_sid = None
+                if path == "/api/v1/sessions":
+                    return self._sessions_collection(method)
+                if path.startswith("/api/v1/sessions/"):
+                    rest = path[len("/api/v1/sessions/"):]
+                    sid, _, sub = rest.partition("/")
+                    if not sub:
+                        return self._sessions_item(method, sid)
+                    sess = manager.get(sid)
+                    path = ("/api/v1/" + sub).rstrip("/")
+                    routed_sid = sid
                 else:
-                    m = re.fullmatch(r"/api/v1/([a-z0-9-]+)(?:/([^/]+))?(?:/([^/]+))?", path)
-                    if m and m.group(1) in di.store.resources:
-                        return self._resource_crud(method, m, url)
-                self._json(404, {"message": f"route not found: {method} {path}"})
+                    sess = manager.default
+                    if path.startswith("/api/v1") or path in ("/metrics",
+                                                              "/readyz"):
+                        sess.touch()
+                self.sess = sess
+                self.di = sess.di
+                # session-scoped observability: the prefix pins the
+                # filter; bare /api/v1/trace|metrics take ?session=
+                self.routed_sid = routed_sid
+                from ..utils.tracing import TRACER
+
+                with TRACER.session_scope(sess.id):
+                    return self._dispatch(method, path, url)
             except ApiError as e:
                 self._error(e)
             except json.JSONDecodeError as e:
@@ -190,16 +206,103 @@ def _make_handler(di: DIContainer):
             except Exception as e:  # handler-level 500, server stays up
                 self._error(e)
 
+        def _dispatch(self, method: str, path: str, url):
+            di = self.di
+            if path in ("", "/", "/ui") and method == "GET":
+                return self._index()
+            if path.startswith("/web/") and method == "GET":
+                return self._static(path[len("/web/"):])
+            if path == "/metrics" and method == "GET":
+                return self._metrics_text()
+            if path in ("/healthz", "/readyz") and method == "GET":
+                return self._health(path)
+            if path == "/api/v1/metrics" and method == "GET":
+                from ..utils.tracing import TRACER
+
+                sid = self._session_filter(url)
+                return self._json(200, TRACER.snapshot(session=sid))
+            if path == "/api/v1/metrics/stream" and method == "GET":
+                return self._metrics_stream(url)
+            if path == "/api/v1/trace" and method == "GET":
+                return self._trace(url)
+            if path == "/api/v1/profile" and method == "POST":
+                return self._profile()
+            if path == "/api/v1/schedulerconfiguration":
+                if method == "GET":
+                    return self._json(200, di.scheduler_service.get_config())
+                if method == "POST":
+                    return self._apply_scheduler_config()
+            elif path == "/api/v1/reset" and method == "PUT":
+                di.reset_service.reset()
+                return self._json(202)
+            elif path == "/api/v1/export" and method == "GET":
+                opts = SnapshotOptions(
+                    ignore_err="ignoreErr" in parse_qs(url.query))
+                return self._json(200, di.snapshot_service.snap(opts))
+            elif path == "/api/v1/import" and method == "POST":
+                opts = SnapshotOptions(
+                    ignore_err="ignoreErr" in parse_qs(url.query),
+                    ignore_scheduler_configuration="ignoreSchedulerConfiguration"
+                    in parse_qs(url.query),
+                )
+                di.snapshot_service.load(self._body() or {}, opts)
+                return self._json(200)
+            elif path == "/api/v1/listwatchresources" and method == "GET":
+                return self._list_watch(url)
+            elif path.startswith("/api/v1/extender/") and method == "POST":
+                return self._extender(path)
+            elif path == "/api/v1/scenarios" or path.startswith("/api/v1/scenarios/"):
+                return self._scenarios(method, path)
+            else:
+                m = re.fullmatch(r"/api/v1/([a-z0-9-]+)(?:/([^/]+))?(?:/([^/]+))?", path)
+                if m and m.group(1) in di.store.resources:
+                    return self._resource_crud(method, m, url)
+            self._json(404, {"message": f"route not found: {method} {path}"})
+
+        # ------------------------------------------------ sessions api
+
+        def _sessions_collection(self, method: str):
+            """GET /api/v1/sessions (list + shared-shell stats) / POST
+            (create; body {"id": ...} optional — a fresh id is minted
+            when absent)."""
+            if method == "GET":
+                return self._json(200, {"items": manager.list_sessions(),
+                                        **manager.stats()})
+            if method == "POST":
+                body = self._body() or {}
+                sess = manager.create(body.get("id") or None)
+                return self._json(201, sess.info())
+            return self._json(405, {"message": "method not allowed"})
+
+        def _sessions_item(self, method: str, sid: str):
+            """GET /api/v1/sessions/<id> / DELETE (clean eviction through
+            the session's shutdown path; the default session is pinned)."""
+            if method == "GET":
+                return self._json(200, manager.get(sid, touch=False).info())
+            if method == "DELETE":
+                manager.delete(sid)
+                return self._json(200)
+            return self._json(405, {"message": "method not allowed"})
+
+        def _session_filter(self, url) -> str | None:
+            """The session an observability read is scoped to: pinned by
+            the /api/v1/sessions/<id>/ prefix, else ?session= on the
+            bare path (None -> aggregate view)."""
+            if self.routed_sid is not None:
+                return self.routed_sid
+            params = parse_qs(url.query)
+            return params.get("session", [None])[0]
+
         # --------------------------------------------------- handlers
 
         def _apply_scheduler_config(self):
             body = self._body() or {}
             # only Profiles and Extenders are honored
             # (reference: handler/schedulerconfig.go:41-63)
-            cfg = di.scheduler_service.get_config()
+            cfg = self.di.scheduler_service.get_config()
             cfg["profiles"] = body.get("profiles") or []
             cfg["extenders"] = body.get("extenders") or []
-            di.scheduler_service.restart_scheduler(cfg)
+            self.di.scheduler_service.restart_scheduler(cfg)
             self._json(202)
 
         def _list_watch(self, url):
@@ -219,11 +322,17 @@ def _make_handler(di: DIContainer):
                 self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
 
             stream = StreamWriter(write_chunk, self.wfile.flush)
+            # server shutdown and session eviction both fire this stop,
+            # so the watch ends promptly instead of pumping a dead store
             stop = threading.Event()
+            server.streams.register(stop)
+            self.sess.streams.register(stop)
             try:
-                di.watcher_service.list_watch(stream, lrv, stop)
+                self.di.watcher_service.list_watch(stream, lrv, stop)
             finally:
                 stop.set()
+                server.streams.unregister(stop)
+                self.sess.streams.unregister(stop)
                 try:
                     self.wfile.write(b"0\r\n\r\n")
                 except OSError:
@@ -234,7 +343,7 @@ def _make_handler(di: DIContainer):
             if not m:
                 return self._json(404, {"message": "unknown extender route"})
             verb, idx = m.group(1), int(m.group(2))
-            svc = di.scheduler_service.extender_service
+            svc = self.di.scheduler_service.extender_service
             if svc is None:
                 return self._json(400, {"message": "no extenders configured"})
             try:
@@ -280,23 +389,33 @@ def _make_handler(di: DIContainer):
 
         def _health(self, path: str):
             """GET /healthz (liveness: the HTTP server answers) and
-            /readyz (readiness: the scheduling loop thread is running, so
-            submitted pods will actually be scheduled — 503 until
-            then)."""
+            /readyz (readiness: the session's scheduling loop thread is
+            running, so submitted pods will actually be scheduled — 503
+            until then).  readyz also surfaces the LAST loop crash
+            (di.py: the loop survives engine exceptions, but a wedged
+            loop must be observable) and the live session count."""
             if path == "/healthz":
                 return self._json(200, {"status": "ok"})
-            loop = di.scheduling_loop
+            loop = self.di.scheduling_loop
             t = getattr(loop, "_thread", None)
+            body = {"sessions": len(manager.list_sessions())}
+            if loop.last_crash is not None:
+                body["lastCrash"] = {k: loop.last_crash[k]
+                                     for k in ("time", "error")}
+                body["crashes"] = True
             if t is not None and t.is_alive():
-                return self._json(200, {"status": "ready"})
+                return self._json(200, {"status": "ready", **body})
             return self._json(503, {"status": "not ready",
-                                    "message": "scheduling loop not running"})
+                                    "message": "scheduling loop not running",
+                                    **body})
 
         def _trace(self, url):
-            """GET /api/v1/trace?limit=N — the recorded span tree as
-            chrome://tracing / Perfetto JSON (trace-event format; load
-            the response body in https://ui.perfetto.dev — the
-            docs/metrics.md walkthrough reads a pipelined wave)."""
+            """GET /api/v1/trace?limit=N&session=S — the recorded span
+            tree as chrome://tracing / Perfetto JSON (trace-event format;
+            load the response body in https://ui.perfetto.dev — the
+            docs/metrics.md walkthrough reads a pipelined wave).
+            session= (or the /api/v1/sessions/<id>/trace alias) keeps
+            only spans recorded under that session's scope."""
             from ..utils.tracing import TRACER
 
             params = parse_qs(url.query)
@@ -308,13 +427,16 @@ def _make_handler(di: DIContainer):
                 except ValueError:
                     return self._json(400, {"reason": "BadRequest",
                                             "message": f"bad limit {v!r}"})
-            return self._json(200, TRACER.perfetto(limit=limit))
+            return self._json(200, TRACER.perfetto(
+                limit=limit, session=self._session_filter(url)))
 
         def _metrics_stream(self, url):
             """GET /api/v1/metrics/stream?interval=S&count=N — Server-Sent
             Events: one `data: <snapshot JSON>` event per interval (the
-            same shape as /api/v1/metrics), until the client disconnects
-            or `count` events were sent (count=0: unbounded)."""
+            same shape as /api/v1/metrics), until the client disconnects,
+            `count` events were sent (count=0: unbounded), or the server
+            (or this stream's session) shuts down — the inter-event wait
+            rides a stop event, never a bare sleep."""
             from ..utils.tracing import TRACER
 
             params = parse_qs(url.query)
@@ -325,6 +447,7 @@ def _make_handler(di: DIContainer):
                 return self._json(400, {"reason": "BadRequest",
                                         "message": "bad interval/count"})
             interval = min(max(interval, 0.05), 3600.0)
+            sid = self._session_filter(url)
             self.send_response(200)
             self._cors()
             self.send_header("Content-Type", "text/event-stream")
@@ -335,19 +458,26 @@ def _make_handler(di: DIContainer):
             def write_chunk(data: bytes):
                 self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
 
+            stop = threading.Event()
+            server.streams.register(stop)
+            self.sess.streams.register(stop)
             sent = 0
             try:
                 while count <= 0 or sent < count:
-                    payload = json.dumps(TRACER.snapshot())
+                    payload = json.dumps(TRACER.snapshot(session=sid))
                     write_chunk(f"data: {payload}\n\n".encode())
                     self.wfile.flush()
                     sent += 1
                     if count > 0 and sent >= count:
                         break
-                    time.sleep(interval)
+                    if stop.wait(interval):
+                        break  # server shutdown / session eviction
                 self.wfile.write(b"0\r\n\r\n")
             except OSError:
                 pass  # client went away mid-stream
+            finally:
+                server.streams.unregister(stop)
+                self.sess.streams.unregister(stop)
 
         def _index(self):
             """Serve the web UI (the reference runs a separate Nuxt app on
@@ -380,7 +510,7 @@ def _make_handler(di: DIContainer):
         def _scenarios(self, method: str, path: str):
             """KEP-140 scenario API (the Scenario CRD surface; the
             reference's CRD is scaffold-only, scenario_types.go:27-64)."""
-            svc = di.scenario_service
+            svc = self.di.scenario_service
             name = path[len("/api/v1/scenarios/"):] if path != "/api/v1/scenarios" else ""
             try:
                 if method == "GET" and not name:
@@ -399,6 +529,7 @@ def _make_handler(di: DIContainer):
             return self._json(405, {"message": "method not allowed"})
 
         def _resource_crud(self, method: str, m, url):
+            di = self.di
             resource = m.group(1)
             _, namespaced = di.store.resources[resource]
             g2, g3 = m.group(2), m.group(3)
@@ -410,7 +541,15 @@ def _make_handler(di: DIContainer):
             if method == "POST" and g2 is None:
                 return self._json(201, di.store.create(resource, self._body() or {}))
             if namespaced and g3 is None and g2 is not None and method != "GET":
-                pass  # fallthrough: namespaced updates need ns+name
+                # a namespaced PUT/DELETE with only a name used to fall
+                # through and act cluster-scoped (deleting nothing /
+                # updating whatever namespace the body claimed) — reject
+                # it loudly instead
+                return self._json(400, {
+                    "reason": "BadRequest",
+                    "message": f"{resource} is namespaced: {method} needs "
+                               f"/api/v1/{resource}/<namespace>/<name> "
+                               f"(got only {g2!r})"})
             ns, name = (g2, g3) if (namespaced and g3) else (None, g2)
             if name is None:
                 return self._json(404, {"message": "name required"})

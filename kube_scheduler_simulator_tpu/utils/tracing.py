@@ -118,7 +118,24 @@ _HELP: dict[str, str] = {
         "(KSS_TPU_DEVICE_RESULT_BUDGET_MB bounds the bytes behind them).",
     "device_chunks_spilled_total":
         "Device-resident replay chunks spilled to host by the retention "
-        "budget's background LRU writer.",
+        "budget's background LRU writer (session label: the session whose "
+        "per-session share of KSS_TPU_DEVICE_RESULT_BUDGET_MB was "
+        "exceeded).",
+    "scan_compile_cache_total":
+        "Jitted-scan compile cache lookups by result: miss = a fresh "
+        "jax.jit build (first wave at a new workload shape), hit = a "
+        "process-level cached executable reused — across sessions, the "
+        "multi-session serving win (docs/metrics.md).",
+    "sessions_active":
+        "Simulation sessions currently live in the SessionManager "
+        "(including the default session).",
+    "sessions_created_total": "Simulation sessions created.",
+    "sessions_evicted_total":
+        "Simulation sessions torn down, by reason (explicit DELETE, "
+        "idle TTL, LRU capacity eviction, server shutdown).",
+    "scheduling_loop_crashes_total":
+        "Scheduling-loop waves that raised (the loop stays alive; the "
+        "last crash is surfaced on /readyz).",
 }
 
 _NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -203,6 +220,41 @@ class Tracer:
         self._ids = itertools.count(1)
         self._tls = threading.local()
         self._tids: dict[int, tuple[int, str]] = {}  # ident -> (tid, name)
+        # per-session views (multi-session serving, server/sessions.py):
+        # while a session scope is active on the recording thread, spans
+        # gain a session attr, labeled counters/histograms gain a
+        # session label, and plain counters/span aggregates are ALSO
+        # tallied here so /api/v1/metrics?session= can answer without
+        # touching the aggregate families
+        self._scounters: dict[str, dict[str, float]] = {}
+        self._sagg: dict[str, dict[str, dict]] = {}
+
+    # ---------------------------------------------------------- sessions
+
+    def current_session(self) -> str | None:
+        """The session id attached to metrics recorded on this thread
+        (None outside any session scope — direct engine use, tests)."""
+        st = getattr(self._tls, "sessions", None)
+        return st[-1] if st else None
+
+    @contextmanager
+    def session_scope(self, session: str | None):
+        """Attribute everything recorded on this thread to `session`:
+        spans carry a session attr, inc()/observe() fold a session
+        label in, count()/span aggregates are mirrored into the
+        per-session view.  None is a no-op scope (the sessionless
+        paths stay byte-identical)."""
+        if session is None:
+            yield
+            return
+        st = getattr(self._tls, "sessions", None)
+        if st is None:
+            st = self._tls.sessions = []
+        st.append(str(session))
+        try:
+            yield
+        finally:
+            st.pop()
 
     # ------------------------------------------------------------- spans
 
@@ -236,6 +288,9 @@ class Tracer:
                   parent if parent is not None else (st[-1] if st else None),
                   name)
         st.append(sp.id)
+        session = self.current_session()
+        if session is not None and "session" not in attrs:
+            attrs["session"] = session
         t0 = time.perf_counter()
         try:
             yield sp
@@ -257,12 +312,23 @@ class Tracer:
                 a["count"] += 1
                 a["total_seconds"] += dt
                 a["max_seconds"] = max(a["max_seconds"], dt)
+                if session is not None:
+                    a = self._sagg.setdefault(session, {}).setdefault(
+                        name,
+                        {"count": 0, "total_seconds": 0.0, "max_seconds": 0.0})
+                    a["count"] += 1
+                    a["total_seconds"] += dt
+                    a["max_seconds"] = max(a["max_seconds"], dt)
 
     # ---------------------------------------------------------- counters
 
     def count(self, name: str, n: float = 1) -> None:
+        session = self.current_session()
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
+            if session is not None:
+                sc = self._scounters.setdefault(session, {})
+                sc[name] = sc.get(name, 0) + n
 
     def gauge(self, name: str, value: float) -> None:
         """Set a gauge to an absolute value (unlike count(), which
@@ -272,7 +338,11 @@ class Tracer:
 
     def inc(self, name: str, n: float = 1, **labels) -> None:
         """Labeled counter increment; identical label sets merge
-        regardless of keyword order."""
+        regardless of keyword order.  Under an active session scope a
+        session label is folded in (unless the caller set one)."""
+        session = self.current_session()
+        if session is not None and "session" not in labels:
+            labels["session"] = session
         key = tuple(sorted((k, str(v)) for k, v in labels.items()))
         with self._lock:
             series = self._lcounters.setdefault(name, {})
@@ -287,6 +357,9 @@ class Tracer:
         exponential ladder."""
         if n <= 0:
             return
+        session = self.current_session()
+        if session is not None and "session" not in labels:
+            labels["session"] = session
         key = tuple(sorted((k, str(v)) for k, v in labels.items()))
         with self._lock:
             bounds = self._hist_bounds.get(name)
@@ -322,10 +395,49 @@ class Tracer:
             }
             return {"spans": spans, "counters": dict(self._counters)}
 
-    def snapshot(self) -> dict:
+    def snapshot(self, session: str | None = None) -> dict:
         """Full metrics snapshot: summary() plus labeled counters and
         histogram series — what /api/v1/metrics, the SSE stream and the
-        bench artifact emit."""
+        bench artifact emit.  With session=<id>, every family is
+        filtered to that session's view: spans/counters come from the
+        per-session tallies, labeled counters and histograms keep only
+        series whose session label matches (docs/metrics.md)."""
+        if session is not None:
+            skey = ("session", str(session))
+            with self._lock:
+                sagg = {
+                    k: {**v,
+                        "avg_seconds": v["total_seconds"] / max(v["count"], 1)}
+                    for k, v in self._sagg.get(session, {}).items()
+                }
+                out = {
+                    "session": str(session),
+                    "spans": sagg,
+                    "counters": dict(self._scounters.get(session, {})),
+                    "time": time.time(),
+                    "gauges": {},
+                    "labeled_counters": {
+                        name: [{"labels": dict(key), "value": v}
+                               for key, v in sorted(series.items())
+                               if skey in key]
+                        for name, series in sorted(self._lcounters.items())
+                        if any(skey in key for key in series)
+                    },
+                    "histograms": {
+                        name: {
+                            "buckets": list(self._hist_bounds[name]),
+                            "series": [
+                                {"labels": dict(key), "counts": list(h.counts),
+                                 "sum": round(h.sum, 9), "count": h.count}
+                                for key, h in sorted(series.items())
+                                if skey in key
+                            ],
+                        }
+                        for name, series in sorted(self._hists.items())
+                        if any(skey in key for key in series)
+                    },
+                }
+            return out
         out = self.summary()
         with self._lock:
             out["time"] = time.time()
@@ -383,16 +495,20 @@ class Tracer:
             out.append(f"# TYPE {m} {mtype}")
             return m
 
-        for name, v in sorted(counters.items()):
+        # one family per counter NAME: a name incremented both plain
+        # (sessionless paths) and labeled (session scopes fold a session
+        # label in) must emit ONE # HELP/# TYPE block — the unlabeled
+        # sample first, then the labeled series (duplicate TYPE lines
+        # would fail validate_exposition)
+        for name in sorted(set(counters) | set(lcounters)):
             m = family(name, "counter")
-            out.append(f"{m} {_fmt_float(v)}")
+            if name in counters:
+                out.append(f"{m} {_fmt_float(counters[name])}")
+            for key, v in sorted(lcounters.get(name, {}).items()):
+                out.append(f"{m}{self._render_labels(key)} {_fmt_float(v)}")
         for name, v in sorted(gauges.items()):
             m = family(name, "gauge")
             out.append(f"{m} {_fmt_float(v)}")
-        for name, series in sorted(lcounters.items()):
-            m = family(name, "counter")
-            for key, v in sorted(series.items()):
-                out.append(f"{m}{self._render_labels(key)} {_fmt_float(v)}")
         for name, (bounds, series) in sorted(hists.items()):
             m = family(name, "histogram")
             for key, (bcounts, hsum, hcount) in sorted(series.items()):
@@ -417,7 +533,8 @@ class Tracer:
 
     # --------------------------------------------------------- perfetto
 
-    def perfetto(self, limit: int | None = None) -> dict:
+    def perfetto(self, limit: int | None = None,
+                 session: str | None = None) -> dict:
         """chrome://tracing / Perfetto JSON of the recorded span tree.
 
         Complete events ("ph": "X") on per-thread tracks; ts/dur in
@@ -429,6 +546,12 @@ class Tracer:
         with self._lock:
             evs = list(self._events)
             tids = dict(self._tids)
+        if session is not None:
+            # ?session= filtering (docs/metrics.md): only spans recorded
+            # under that session's scope — filtered BEFORE the limit cut
+            # so a busy neighbor can't push this session's spans out of
+            # the window
+            evs = [ev for ev in evs if ev.get("session") == str(session)]
         if limit is not None:
             evs = evs[-limit:] if limit > 0 else []  # evs[-0:] is ALL
         pid = os.getpid()
@@ -461,6 +584,8 @@ class Tracer:
             self._lcounters.clear()
             self._hists.clear()
             self._hist_bounds.clear()
+            self._scounters.clear()
+            self._sagg.clear()
 
     # -------------------------------------------------------- XLA profile
 

@@ -33,10 +33,23 @@ const API = {
   submitScenario: (s) => api("POST", "/api/v1/scenarios", s),
   metrics: () => api("GET", "/api/v1/metrics"),
   // flight-recorder surface (docs/metrics.md): the full snapshot
-  // (histograms + labeled counters) and the Perfetto span-tree export
-  getMetrics: () => API.metrics(),
-  getTrace: (limit) =>
-    api("GET", "/api/v1/trace" + (limit ? "?limit=" + limit : "")),
+  // (histograms + labeled counters) and the Perfetto span-tree export;
+  // pass a session id to filter either view to one session
+  getMetrics: (session) =>
+    api("GET", "/api/v1/metrics" + (session ? "?session=" + session : "")),
+  getTrace: (limit, session) =>
+    api("GET", "/api/v1/trace" +
+        (limit || session ? "?" : "") +
+        (limit ? "limit=" + limit : "") +
+        (limit && session ? "&" : "") +
+        (session ? "session=" + session : "")),
+  // multi-session serving (docs/api.md): CRUD + per-session routing —
+  // sessionPath("a", "pods") -> "/api/v1/sessions/a/pods"
+  sessions: () => api("GET", "/api/v1/sessions"),
+  createSession: (id) =>
+    api("POST", "/api/v1/sessions", id ? { id } : {}),
+  deleteSession: (id) => api("DELETE", "/api/v1/sessions/" + id),
+  sessionPath: (id, sub) => "/api/v1/sessions/" + id + "/" + sub,
 };
 
 // ---- watch stream (web/api/v1/watcher.ts analogue: fetch ReadableStream

@@ -359,9 +359,10 @@ def test_scan_cache_lru_alternating_shapes(monkeypatch):
     cfg = PluginSetConfig(enabled=["NodeResourcesFit"])
     cw = compile_workload(nodes, pods, cfg)
 
-    monkeypatch.setattr(replay_mod, "_SCAN_CACHE_MAX", 2)
-    saved = dict(replay_mod._SCAN_CACHE)
-    replay_mod._SCAN_CACHE.clear()
+    cache = replay_mod._SCAN_CACHE
+    monkeypatch.setattr(cache, "max_entries", 2)
+    saved = dict(cache._entries)
+    cache._entries.clear()
     try:
         from kube_scheduler_simulator_tpu.framework.replay import _scan_for
 
@@ -374,8 +375,8 @@ def test_scan_cache_lru_alternating_shapes(monkeypatch):
         assert _scan_for(cw, chunk=4) is c
         assert _scan_for(cw, chunk=3) is not b, "B was the LRU victim"
     finally:
-        replay_mod._SCAN_CACHE.clear()
-        replay_mod._SCAN_CACHE.update(saved)
+        cache._entries.clear()
+        cache._entries.update(saved)
 
 
 def test_scan_cache_interleave_beyond_capacity(monkeypatch):
@@ -388,14 +389,15 @@ def test_scan_cache_interleave_beyond_capacity(monkeypatch):
     cfg = PluginSetConfig(enabled=["NodeResourcesFit"])
     cw = compile_workload(nodes, pods, cfg)
 
-    monkeypatch.setattr(replay_mod, "_SCAN_CACHE_MAX", 3)
-    saved = dict(replay_mod._SCAN_CACHE)
-    replay_mod._SCAN_CACHE.clear()
+    cache = replay_mod._SCAN_CACHE
+    monkeypatch.setattr(cache, "max_entries", 3)
+    saved = dict(cache._entries)
+    cache._entries.clear()
     try:
         from kube_scheduler_simulator_tpu.framework.replay import _scan_for
 
         hot = [_scan_for(cw, chunk=2), _scan_for(cw, chunk=3)]
-        for cold_chunk in (4, 5, 6, 7):  # _SCAN_CACHE_MAX+1 shapes total
+        for cold_chunk in (4, 5, 6, 7):  # max_entries+1 shapes total
             # touch the hot pair, then one cold shape — the cold shapes
             # must evict each other, never the just-touched pair
             assert _scan_for(cw, chunk=2) is hot[0]
@@ -404,5 +406,5 @@ def test_scan_cache_interleave_beyond_capacity(monkeypatch):
         assert _scan_for(cw, chunk=2) is hot[0]
         assert _scan_for(cw, chunk=3) is hot[1]
     finally:
-        replay_mod._SCAN_CACHE.clear()
-        replay_mod._SCAN_CACHE.update(saved)
+        cache._entries.clear()
+        cache._entries.update(saved)

@@ -55,6 +55,18 @@ HOT_PATH_ROOTS: list[tuple[str, str]] = [
     # already and covers _CompactChunks.materialize/_DeviceAttribution)
     ("store.native_decode", "decode_chunk_start"),
     ("store.native_decode", "decode_pod_fused"),
+    # multi-session serving (PR 11): the session registry sits on every
+    # routed request, concurrent with all sessions' live waves — lookup,
+    # listing and the shared-shell stats must stay loop-free and
+    # host-sync-free (the lock rules additionally watch the registry
+    # lock package-wide: no engine wave, deep copy or blocking call may
+    # run under SessionManager._mu)
+    ("server.sessions", "SessionManager.get"),
+    ("server.sessions", "SessionManager.list_sessions"),
+    ("server.sessions", "SessionManager.stats"),
+    ("server.sessions", "SimulationSession.touch"),
+    ("server.sessions", "SimulationSession.register_stream"),
+    ("server.sessions", "SimulationSession.unregister_stream"),
 ]
 
 BIG_ITERABLES = {"pending", "pods", "nodes"}
