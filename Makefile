@@ -9,8 +9,8 @@ PY ?= python
 
 .PHONY: codec native-asan native-tsan test test-asan test-tsan analyze \
         bench bench-check bench-gang bench-serve bench-multichip smoke \
-        clean parity-fullscale parity-fullscale-device multichip-scaling \
-        host-probe tpu-watch
+        chaos clean parity-fullscale parity-fullscale-device \
+        multichip-scaling host-probe tpu-watch
 
 # measurement artifacts (committed under docs/bench/; see BASELINE.md)
 parity-fullscale:
@@ -106,6 +106,17 @@ bench-serve:
 	    assert cc['hit_rate'] >= cc['floor'], (cc, 'hit rate under (K-1)/K'); \
 	    print('bench-serve: %d sessions, warm aggregate %.0f cycles/s, p99 %.0f, cache hit rate %.2f (floor %.2f)' \
 	        % (s['sessions'], s['warm']['aggregate_cycles_per_sec'], s['warm']['p99_session_cycles_per_sec'], cc['hit_rate'], cc['floor']))"
+
+# chaos gate (docs/fault-injection.md): concurrent multi-session waves
+# under seeded fault plans at every seam, asserting completion via
+# retry/degradation, bit-identical annotations vs the fault-free run,
+# gang atomicity, per-session isolation, and no lock-order cycles under
+# the runtime witness.  Deterministic: a failure prints the seed and
+# the exact reproducing command.  Also runs as the slow-marked tier-2
+# suite tests/test_chaos.py, and a quick verdict rides every bench
+# round (extra.chaos; bench-check refuses rounds whose chaos failed).
+chaos:
+	KSS_TPU_LOCK_WITNESS=1 JAX_PLATFORMS=cpu $(PY) -m tools.chaos --seeds 3
 
 smoke:
 	$(PY) bench.py --smoke

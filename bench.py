@@ -1333,6 +1333,15 @@ def _run(args):
         extra["analysis"] = analysis_verdict()
     except Exception as e:  # never fail a bench run over the analyzer
         extra["analysis"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # the chaos verdict rides every round too (docs/fault-injection.md):
+    # one quick seeded fault-plan run proving waves still complete via
+    # retry/degradation with bit-identical results — bench-check refuses
+    # rounds whose chaos run failed
+    try:
+        from tools.chaos import chaos_verdict
+        extra["chaos"] = chaos_verdict(seeds=1, quick=True)
+    except Exception as e:  # never fail a bench run over the harness
+        extra["chaos"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     # claim stdout before emitting the one JSON line: if the hang
     # watchdog fired mid-run (a wedged device op that later RETURNED

@@ -189,6 +189,23 @@ def main(argv: list[str]) -> int:
         bad = prev_p.name if prev is None else new_p.name
         print(f"bench-check: no bench JSON line found in {bad}")
         return 2
+    chaos = (new.get("extra") or {}).get("chaos") or {}
+    if chaos and chaos.get("ok") is False:
+        print(f"bench-check: REFUSING to compare — {new_p.name}'s chaos "
+              f"verdict failed (seeds {chaos.get('seeds')}): waves no "
+              "longer survive injected faults with bit-identical results "
+              "(run `make chaos` to reproduce with the printed seed)")
+        for line in (chaos.get("failures") or [])[:10]:
+            print(f"  {line}")
+        return 2
+    if chaos.get("error"):
+        # the harness itself died (import breakage, internal error):
+        # that is a FAILED chaos run, not a skippable metric — a gate
+        # that goes silently vacuous would defeat its purpose
+        print(f"bench-check: REFUSING to compare — {new_p.name}'s chaos "
+              f"harness errored instead of running: {chaos['error']} "
+              "(run `make chaos`)")
+        return 2
     analysis = (new.get("extra") or {}).get("analysis") or {}
     if analysis.get("new_findings"):
         print(f"bench-check: REFUSING to compare — {new_p.name} was "

@@ -184,6 +184,33 @@ class _NoGang:
 
 _GANG_NONE = _NoGang()
 
+# the degradation ladder's rungs (docs/fault-injection.md): all three
+# are bit-identical parity gates (tests/test_device_resident.py), so
+# stepping down after a structural device fault is provably lossless —
+# it trades wall time (host fetch, eager decode) for survival
+_RESIDENCY_MODES = ("device_resident", "host_resident", "eager_decode")
+
+
+class _WaveAbort(Exception):
+    """Internal: a wave attempt failed mid-flight.  Carries the
+    UNCOMMITTED SUFFIX of the attempt's (filtered: exclude/gates/gang
+    prescreen already applied) pending list — everything before it
+    landed: binds stand, gang state is consistent at the commit
+    boundary — and the binds already counted, so the wave failure
+    protocol retries exactly the suffix and returns an accurate bound
+    total (docs/fault-injection.md).  The suffix is the filtered list
+    itself, not an index into the caller's pending: the attempt
+    filters before committing, so outer indices would misalign."""
+
+    def __init__(self, cause: BaseException, remaining: list,
+                 n_bound: int, stage: str):
+        super().__init__(f"wave aborted at {stage}: "
+                         f"{type(cause).__name__}: {cause}")
+        self.cause = cause
+        self.remaining = remaining
+        self.n_bound = n_bound
+        self.stage = stage
+
 
 class _WaveCommitter:
     """Chunk-pipelined commit consumer for a streaming wave.
@@ -324,6 +351,9 @@ class _WaveCommitter:
             w.seal()
         try:
             self._reflects.drain()
+        # abort() runs on a wave that ALREADY failed; the replay error
+        # is what surfaces — a secondary reflect error must not mask it
+        # kss-analyze: allow(swallowed-exception)
         except Exception:
             pass
 
@@ -512,6 +542,13 @@ class SchedulerEngine:
         # injectable for tests (forced-conflict soak asserts the backoff
         # schedule without waiting out real 100ms x 3^n sleeps)
         self._retry_sleep = time.sleep
+        # wave failure protocol (docs/fault-injection.md): the engine's
+        # own degradation-ladder level ON TOP of the env floor
+        # (KSS_TPU_HOST_RESIDENT/KSS_TPU_EAGER_DECODE) — 0 device,
+        # 1 host, 2 eager — and the consecutive-good-waves counter
+        # driving probe-based recovery back up the ladder
+        self._residency = 0
+        self._resid_ok_waves = 0
         # multi-session serving (server/sessions.py): the owning
         # session's id, or None for direct engine use.  schedule_pending
         # and the engine's worker threads enter this session's tracer
@@ -841,9 +878,166 @@ class SchedulerEngine:
                                n=n - bound, result="unschedulable")
         return bound, retry
 
+    # ------------------------------------------------ failure protocol
+
+    @staticmethod
+    def _env_int(name: str, default: int) -> int:
+        from ..utils.env import env_int
+
+        return env_int(name, default)
+
+    @staticmethod
+    def _env_residency_floor() -> int:
+        """The ladder level the environment pins as a floor: the engine
+        may degrade BELOW it but never recovers above it."""
+        if os.environ.get("KSS_TPU_EAGER_DECODE") == "1":
+            return 2
+        if os.environ.get("KSS_TPU_HOST_RESIDENT") == "1":
+            return 1
+        return 0
+
+    def _effective_residency(self) -> int:
+        return max(self._env_residency_floor(), self._residency)
+
+    def result_mode(self) -> str:
+        """The wave's current result-residency rung (device_resident /
+        host_resident / eager_decode) — surfaced per session on
+        /api/v1/sessions and /readyz (docs/fault-injection.md)."""
+        return _RESIDENCY_MODES[self._effective_residency()]
+
+    def _degrade(self, seam: str) -> bool:
+        """Step one rung down the ladder after a structural device
+        fault.  False when already at the bottom (eager decode has no
+        device dependency left to shed)."""
+        cur = self._effective_residency()
+        if cur >= len(_RESIDENCY_MODES) - 1:
+            return False
+        self._residency = cur + 1
+        self._resid_ok_waves = 0
+        TRACER.inc("wave_faults_total", seam=seam, action="degraded")
+        TRACER.inc("wave_degradations_total",
+                   **{"from": _RESIDENCY_MODES[cur],
+                      "to": _RESIDENCY_MODES[cur + 1]})
+        return True
+
+    def _wave_recovered_ok(self) -> None:
+        """Probe-based recovery: after KSS_TPU_DEGRADE_PROBE_WAVES
+        consecutive clean waves at a degraded rung, step back UP one
+        level (never above the env floor).  The next wave is the probe:
+        if it faults structurally again, _degrade steps straight back
+        down and the counter restarts."""
+        if self._residency <= 0:
+            return
+        floor = self._env_residency_floor()
+        cur = self._effective_residency()
+        if cur <= floor:
+            self._residency = 0  # env already enforces this rung
+            return
+        self._resid_ok_waves += 1
+        if self._resid_ok_waves < self._env_int(
+                "KSS_TPU_DEGRADE_PROBE_WAVES", 8):
+            return
+        self._resid_ok_waves = 0
+        new = max(cur - 1, floor)
+        self._residency = 0 if new <= floor else new
+        TRACER.inc("wave_degradations_total",
+                   **{"from": _RESIDENCY_MODES[cur],
+                      "to": _RESIDENCY_MODES[new]})
+
     def _profile_wave_run(self, pending: list[dict],
                           exclude: set[tuple[str, str]] | None = None
                           ) -> tuple[int, str | None]:
+        """The wave failure protocol (docs/fault-injection.md) around
+        _profile_wave_attempt: classify a mid-wave fault and
+
+          * transient  — retry the UNCOMMITTED SUFFIX with bounded
+            backoff (KSS_TPU_WAVE_MAX_RETRIES, default 3): committed
+            chunks stand (their binds/parks landed through the gang-cut
+            watermark, so gang atomicity holds at the boundary), the
+            suffix recompiles against current store state — the same
+            recompile-with-upstream-state mechanism the "rejected"
+            retry path already parity-proves — and bind order stays
+            deterministic;
+          * structural — step the residency ladder down one rung
+            (device -> host -> eager; all bit-identical parity gates)
+            and re-run, with probe-based recovery stepping back up
+            after consecutive clean waves;
+          * fatal      — surface immediately (interrupts, exhausted
+            bounded retries, quarantined compiles).
+
+        With no fault the attempt's result passes straight through —
+        the try block is the only overhead on the happy path."""
+        from ..utils.faults import classify_fault
+        from .replay import (CompileQuarantined, materialize_failure_streak,
+                             reset_materialize_failures)
+
+        if (self._effective_residency() == 0
+                and materialize_failure_streak(self.session)
+                >= self._env_int("KSS_TPU_MATERIALIZE_FAIL_LIMIT", 3)):
+            # repeated on-demand D2H failures are a structural device
+            # signal even though they surface on the READ path: step to
+            # host-resident fetch so new waves stop pinning chunks that
+            # cannot come back across.  The streak is per-session: a
+            # neighbor's flaky reads never degrade THIS engine
+            if self._degrade("replay.materialize"):
+                reset_materialize_failures(self.session)
+        bound = 0
+        retries_left = self._env_int("KSS_TPU_WAVE_MAX_RETRIES", 3)
+        delay = 0.02
+        while True:
+            try:
+                b, retry = self._profile_wave_attempt(pending, exclude)
+            except _WaveAbort as ab:
+                bound += ab.n_bound
+                pending = ab.remaining
+                cause = ab.cause
+                seam = getattr(cause, "seam", None) or ab.stage
+                if isinstance(cause, CompileQuarantined):
+                    # per-key containment already happened in the scan
+                    # cache; retrying here would only re-read the
+                    # quarantine — surface it to the caller/session
+                    raise cause
+                kind = classify_fault(cause)
+                if kind == "structural":
+                    if self._degrade(seam):
+                        continue
+                    TRACER.inc("wave_faults_total", seam=seam,
+                               action="aborted")
+                    raise cause
+                if kind == "transient" and retries_left > 0:
+                    # retry even with an EMPTY suffix: every pod already
+                    # committed, so the fault hit post-commit work (e.g.
+                    # a reflect drain — its records stay queued and land
+                    # on the next read/reflect); the empty re-attempt
+                    # settles immediately and the wave returns its bind
+                    # count instead of crashing a fully-committed wave
+                    retries_left -= 1
+                    TRACER.count("wave_retries_total")
+                    TRACER.inc("wave_faults_total", seam=seam,
+                               action="retried")
+                    self._retry_sleep(delay)
+                    delay = min(delay * 5, 1.0)
+                    continue
+                TRACER.inc("wave_faults_total", seam=seam, action="aborted")
+                raise cause
+            self._wave_recovered_ok()
+            return bound + b, retry
+
+    def _guarded_replay(self, stage: str, pending: list, fn):
+        """Run one replay under the failure protocol's classification:
+        nothing was committed yet on these paths (the sequential/
+        speculative commits happen in _finish_wave AFTER the replay
+        drains), so a fault retries the whole FILTERED pending list —
+        retrying the filtered list (not the caller's raw one) keeps
+        gate marks and gang-prescreen rejections single-shot."""
+        try:
+            return fn()
+        except BaseException as e:
+            raise _WaveAbort(e, pending, 0, stage) from e
+
+    def _profile_wave_attempt(self, pending: list[dict],
+                              exclude: set[tuple[str, str]] | None = None
+                              ) -> tuple[int, str | None]:
         """One wave over the given pending pods with the current
         plugin_config. Returns (#bound, retry reason or None).
 
@@ -953,14 +1147,20 @@ class SchedulerEngine:
                 # frozen state across the mesh's dp shards, commit the
                 # provably-non-interfering prefix — bit-identical to the
                 # scan (parallel/speculative.py; tests/test_speculative.py)
-                with TRACER.span("speculative_replay", pods=len(pending),
-                                 nodes=len(nodes)) as sp:
-                    rr, spec_stats = replay_speculative(
-                        cw, mesh, pods=pending,
-                        namespaces=self._list_shared("namespaces"))
-                    TRACER.count("speculative_rounds_total",
-                                 spec_stats["rounds"])
-                self._record_attribution(rr, sp.seconds)
+                def _spec_replay():
+                    with TRACER.span("speculative_replay",
+                                     pods=len(pending),
+                                     nodes=len(nodes)) as sp:
+                        rr, spec_stats = replay_speculative(
+                            cw, mesh, pods=pending,
+                            namespaces=self._list_shared("namespaces"))
+                        TRACER.count("speculative_rounds_total",
+                                     spec_stats["rounds"])
+                    return rr, sp.seconds
+
+                rr, spec_seconds = self._guarded_replay(
+                    "speculative_replay", pending, _spec_replay)
+                self._record_attribution(rr, spec_seconds)
                 if self._wave_lazy_ok():
                     from ..store.lazy import LazyWave
 
@@ -969,10 +1169,17 @@ class SchedulerEngine:
                         lazy_wave=LazyWave(rr, len(pending), sealed=True))
                 # rr's arrays are final host numpy here: decode through
                 # the pooled chunk decoder like the scan path, not one
-                # pod at a time on the commit thread
+                # pod at a time on the commit thread.  Guarded: nothing
+                # is committed yet, so a transient decode fault retries
+                # the wave instead of aborting the backlog
                 all_annotations = [None] * len(pending)
-                with TRACER.span("decode_stream", pods=len(pending)):
-                    decode_chunk_into(rr, 0, len(pending), all_annotations)
+
+                def _spec_decode():
+                    with TRACER.span("decode_stream", pods=len(pending)):
+                        decode_chunk_into(rr, 0, len(pending),
+                                          all_annotations)
+
+                self._guarded_replay("decode_stream", pending, _spec_decode)
                 return self._finish_wave(cw, rr, all_annotations, pending,
                                          exclude)
 
@@ -982,13 +1189,19 @@ class SchedulerEngine:
             # host-resident: the lifecycle loop consumes every pod's
             # annotations in order, so deferring the D2H would just move
             # the whole transfer out of the scan-overlap window
-            with TRACER.span("device_replay", pods=len(pending),
-                             nodes=len(nodes)) as sp:
-                rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                            mesh=mesh, unroll=self.unroll,
-                            device_resident=False)
+            def _lc_replay():
+                with TRACER.span("device_replay", pods=len(pending),
+                                 nodes=len(nodes)) as sp:
+                    rr = replay(
+                        cw, chunk=min(self.chunk, max(len(pending), 1)),
+                        mesh=mesh, unroll=self.unroll,
+                        device_resident=False)
+                return rr, sp.seconds
+
+            rr, replay_seconds = self._guarded_replay(
+                "device_replay", pending, _lc_replay)
             all_annotations = _LazyDecode(rr)
-            self._record_attribution(rr, sp.seconds)
+            self._record_attribution(rr, replay_seconds)
             return self._finish_wave(cw, rr, all_annotations, pending, exclude)
 
         if self._can_stream_commit():
@@ -1011,15 +1224,27 @@ class SchedulerEngine:
                     # Lazy waves keep results DEVICE-resident: on_chunk
                     # is a handoff, the commit consumes decision rows
                     # only, and the heavy tensors never cross in-wave
+                    # (unless the degradation ladder stepped to host)
                     committer.parent_span = sp.id
                     rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
                                 mesh=mesh, unroll=self.unroll,
                                 on_chunk=committer.on_chunk,
-                                device_resident=committer.lazy)
-            except BaseException:
+                                device_resident=(
+                                    committer.lazy
+                                    and self._effective_residency() == 0))
+            except BaseException as e:
+                # abort BEFORE reading the watermark: committed chunks
+                # stand (binds/parks through the last gang-cut), queued
+                # chunks drop — then hand the failure protocol the
+                # settled commit boundary so only the suffix retries
                 committer.abort()
-                raise
-            result = committer.finish()
+                raise _WaveAbort(e, pending[committer._upto:],
+                                 committer.n_bound, "replay_stream") from e
+            try:
+                result = committer.finish()
+            except BaseException as e:
+                raise _WaveAbort(e, pending[committer._upto:],
+                                 committer.n_bound, "commit_stream") from e
             self._record_attribution(rr, sp.seconds,
                                      att=committer.attribution())
             return result
@@ -1032,12 +1257,18 @@ class SchedulerEngine:
             # materializes D2H + decode (store/lazy.py)
             from ..store.lazy import LazyWave
 
-            with TRACER.span("replay_and_decode_stream", pods=len(pending),
-                             nodes=len(nodes)) as sp:
-                rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                            mesh=mesh, unroll=self.unroll,
-                            device_resident=True)
-            self._record_attribution(rr, sp.seconds)
+            def _lazy_replay():
+                with TRACER.span("replay_and_decode_stream",
+                                 pods=len(pending), nodes=len(nodes)) as sp:
+                    rr = replay(
+                        cw, chunk=min(self.chunk, max(len(pending), 1)),
+                        mesh=mesh, unroll=self.unroll,
+                        device_resident=self._effective_residency() == 0)
+                return rr, sp.seconds
+
+            rr, replay_seconds = self._guarded_replay(
+                "replay_stream", pending, _lazy_replay)
+            self._record_attribution(rr, replay_seconds)
             return self._finish_wave(
                 cw, rr, None, pending, exclude,
                 lazy_wave=LazyWave(rr, len(pending), sealed=True))
@@ -1046,13 +1277,20 @@ class SchedulerEngine:
         # host thread pool on the fallback ladder) as soon as its
         # transfer lands, overlapping the device's later chunks
         all_annotations = [None] * len(pending)
-        with TRACER.span("replay_and_decode_stream", pods=len(pending),
-                         nodes=len(nodes)) as sp:
-            rr = replay(cw, chunk=min(self.chunk, max(len(pending), 1)),
-                        mesh=mesh, unroll=self.unroll,
-                        on_chunk=lambda rr_, lo, hi: decode_chunk_into(
-                            rr_, lo, hi, all_annotations))
-        self._record_attribution(rr, sp.seconds)
+
+        def _eager_replay():
+            with TRACER.span("replay_and_decode_stream", pods=len(pending),
+                             nodes=len(nodes)) as sp:
+                rr = replay(
+                    cw, chunk=min(self.chunk, max(len(pending), 1)),
+                    mesh=mesh, unroll=self.unroll,
+                    on_chunk=lambda rr_, lo, hi: decode_chunk_into(
+                        rr_, lo, hi, all_annotations))
+            return rr, sp.seconds
+
+        rr, replay_seconds = self._guarded_replay(
+            "replay_stream", pending, _eager_replay)
+        self._record_attribution(rr, replay_seconds)
         return self._finish_wave(cw, rr, all_annotations, pending, exclude)
 
     def _wave_lazy_ok(self) -> bool:
@@ -1072,8 +1310,12 @@ class SchedulerEngine:
             e.g. the remote HTTP cluster client).
 
         The host-interleaved and custom-lifecycle paths decode per pod
-        regardless (their cycles consume annotations inline)."""
+        regardless (their cycles consume annotations inline).  The
+        degradation ladder's bottom rung (docs/fault-injection.md)
+        forces eager decode the same way the env baseline does."""
         if os.environ.get("KSS_TPU_EAGER_DECODE") == "1":
+            return False
+        if self._effective_residency() >= 2:
             return False
         if self._extenders_map():
             return False
@@ -1150,6 +1392,7 @@ class SchedulerEngine:
                         extension_point=point)
             TRACER.count("wave_attribution_seconds",
                          round(time.perf_counter() - t0, 6))
+        # kss-analyze: allow(swallowed-exception)
         except Exception:
             pass  # attribution is observability; waves never fail on it
 
@@ -1341,11 +1584,14 @@ class SchedulerEngine:
         for (ns, name), rec in list(self.gang_parked.items()):
             try:
                 pod = self.store.get("pods", name, ns, copy_object=False)
+            # a parked pod deleted from the store stops reserving capacity
+            # kss-analyze: allow(swallowed-exception)
             except NotFound:
                 continue
             except TypeError:  # store without the no-copy fast path
                 try:
                     pod = self.store.get("pods", name, ns)
+                # kss-analyze: allow(swallowed-exception) — as above
                 except NotFound:
                     continue
             out.append((pod, rec.node))
@@ -1738,6 +1984,8 @@ class SchedulerEngine:
         except Exception:
             try:
                 unreserve_all()
+            # best-effort cleanup on an already-failed waiter
+            # kss-analyze: allow(swallowed-exception)
             except Exception:
                 pass
         finally:
@@ -1748,6 +1996,9 @@ class SchedulerEngine:
                     self._mark_unschedulable(ns, name, fresh_node_count=True)
                 self.reflector.reflect(
                     ns, name, uid=(pod.get("metadata") or {}).get("uid"))
+            # the waiter thread must reach its result handoff; a reflect
+            # failure leaves the store record for the next reflect
+            # kss-analyze: allow(swallowed-exception)
             except Exception:
                 pass
             self.waiting_pods.pop((ns, name), None)
@@ -1827,6 +2078,8 @@ class SchedulerEngine:
             vm = v.get("metadata") or {}
             try:
                 self.store.delete("pods", vm.get("name", ""), vm.get("namespace") or "default")
+            # victim already gone: the preemption's goal state
+            # kss-analyze: allow(swallowed-exception)
             except NotFound:
                 pass
 
@@ -1925,6 +2178,9 @@ class SchedulerEngine:
                 plist = self.extender_service.handle(
                     "prioritize", idx, {"Pod": pod, "NodeNames": node_names}
                 )
+            # upstream ignores prioritize-extender errors (the scores
+            # just don't contribute)
+            # kss-analyze: allow(swallowed-exception)
             except Exception:
                 continue
             for entry in plist or []:
@@ -2225,7 +2481,12 @@ class SchedulerEngine:
             except Conflict:
                 return False, None  # re-fetch and retry under backoff
 
-        retry_with_exponential_backoff(attempt, sleep=self._retry_sleep)
+        # the reflector's stop event doubles as the engine's teardown
+        # interrupt: session eviction must not ride out a bind-conflict
+        # backoff (~36s) any more than a write-back one (utils/retry.py)
+        retry_with_exponential_backoff(
+            attempt, sleep=self._retry_sleep,
+            stop=getattr(self.reflector, "stop_event", None))
 
     @staticmethod
     def _bind_mutation(node_name: str):

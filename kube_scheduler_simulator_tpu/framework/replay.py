@@ -51,7 +51,54 @@ import numpy as np
 
 from .pipeline import build_step
 from ..state.compile import CompiledWorkload
+from ..utils.faults import fault_point
 from ..utils.tracing import TRACER
+
+
+class _FailStreak:
+    """PER-SESSION consecutive-failure counters for the on-demand
+    materialization path: any success resets the failing session's
+    streak.  The engine's wave failure protocol reads ITS session's
+    streak at wave start — a streak past KSS_TPU_MATERIALIZE_FAIL_LIMIT
+    is a structural device signal (repeated D2H failure), answered by
+    stepping that session's degradation ladder down to host-resident
+    fetch (docs/fault-injection.md).  Buckets key on the tracer session
+    scope active at the failing read (None = sessionless direct engine
+    use), so one tenant's flaky link never degrades a neighbor."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._n: dict = {}
+
+    def fail(self) -> int:
+        sid = TRACER.current_session()
+        with self._mu:
+            self._n[sid] = self._n.get(sid, 0) + 1
+            return self._n[sid]
+
+    def ok(self) -> None:
+        sid = TRACER.current_session()
+        with self._mu:
+            self._n.pop(sid, None)
+
+    def value(self, session=None) -> int:
+        with self._mu:
+            return self._n.get(session, 0)
+
+    def reset(self, session=None) -> None:
+        with self._mu:
+            self._n.pop(session, None)
+
+
+_MATERIALIZE_FAILS = _FailStreak()
+
+
+def materialize_failure_streak(session: str | None = None) -> int:
+    return _MATERIALIZE_FAILS.value(session)
+
+
+def reset_materialize_failures(session: str | None = None) -> None:
+    _MATERIALIZE_FAILS.reset(session)
 
 
 class _CompactChunks:
@@ -132,6 +179,7 @@ class _CompactChunks:
 
         try:
             t0 = time.perf_counter()
+            fault_point("replay.materialize")
             # the span IS with-managed — it rides a conditional context
             # manager (spans only on-demand reads, not background spills),
             # a form the static balance rule can't see through
@@ -142,11 +190,14 @@ class _CompactChunks:
             dt = time.perf_counter() - t0
         except BaseException:
             # transient fetch failure: clear the in-flight slot so the
-            # next reader retries instead of waiting forever
+            # next reader retries instead of waiting forever; the streak
+            # feeds the engine's structural-degradation check
+            _MATERIALIZE_FAILS.fail()
             with self._mu:
                 del self._inflight[ci]
             ev.set()
             raise
+        _MATERIALIZE_FAILS.ok()
         nbytes = sum(a.nbytes for a in fetched.values())
         with self._mu:
             for g in self.GROUPS:
@@ -307,6 +358,7 @@ class _DeviceResultBudget:
             # the spill thread adopts the owning session's scope so the
             # spill counter lands as device_chunks_spilled_total{session=}
             with TRACER.session_scope(session):
+                fault_point("replay.budget_spill")
                 cc.materialize(ci, spill=True)
         except Exception:
             # transient fetch failure: clear the in-flight mark and
@@ -849,6 +901,26 @@ def _slice_xs(xs: dict[str, Any], lo: int, hi: int, pad_to: int) -> dict[str, An
 # not on every replay() call.
 
 
+class CompileQuarantined(RuntimeError):
+    """A scan-cache key whose build failed repeatedly is quarantined:
+    callers get this immediately (fail-fast) instead of paying another
+    multi-second doomed compile — one bad workload shape must not
+    poison every session sharing the process with repeated build storms
+    (docs/fault-injection.md).  The quarantine expires after
+    KSS_TPU_COMPILE_QUARANTINE_S; a successful rebuild clears it."""
+
+    seam = "compile.build"
+
+    def __init__(self, message: str):
+        super().__init__(message)
+
+
+def _compile_quarantine_ttl() -> float:
+    from ..utils.env import env_float
+
+    return env_float("KSS_TPU_COMPILE_QUARANTINE_S", 300.0)
+
+
 class _ScanCacheRegistry:
     """Process-level LRU registry of jitted scan callables, keyed by
     workload shape (_workload_scan_key).  Concurrent sessions' waves hit
@@ -859,13 +931,25 @@ class _ScanCacheRegistry:
     compile-once guarantee `make bench-serve` measures as its
     (K-1)/K hit rate).  LRU semantics unchanged: pop-and-reinsert on
     hit, so two shapes alternating at capacity never evict each other's
-    still-hot compiles."""
+    still-hot compiles.
+
+    Build-failure containment: the first failure is treated as
+    transient (waiters retry and become builders — a wave-protocol
+    retry rebuilds); _QUARANTINE_AFTER consecutive failures of the SAME
+    key quarantine it for _compile_quarantine_ttl() seconds, during
+    which lookups raise CompileQuarantined without touching the
+    compiler.  Other keys — other sessions' shapes — are unaffected,
+    and a successful build clears the key's failure history."""
+
+    _QUARANTINE_AFTER = 2
 
     def __init__(self, max_entries: int = 64):
         self.max_entries = max_entries
         self._mu = threading.Lock()
         self._entries: OrderedDict = OrderedDict()
         self._building: dict = {}   # key -> threading.Event
+        # key -> [consecutive fails, quarantined-until monotonic, last err]
+        self._failed: dict = {}
         self.hits = 0
         self.misses = 0
 
@@ -874,6 +958,9 @@ class _ScanCacheRegistry:
             total = self.hits + self.misses
             return {"entries": len(self._entries), "hits": self.hits,
                     "misses": self.misses,
+                    "quarantined": sum(
+                        1 for f in self._failed.values()
+                        if f[1] > time.monotonic()),
                     "hit_rate": round(self.hits / total, 4) if total else None}
 
     def get_or_build(self, key, builder):
@@ -885,28 +972,51 @@ class _ScanCacheRegistry:
                     self.hits += 1
                     TRACER.inc("scan_compile_cache_total", result="hit")
                     return scan_jit
+                bad = self._failed.get(key)
+                if bad is not None and bad[1] > time.monotonic():
+                    TRACER.inc("scan_compile_cache_total",
+                               result="quarantined")
+                    quarantined_err = bad[2]
+                    break
                 ev = self._building.get(key)
                 if ev is None:
                     ev = self._building[key] = threading.Event()
+                    quarantined_err = None
                     self.misses += 1
                     TRACER.inc("scan_compile_cache_total", result="miss")
                     break
             # another thread is building this key: its executable is
             # seconds away — waiting IS the cross-session compile shave
             ev.wait()
+        if quarantined_err is not None:
+            raise CompileQuarantined(
+                "scan compile for this workload shape is quarantined "
+                f"after {self._QUARANTINE_AFTER} consecutive build "
+                f"failures (last: {quarantined_err}); other shapes are "
+                "unaffected")
         try:
             # the jax.jit wrapper builds OUTSIDE the lock (kss-analyze
             # device-under-lock; jit is lazy but build_step touches jnp)
+            fault_point("compile.build")
             scan_jit = builder()
-        except BaseException:
+        except BaseException as e:
             with self._mu:
                 del self._building[key]
+                bad = self._failed.get(key) or [0, 0.0, ""]
+                bad[0] += 1
+                bad[2] = f"{type(e).__name__}: {e}"[:200]
+                if bad[0] >= self._QUARANTINE_AFTER:
+                    bad[1] = time.monotonic() + _compile_quarantine_ttl()
+                    TRACER.inc("wave_faults_total", seam="compile.build",
+                               action="quarantined")
+                self._failed[key] = bad
             ev.set()    # waiters retry; they'll become builders
             raise
         with self._mu:
             while len(self._entries) >= self.max_entries:
                 self._entries.popitem(last=False)
             self._entries[key] = scan_jit
+            self._failed.pop(key, None)
             del self._building[key]
         ev.set()
         return scan_jit
@@ -1007,6 +1117,7 @@ def _fetch_chunk(out) -> dict[str, np.ndarray]:
     DEVICE layout (e.g. strides (1,10,5) for a [C,S,N] int8), and the
     native codec walks raw pointers assuming C order — a strided buffer
     silently decodes neighboring pods' values."""
+    fault_point("replay.decision_fetch")
     c = {f: np.ascontiguousarray(np.asarray(getattr(out, f)))
          for f in out._fields}
     c["_d2h_bytes"] = sum(a.nbytes for a in c.values())
@@ -1024,6 +1135,7 @@ def _fetch_decisions(out, att) -> dict[str, np.ndarray]:
     O(chunk x plugins x nodes) compact tensors, which stay live on
     device until a cold read materializes them (docs/wave-pipeline.md
     device-residency stage)."""
+    fault_point("replay.decision_fetch")
     c = {f: np.ascontiguousarray(np.asarray(getattr(out, f)))
          for f in _DECISION_FIELDS}
     nbytes = sum(a.nbytes for a in c.values())
@@ -1436,9 +1548,24 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
     futures: list = []
     heavy: list = []   # device-resident: the chunk's CompactOut (device refs)
     drained = 0
+    # fetches run on pool workers, which don't inherit the caller's
+    # thread-local tracer session scope — carry it across explicitly so
+    # session-scoped fault rules (and any session-labeled taps) see the
+    # owning session at the decision-fetch seam
+    wave_session = TRACER.current_session()
+
+    def fetch_decisions_scoped(out, att):
+        with TRACER.session_scope(wave_session):
+            return _fetch_decisions(out, att)
+
+    def fetch_chunk_scoped(out):
+        with TRACER.session_scope(wave_session):
+            return _fetch_chunk(out)
+
     with ThreadPoolExecutor(max_workers=3) as pool:
         for lo in range(0, p, chunk):
             hi = min(lo + chunk, p)
+            fault_point("replay.scan_dispatch")
             xs_chunk = _slice_xs(cw.xs, lo, hi, chunk)
             xs_chunk["is_pad"] = (jnp.arange(chunk) >= (hi - lo))
             carry, out = scan_jit(carry, xs_chunk)
@@ -1449,10 +1576,11 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
             if device_resident:
                 att_out = att_ctx.run(out, lo) if att_ctx is not None \
                     else None
-                futures.append(pool.submit(_fetch_decisions, out, att_out))
+                futures.append(pool.submit(fetch_decisions_scoped, out,
+                                           att_out))
                 heavy.append(out)
             else:
-                futures.append(pool.submit(_fetch_chunk, out))
+                futures.append(pool.submit(fetch_chunk_scoped, out))
                 heavy.append(None)
             del out
             while len(futures) - drained > _MAX_INFLIGHT:

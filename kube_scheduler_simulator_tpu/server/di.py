@@ -184,6 +184,11 @@ class DIContainer:
         return self.recorder
 
     def shutdown(self):
+        # interrupt any in-flight write-back/bind backoff FIRST: the
+        # retry schedule sleeps up to ~36s and eviction must not ride it
+        # out (utils/retry.py stop; the aborted write surfaces as
+        # RetryAborted to its wave, which teardown tolerates)
+        self.reflector.stop_event.set()
         self.scheduling_loop.stop()
         if self.syncer:
             self.syncer.stop()

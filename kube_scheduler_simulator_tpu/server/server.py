@@ -398,7 +398,15 @@ def _make_handler(server: SimulatorServer):
                 return self._json(200, {"status": "ok"})
             loop = self.di.scheduling_loop
             t = getattr(loop, "_thread", None)
-            body = {"sessions": len(manager.list_sessions())}
+            sessions = manager.list_sessions()
+            body = {"sessions": len(sessions)}
+            # degradation-ladder status (docs/fault-injection.md):
+            # sessions running below their configured residency rung
+            # after a structural fault still serve bit-identical
+            # results, but an operator watching /readyz should see them
+            degraded = [s["id"] for s in sessions if s.get("degraded")]
+            if degraded:
+                body["degradedSessions"] = degraded
             if loop.last_crash is not None:
                 body["lastCrash"] = {k: loop.last_crash[k]
                                      for k in ("time", "error")}

@@ -46,6 +46,8 @@ import uuid
 
 from ..cluster.store import ApiError, NotFound
 from ..config.config import SimulatorConfiguration
+from ..utils.env import env_int as _env_int
+from ..utils.faults import fault_point
 from ..utils.tracing import TRACER
 from .di import DIContainer
 
@@ -67,16 +69,6 @@ class SessionExists(ApiError):
 class SessionCapacity(ApiError):
     status = 429
     reason = "TooManySessions"
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return int(float(raw))
-    except ValueError:
-        return default
 
 
 class StreamRegistry:
@@ -156,6 +148,7 @@ class SimulationSession:
         t = getattr(loop, "_thread", None)
         pods, _ = self.di.store.list("pods", copy_objects=False)
         nodes, _ = self.di.store.list("nodes", copy_objects=False)
+        engine = self.di.engine
         return {
             "id": self.id,
             "createdAt": self.created_at,
@@ -164,6 +157,16 @@ class SimulationSession:
             "pods": len(pods),
             "nodes": len(nodes),
             "schedulerRunning": bool(t is not None and t.is_alive()),
+            # degradation-ladder status (docs/fault-injection.md): the
+            # wave's current result-residency mode, and whether the
+            # engine stepped DOWN from its configured rung after a
+            # structural fault (a degraded session still serves
+            # bit-identical results — the rungs are parity gates — it
+            # just pays host fetch / eager decode until the probe
+            # recovery steps back up)
+            "resultMode": (engine.result_mode()
+                           if hasattr(engine, "result_mode") else None),
+            "degraded": bool(getattr(engine, "_residency", 0)),
             "lastCrash": (loop.last_crash or None) and {
                 k: loop.last_crash[k] for k in ("time", "error")
             },
@@ -303,6 +306,10 @@ class SessionManager:
         if victim is not None:
             self._teardown(victim, reason="capacity")
         try:
+            # chaos seam: a construction failure must release the
+            # reservation (the finally below) and leave the registry
+            # admitting — tests/test_faults.py pins create-after-fault
+            fault_point("session.create")
             sess = SimulationSession(sid, self.cfg,
                                      start_scheduler=self.start_scheduler)
         finally:
@@ -366,12 +373,31 @@ class SessionManager:
         while not self._stop.wait(interval):
             try:
                 self.sweep_idle()
+            # kss-analyze: allow(swallowed-exception)
             except Exception:
                 pass  # the sweeper must survive a racing teardown
 
     def _teardown(self, sess: SimulationSession, reason: str) -> None:
         TRACER.inc("sessions_evicted_total", reason=reason)
-        sess.shutdown()
+        failed = False
+        try:
+            fault_point("session.evict")
+        except Exception:
+            # an injected evict fault models a failing teardown STEP —
+            # still attempt the real shutdown below, or the evicted
+            # session's scheduling loop would keep running orphaned
+            failed = True
+        try:
+            sess.shutdown()
+        except Exception:
+            failed = True
+        if failed:
+            # a teardown failure must never wedge admission (the victim
+            # was already unregistered; shutdown() stops the loop and
+            # streams first, so a partial failure leaks the least) —
+            # count it so chaos runs and operators see it instead of a
+            # 500 that leaves the registry in the same state anyway
+            TRACER.inc("session_teardown_failures_total", reason=reason)
 
     # -------------------------------------------------------- shutdown
 

@@ -22,6 +22,7 @@ import os
 import numpy as np
 
 from . import annotations as ann
+from ..utils.faults import fault_point
 from ..utils.platform import effective_cpu_count
 from ..utils.tracing import TRACER
 from ..framework.replay import ReplayResult
@@ -390,7 +391,33 @@ def decode_chunk_into(rr, lo: int, hi: int, out: list, base: int = 0) -> None:
     Decoder ladder (docs/wave-pipeline.md): chunk-granular native call
     (one GIL-released C call per compact chunk, C-side worker pool) ->
     per-pod fused native decode on the Python thread pool -> pure-Python
-    encoder (KSS_TPU_DISABLE_NATIVE=1, or no toolchain)."""
+    encoder (KSS_TPU_DISABLE_NATIVE=1, or no toolchain).
+
+    A failed decode re-raises to its caller but is VISIBLE now
+    (decode_failures_total{path=...}) and never poisons the chunk: the
+    lazy read path clears for retry (store/lazy.py), so a transient
+    fault heals on the next read — tests/test_faults.py pins this."""
+    try:
+        _decode_chunk_into(rr, lo, hi, out, base)
+    except Exception:
+        TRACER.inc("decode_failures_total", path=_decode_path_label(rr))
+        raise
+
+
+def _decode_path_label(rr) -> str:
+    """Best-effort decode-path label for the failure tap (the ladder
+    the failed call would have taken)."""
+    try:
+        if _native_ctx(rr.cw) is None:
+            return "python"
+        return ("native_chunk" if getattr(rr, "_compact", None) is not None
+                else "native_pod")
+    except Exception:
+        return "unknown"
+
+
+def _decode_chunk_into(rr, lo: int, hi: int, out: list, base: int) -> None:
+    fault_point("decode.chunk")
     cc = getattr(rr, "_compact", None)
     if cc is not None:
         # chunk-granular native decode; ranges spanning several compact
@@ -468,6 +495,7 @@ def decode_release_batches(rr, lo: int, hi: int, on_pod=None,
         fut = start(ranges[0]) if ranges else None
         try:
             for k, (b0, b1) in enumerate(ranges):
+                fault_point("decode.chunk")
                 handle = fut.result()
                 fut = start(ranges[k + 1]) if k + 1 < len(ranges) else None
                 triples = native_decode.decode_chunk_take(handle)
@@ -481,10 +509,15 @@ def decode_release_batches(rr, lo: int, hi: int, on_pod=None,
                     for j, a in enumerate(sink):
                         if a is not None:
                             on_pod(b0 + j, a)
-        except BaseException:
+        except BaseException as e:
+            if isinstance(e, Exception):
+                TRACER.inc("decode_failures_total", path="native_chunk")
             if fut is not None:  # don't leak the in-flight arena
                 try:
                     fut.result().discard()
+                # best-effort arena release on an already-raising path
+                # (the original error re-raises below)
+                # kss-analyze: allow(swallowed-exception)
                 except Exception:
                     pass
             raise

@@ -69,7 +69,6 @@ class LazyWave:
         self.chunk = cc.chunk if cc is not None else _FALLBACK_CHUNK
         self._mu = threading.Lock()
         self._chunks: dict[int, list] = {}
-        self._errors: dict[int, BaseException] = {}
         self._inflight: dict[int, threading.Event] = {}
         # streaming waves seal at replay drain: a reader arriving while
         # the device is still filling rr blocks here instead of decoding
@@ -110,20 +109,23 @@ class LazyWave:
                 got = self._chunks.get(ci)
                 if got is not None:
                     break
-                err = self._errors.pop(ci, None)
-                if err is not None:
-                    # raise to THIS reader only: popping lets the next
-                    # reader retry the decode (a transient failure —
-                    # allocation pressure, an interrupt mid-read — must
-                    # not poison the chunk forever)
-                    raise err
                 ev = self._inflight.get(ci)
                 owner = ev is None
                 if owner:
                     ev = self._inflight[ci] = threading.Event()
             if not owner:
                 ev.wait()
-                continue  # re-check: memoized result or recorded error
+                # a failed decode hands its error to the readers that
+                # were already waiting on it (the attribute rides the
+                # event); a FRESH read retries the decode instead — a
+                # transient failure (allocation pressure, an injected
+                # chaos fault, an interrupt mid-read) must heal on
+                # re-read, never poison the chunk (docs/fault-injection.md;
+                # decode_failures_total counts the failure)
+                err = getattr(ev, "error", None)
+                if err is not None:
+                    raise err
+                continue  # re-check: the owner memoized the chunk
             lo = ci * self.chunk
             hi = min(lo + self.chunk, self.n)
             sink: list = [None] * (hi - lo)
@@ -133,8 +135,8 @@ class LazyWave:
                 with TRACER.span("decode_lazy", lo=lo, hi=hi):
                     decode_chunk_into(self.rr, lo, hi, sink, base=lo)
             except BaseException as e:  # noqa: BLE001 — replayed to waiters
+                ev.error = e
                 with self._mu:
-                    self._errors[ci] = e
                     del self._inflight[ci]
                 ev.set()
                 raise

@@ -24,7 +24,9 @@ import json
 
 from . import annotations as ann
 from ..cluster.store import Conflict, NotFound, ObjectStore
+from ..utils.faults import fault_point
 from ..utils.retry import retry_with_exponential_backoff
+from ..utils.tracing import TRACER
 
 RESULT_HISTORY_LIMIT = ann.TOTAL_ANNOTATION_SIZE_LIMIT
 
@@ -179,10 +181,13 @@ class LazyReflections:
     `d2h_fetch` span) — and the store write run with NO registry lock
     held."""
 
-    def __init__(self, store):
+    def __init__(self, store, stop=None):
         import threading
 
         self.store = store
+        # owner's teardown event: interrupts the conflict-retry backoff
+        # of a drain racing shutdown/eviction (utils/retry.py stop)
+        self.stop = stop
         self._mu = threading.Lock()
         self._pending: dict[tuple[str, str], list[_PendingRecord]] = {}
         self._inflight: dict[tuple[str, str], object] = {}
@@ -397,6 +402,10 @@ class LazyReflections:
 
         def attempt() -> tuple[bool, Exception | None]:
             try:
+                fault_point("reflector.write_back")
+            except Conflict:
+                return False, None  # injected conflict: retry under backoff
+            try:
                 cur = self.store.get("pods", name, namespace,
                                      copy_object=False)
             except NotFound:
@@ -429,7 +438,7 @@ class LazyReflections:
                 return False, None  # re-fetch and retry
             return True, None
 
-        retry_with_exponential_backoff(attempt)
+        retry_with_exponential_backoff(attempt, stop=self.stop)
 
 
 def reflect_each(reflect_fn, items) -> None:
@@ -450,9 +459,16 @@ def reflect_each(reflect_fn, items) -> None:
 
 class StoreReflector:
     def __init__(self, store: ObjectStore, sleep=None):
+        import threading
+
         self.store = store
         self.result_stores: dict[str, object] = {}
         self._sleep = sleep  # injectable for tests
+        # teardown interrupt: the write path's exponential backoff
+        # sleeps up to ~36s; setting this (DIContainer.shutdown /
+        # session eviction) wakes any in-flight backoff immediately
+        # (utils/retry.py RetryAborted) instead of riding it out
+        self.stop_event = threading.Event()
         self._watch_thread = None
         self._watch_queue = None
         self._lazy: LazyReflections | None = None
@@ -468,7 +484,7 @@ class StoreReflector:
         """The deferred write-back registry, installed as a store read
         hook on first use (store/lazy.py module docs)."""
         if self._lazy is None:
-            reg = LazyReflections(self.store)
+            reg = LazyReflections(self.store, stop=self.stop_event)
             self.store.add_read_hook(reg)
             self._lazy = reg
         return self._lazy
@@ -533,6 +549,7 @@ class StoreReflector:
                            for rs in self.result_stores.values()):
                         try:
                             self.reflect(ns, name, uid=meta.get("uid"))
+                        # kss-analyze: allow(swallowed-exception)
                         except Exception:
                             pass  # klog-and-continue, as the reference does
             finally:
@@ -571,6 +588,10 @@ class StoreReflector:
         last_pod: dict = {}
 
         def attempt() -> tuple[bool, Exception | None]:
+            try:
+                fault_point("reflector.write_back")
+            except Conflict:
+                return False, None  # injected conflict: retry under backoff
             try:
                 cur = self.store.get("pods", name, namespace,
                                      copy_object=False)
@@ -622,7 +643,8 @@ class StoreReflector:
             return True, None
 
         kwargs = {"sleep": self._sleep} if self._sleep else {}
-        retry_with_exponential_backoff(attempt, **kwargs)
+        retry_with_exponential_backoff(attempt, stop=self.stop_event,
+                                       **kwargs)
         if last_pod:
             for rs in self.result_stores.values():
                 rs.delete_data(last_pod)
@@ -643,6 +665,16 @@ class StoreReflector:
         stamp under the lock, and a concurrent wave's binds never queue
         behind a batch of record encodes."""
         if getattr(self.store, "apply_batch", None) is None:
+            reflect_each(self.reflect, items)
+            return
+        try:
+            fault_point("reflector.write_back")
+        except Exception:
+            # a failed batch write-back degrades to the per-pod
+            # conflict-retried path — same bytes, same record order,
+            # just without the single-lock-hold batching
+            TRACER.inc("wave_faults_total", seam="reflector.write_back",
+                       action="batch_fallback")
             reflect_each(self.reflect, items)
             return
         defer_ok = getattr(self.store, "add_read_hook", None) is not None

@@ -1,0 +1,32 @@
+"""Shared env-var knob parsing.
+
+One coercion rule for every KSS_TPU_* numeric knob (engine failure
+protocol, compile quarantine, session admission): unset/empty or
+unparsable (including "inf"/"nan" for int knobs) falls back to the
+default — an operator typo degrades to documented behavior instead of
+crashing a wave.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(float(raw))
+    except (ValueError, OverflowError):
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default

@@ -1,0 +1,81 @@
+"""kss-analyze fixture: seeded swallowed-exception violations.
+
+Never imported; parsed by tests/test_analyze.py through
+load_module_file + run_analysis(swallow_modules=...).
+"""
+
+
+def silent_pass():
+    try:
+        risky()
+    except Exception:
+        pass
+
+
+def silent_continue():
+    for _ in range(3):
+        try:
+            risky()
+        except (ValueError, OSError):
+            continue
+
+
+def bare_silent():
+    try:
+        risky()
+    except:  # noqa: E722
+        ...
+
+
+def handled_with_tap():
+    try:
+        risky()
+    except Exception:
+        TRACER.inc("failures_total")  # noqa: F821 — fixture
+
+
+def handled_with_reraise():
+    try:
+        risky()
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+
+
+def handled_with_state():
+    err = None
+    try:
+        risky()
+    except Exception as e:
+        err = e
+    return err
+
+
+def allowed_silent():
+    try:
+        risky()
+    # kss-analyze: allow(swallowed-exception)
+    except Exception:
+        pass
+
+
+def outer_with_nested():
+    def inner_a():
+        try:
+            risky()
+        except Exception:
+            pass
+
+    def inner_b():
+        try:
+            risky()
+        except Exception:
+            pass
+
+    return inner_a, inner_b
+
+
+def risky():
+    raise ValueError("fixture")
+
+
+TRACER = None

@@ -125,6 +125,35 @@ def test_compact_host_sync_detected():
 # ------------------------------------------------------------ span rules
 
 
+def test_swallowed_exception_detected():
+    """The swallowed-exception rule (tools/analysis/swallowed.py): a
+    handler whose body is entirely silent (pass/continue/...) is
+    flagged; handlers that tap, re-raise or record state are not; an
+    allow comment suppresses with a reason on record."""
+    mod = load_module_file(REPO_ROOT, f"{FIXTURES}/bad_swallow.py")
+    res = run_analysis(modules=[mod],
+                       swallow_modules=("bad_swallow.py",))
+    sw = [f for f in res["findings"] if f.rule == "swallowed-exception"]
+    flagged = {f.qualname for f in sw}
+    # nested siblings keep DISTINCT qualnames (distinct ratchet
+    # fingerprints — a baselined inner_a must not mask a new inner_b)
+    assert flagged == {"silent_pass", "silent_continue", "bare_silent",
+                       "outer_with_nested.inner_a",
+                       "outer_with_nested.inner_b"}, flagged
+    assert any("except bare" in f.detail for f in sw)
+    # the allowed site counted as suppressed, not as a finding
+    assert res["suppressed"] >= 1
+
+
+def test_swallowed_exception_scoped_to_hot_modules():
+    """Modules outside the hot-path manifest are not policed: the rule
+    exists for the fault seams' neighborhoods, not the whole tree."""
+    mod = load_module_file(REPO_ROOT, f"{FIXTURES}/bad_swallow.py")
+    res = run_analysis(modules=[mod])  # default manifest: no match
+    assert not [f for f in res["findings"]
+                if f.rule == "swallowed-exception"]
+
+
 def test_unbalanced_span_and_bad_names():
     res = _fixture_result("bad_spans.py")
     rules = _rules(res["findings"])

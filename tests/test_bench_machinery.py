@@ -333,6 +333,34 @@ def test_bench_check_refuses_tainted_round(tmp_path, capsys):
     assert bc.main(["--dir", str(tmp_path)]) == 0
 
 
+def test_bench_check_refuses_round_with_failed_chaos(tmp_path, capsys):
+    """A round whose embedded chaos verdict failed invalidates the
+    comparison (docs/fault-injection.md): the tree no longer survives
+    injected faults with bit-identical results — refuse, and point at
+    the reproducing seed."""
+    import json
+
+    bc = _bench_check()
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "tail": json.dumps(_bench_line()) + "\n"}))
+    bad = _bench_line()
+    bad["extra"]["chaos"] = {
+        "ok": False, "seeds": [1],
+        "failures": ["seed 1: chaos-a: state diverged from fault-free "
+                     "run at ['p003']"]}
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "tail": json.dumps(bad) + "\n"}))
+    assert bc.main(["--dir", str(tmp_path)]) == 2
+    out = capsys.readouterr().out
+    assert "chaos" in out and "REFUSING" in out and "diverged" in out
+    # a green verdict (and rounds predating the field) compare normally
+    ok = _bench_line()
+    ok["extra"]["chaos"] = {"ok": True, "seeds": [1], "failures": []}
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "tail": json.dumps(ok) + "\n"}))
+    assert bc.main(["--dir", str(tmp_path)]) == 0
+
+
 def test_measure_engine_emits_metrics_snapshot():
     """The BENCH artifact carries the flight-recorder families
     (docs/metrics.md): upstream-named histograms + per-plugin labeled

@@ -1,0 +1,34 @@
+"""Tier-2 chaos gate (slow; `make chaos` runs the same harness as a
+standalone command with the lock witness armed).
+
+tools/chaos.py drives concurrent multi-session waves under seeded fault
+plans covering every seam and asserts the wave-failure-protocol
+invariants: completion via retry/degradation, bit-identical annotations
+vs the fault-free run, gang atomicity, per-session isolation, and a
+consistent session registry under create/evict faults.  A failing seed
+reproduces with `python -m tools.chaos --seeds 1 --seed-base <seed>`.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tools.chaos import FULL_SHAPE, chaos_verdict, run_seed
+
+pytestmark = pytest.mark.slow
+
+
+def test_chaos_gate_three_seeds():
+    verdict = chaos_verdict(seeds=3, seed_base=1)
+    assert verdict["ok"], "\n".join(verdict["failures"])
+    assert verdict["injected_total"] >= 3, \
+        "the plans barely fired — the gate would be vacuous"
+
+
+def test_chaos_single_seed_reports_failures_shape():
+    r = run_seed(11, FULL_SHAPE)
+    assert r["ok"], r["failures"]
+    assert r["injected"] >= 1
+    assert set(r["modes"]) == {"chaos-a", "chaos-b"}
+    # the unfaulted neighbor must never have been degraded
+    assert r["modes"]["chaos-b"] == "device_resident"

@@ -521,8 +521,13 @@ def test_mid_chunk_exception_leaves_tracer_balanced(monkeypatch):
     stays well-formed (docs/static-analysis.md, unbalanced-span rule)."""
     TRACER.reset()
     # this test poisons put_decoded mid-chunk: pin the EAGER commit
-    # worker (lazy mode deposits handles and never calls it in-wave)
+    # worker (lazy mode deposits handles and never calls it in-wave),
+    # and disable the wave failure protocol's retry so the ABORT path —
+    # what this test pins — still surfaces the raise (with retries on,
+    # the one-shot poison heals via the uncommitted-suffix retry:
+    # tests/test_faults.py covers that)
     monkeypatch.setenv("KSS_TPU_EAGER_DECODE", "1")
+    monkeypatch.setenv("KSS_TPU_WAVE_MAX_RETRIES", "0")
     store = ObjectStore()
     for n in make_nodes(6, seed=31):
         store.create("nodes", n)

@@ -31,14 +31,16 @@ DEFAULT_PACKAGE = "kube_scheduler_simulator_tpu"
 def run_analysis(root: str | None = None,
                  package: str | None = None,
                  modules=None,
-                 purity_roots=None) -> dict:
-    """Run all three analyzers; returns
+                 purity_roots=None,
+                 swallow_modules=None) -> dict:
+    """Run all four analyzers; returns
     {"findings": [Finding] (suppressions applied), "suppressed": int,
     "modules": int, "functions": int, "graph": CallGraph}."""
     from .callgraph import CallGraph
     from .locks import LockAnalyzer
     from .purity import PurityAnalyzer
     from .spans import SpanAnalyzer
+    from .swallowed import SwallowedAnalyzer
 
     if modules is None:
         modules = load_modules(root or REPO_ROOT,
@@ -49,6 +51,8 @@ def run_analysis(root: str | None = None,
     findings.extend(lock_findings)
     findings.extend(PurityAnalyzer(graph, roots=purity_roots).analyze())
     findings.extend(SpanAnalyzer(modules).analyze())
+    findings.extend(
+        SwallowedAnalyzer(modules, hot_modules=swallow_modules).analyze())
     by_path = {m.path: m for m in modules}
     kept = filter_suppressed(findings, by_path)
     # stable order + dedup by fingerprint: one function repeating the

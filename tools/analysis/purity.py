@@ -33,6 +33,7 @@ from .common import Finding, dotted_name
 HOT_PATH_ROOTS: list[tuple[str, str]] = [
     ("framework.engine", "SchedulerEngine._schedule_wave"),
     ("framework.engine", "SchedulerEngine._profile_wave_run"),
+    ("framework.engine", "SchedulerEngine._profile_wave_attempt"),
     ("framework.engine", "_WaveCommitter.on_chunk"),
     ("framework.engine", "_WaveCommitter._commit"),
     ("framework.replay", "*"),

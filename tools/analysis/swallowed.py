@@ -1,0 +1,113 @@
+"""Swallowed-exception analyzer (rule: swallowed-exception).
+
+A bare `except ...: pass` on a hot-path module is how faults become
+invisible: the wave failure protocol (docs/fault-injection.md) can only
+classify and retry/degrade what actually SURFACES, and the chaos gate
+can only assert on what is COUNTED.  This rule flags exception handlers
+whose body is entirely silent — only `pass` / `continue` / `break` /
+`...` — on the modules the fault seams thread through.  A handler that
+re-raises, records a tracing tap, logs, or mutates state is doing
+*something* with the failure and is not flagged.
+
+Existing reasoned sites are grandfathered with in-source
+`# kss-analyze: allow(swallowed-exception)` comments carrying their
+justification (the suppression mechanism of tools/analysis/common.py);
+new silent swallows on these modules fail `make analyze`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Finding, Module
+
+RULE = "swallowed-exception"
+
+# the hot-path modules the fault seams thread through: a silent swallow
+# here hides exactly the failures the chaos gate injects
+HOT_MODULES = (
+    "framework/engine.py",
+    "framework/replay.py",
+    "framework/gang.py",
+    "store/decode.py",
+    "store/lazy.py",
+    "store/reflector.py",
+    "store/resultstore.py",
+    "server/sessions.py",
+    "server/di.py",
+    "cluster/kubeapi.py",
+)
+
+_SILENT = (ast.Pass, ast.Continue, ast.Break)
+
+
+def _is_silent(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, _SILENT):
+        return True
+    # a lone `...` (Ellipsis) expression is a pass in disguise
+    return (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant))
+
+
+def _exc_label(handler: ast.ExceptHandler) -> str:
+    t = handler.type
+    if t is None:
+        return "bare"
+    if isinstance(t, ast.Tuple):
+        return ",".join(ast.unparse(e) for e in t.elts)
+    return ast.unparse(t)
+
+
+class SwallowedAnalyzer:
+    def __init__(self, modules: list[Module], hot_modules=None):
+        self.modules = modules
+        self.hot_modules = tuple(hot_modules) if hot_modules is not None \
+            else HOT_MODULES
+
+    def analyze(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in self.modules:
+            if not mod.path.endswith(self.hot_modules):
+                continue
+            findings.extend(self._check_module(mod))
+        return findings
+
+    def _check_module(self, mod: Module) -> list[Finding]:
+        out: list[Finding] = []
+        # enclosing-function map for qualnames
+        qual_of: dict[int, str] = {}
+
+        def walk(node, prefix=""):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{prefix}{child.name}"
+                    # recurse FIRST so nested functions stamp their own
+                    # nodes; the outer setdefault then only fills the
+                    # rest — otherwise sibling nested functions would
+                    # share the outer qualname and their findings would
+                    # collide into one ratchet fingerprint
+                    walk(child, q + ".")
+                    for n in ast.walk(child):
+                        qual_of.setdefault(id(n), q)
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, f"{prefix}{child.name}.")
+                else:
+                    walk(child, prefix)
+
+        walk(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not node.body or not all(_is_silent(s) for s in node.body):
+                continue
+            label = _exc_label(node)
+            qual = qual_of.get(id(node), "<module>")
+            out.append(Finding(
+                rule=RULE, path=mod.path, qualname=qual,
+                detail=f"except {label}", lineno=node.lineno,
+                message=(f"silent `except {label}: pass` swallows the "
+                         "failure with no tap, log, re-raise or state "
+                         "change — surface it (TRACER.inc / re-raise) or "
+                         "justify with an allow comment"),
+            ))
+        return out
