@@ -554,7 +554,7 @@ def measure_engine(scale_pods: int, scale_nodes: int, seed: int,
             "store_batch_writes_total", "store_batches_total",
             "replay_width_retries_total",
             "decode_chunk_calls_total", "decode_native_thread_seconds",
-            "wave_attribution_seconds",
+            "wave_attribution_seconds", "speculative_rounds_total",
             "wave_d2h_bytes_total", "d2h_on_demand_bytes_total",
             "device_chunks_spilled_total",
             "gang_groups_admitted_total", "gang_quorum_rollbacks_total",
@@ -884,6 +884,15 @@ def measure_serve(k_sessions: int, scale_pods: int, scale_nodes: int,
         f"(rate {hit_rate:.2%}, floor {(k_sessions - 1) / k_sessions:.2%} "
         f"for same-shape sessions)")
     snap = TRACER.snapshot()
+    # per-session speculative commit rate (docs/metrics.md): the measured
+    # baseline cross-session wave batching starts from
+    from kube_scheduler_simulator_tpu.server.sessions import (
+        speculative_commit_rates)
+
+    spec = speculative_commit_rates(TRACER)
+    if spec:
+        rates = {s: d["acceptRate"] for s, d in spec.items()}
+        log(f"  speculative accept rate per session: {rates}")
     mgr.shutdown()
     return {"sessions": k_sessions, "pods": scale_pods, "nodes": scale_nodes,
             "cold": cold, "warm": warm,
@@ -891,7 +900,126 @@ def measure_serve(k_sessions: int, scale_pods: int, scale_nodes: int,
                               "hit_rate": hit_rate,
                               "floor": round((k_sessions - 1) / k_sessions,
                                              4)},
+            "speculative": spec,
             "metrics": {"labeled_counters": snap["labeled_counters"]}}
+
+
+def measure_speculative(scale_pods: int, scale_nodes: int, seed: int,
+                        reps: int = 3):
+    """`make bench-spec`: same-process interleaved A/B of the DEFAULT
+    speculative wave against the sequential scan (KSS_TPU_SPECULATIVE=0)
+    at the engine shape, on two scenarios:
+
+      * low_contention — the reserved-slot DL fleet
+        (models/workloads.make_slot_pinned_workload): sparse, mostly
+        disjoint feasibility, the shape where speculation turns P scan
+        steps into ~ceil(P/B) batched rounds.  This is the headline A/B
+        the >=1.5x acceptance bar measures.
+      * contended — the standard broad-feasibility engine workload
+        (every pod fits thousands of nodes), where byte-exact
+        acceptance collapses and the contention controller must hand
+        the wave to the scan fallback at ~scan cost.
+
+    Reports best-of-`reps` cycles/s per arm (arms alternate within one
+    process so host noise hits both), plus accept rate / rounds /
+    fallbacks from the flight recorder — the keys bench_check gates."""
+    import os
+
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.models.workloads import (
+        make_nodes, make_pods, make_slot_pinned_workload)
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+    from kube_scheduler_simulator_tpu.utils.tracing import TRACER
+
+    def scenario(name: str, nodes: list, pods: list, enabled: list) -> dict:
+        store = ObjectStore()
+        for n in nodes:
+            store.create("nodes", n)
+        engine = SchedulerEngine(store,
+                                 plugin_config=PluginSetConfig(
+                                     enabled=list(enabled)), chunk=512)
+        log(f"speculative A/B [{name}]: {len(pods)} pods x {len(nodes)} "
+            f"nodes, {reps} reps/arm interleaved")
+
+        def wave(spec_on: bool) -> tuple[float, int]:
+            for p in pods:
+                store.create("pods", p)
+            prev = os.environ.get("KSS_TPU_SPECULATIVE")
+            os.environ["KSS_TPU_SPECULATIVE"] = "1" if spec_on else "0"
+            try:
+                t0 = time.perf_counter()
+                bound = engine.schedule_pending()
+                wall = time.perf_counter() - t0
+            finally:
+                if prev is None:
+                    os.environ.pop("KSS_TPU_SPECULATIVE", None)
+                else:
+                    os.environ["KSS_TPU_SPECULATIVE"] = prev
+            for p in store.list("pods", copy_objects=False)[0][:]:
+                meta = p["metadata"]
+                store.delete("pods", meta["name"], meta.get("namespace"))
+            return wall, bound
+
+        # one warm wave per arm: XLA compiles (spec rungs + oracle +
+        # commit on one side, the chunked scan on the other) stay out of
+        # the measured reps
+        wave(True)
+        wave(False)
+        spec_walls, seq_walls = [], []
+        bound = 0
+        spec_counters: dict = {}
+        for r in range(reps):
+            TRACER.reset()
+            w, bound = wave(True)
+            spec_walls.append(w)
+            if r == 0:
+                summary = TRACER.summary()["counters"]
+                acc = TRACER.labeled_totals(
+                    "speculative_accepted_total", "session").get("", 0)
+                roll = TRACER.labeled_totals(
+                    "speculative_rolled_back_total", "session").get("", 0)
+                spec_counters = {
+                    "rounds": int(summary.get("speculative_rounds_total", 0)),
+                    "accepted": int(acc),
+                    "rolled_back": int(roll),
+                    "accept_rate": round(acc / (acc + roll), 4)
+                        if acc + roll else None,
+                    "fallbacks": int(sum(TRACER.labeled_totals(
+                        "speculative_fallbacks_total", "session").values())),
+                }
+            w, _ = wave(False)
+            seq_walls.append(w)
+        spec_cps = round(scale_pods / min(spec_walls), 1)
+        seq_cps = round(scale_pods / min(seq_walls), 1)
+        fig = {
+            "speculative_cycles_per_sec": spec_cps,
+            "sequential_cycles_per_sec": seq_cps,
+            "speedup": round(spec_cps / seq_cps, 3) if seq_cps else None,
+            "bound": bound,
+            **spec_counters,
+        }
+        engine.close()
+        log(f"  [{name}] speculative {spec_cps:,.0f} vs sequential "
+            f"{seq_cps:,.0f} cycles/s ({fig['speedup']}x), accept rate "
+            f"{fig.get('accept_rate')}, {fig.get('rounds')} rounds, "
+            f"{fig.get('fallbacks')} fallback(s)")
+        return fig
+
+    slot_nodes, slot_pods = make_slot_pinned_workload(
+        scale_pods, scale_nodes, seed=seed)
+    low = scenario("low_contention", slot_nodes, slot_pods,
+                   ["NodeResourcesFit", "NodeResourcesBalancedAllocation",
+                    "NodeAffinity"])
+    broad_nodes = make_nodes(scale_nodes, seed=seed, taint_fraction=0.1)
+    broad_pods = make_pods(scale_pods, seed=seed + 1, with_affinity=True,
+                           with_tolerations=True, with_spread=True)
+    contended = scenario("contended", broad_nodes, broad_pods,
+                         ["NodeResourcesFit",
+                          "NodeResourcesBalancedAllocation", "NodeAffinity",
+                          "TaintToleration", "PodTopologySpread"])
+    return {"pods": scale_pods, "nodes": scale_nodes,
+            "low_contention": low, "contended": contended}
 
 
 def measure_cpu_baseline(idx: int, cpu_scale: float, node_scale: float,
@@ -1030,6 +1158,11 @@ def main():
                          "(make bench-serve): K concurrent sessions, "
                          "aggregate + p99 cycles/s, compile-cache hit rate")
     ap.add_argument("--serve-sessions", type=int, default=4)
+    ap.add_argument("--spec", action="store_true",
+                    help="run ONLY the speculative-wave A/B shape "
+                         "(make bench-spec): default speculative wave vs "
+                         "KSS_TPU_SPECULATIVE=0 sequential scan, "
+                         "low-contention + contention-heavy scenarios")
     ap.add_argument("--skip-parity", action="store_true")
     ap.add_argument("--skip-config5", action="store_true")
     ap.add_argument("--skip-engine", action="store_true")
@@ -1047,6 +1180,19 @@ def main():
         print(json.dumps({"metric": "serve_bench",
                           "value": fig["warm"]["aggregate_cycles_per_sec"],
                           "unit": "cycles/s", "extra": {"serve": fig}}))
+        return
+    if args.spec:
+        # standalone speculative A/B (make bench-spec): lazy waves never
+        # materialize the 13 GB annotation product, so no THP machinery
+        fig = (measure_speculative(200, 100, args.seed, reps=1)
+               if args.smoke else
+               measure_speculative(max(int(10000 * args.scale), 100),
+                                   max(int(5000 * args.scale), 50),
+                                   args.seed))
+        print(json.dumps({
+            "metric": "speculative_bench",
+            "value": fig["low_contention"]["speculative_cycles_per_sec"],
+            "unit": "cycles/s", "extra": {"speculative": fig}}))
         return
     if args.gang:
         # standalone gang shape (make bench-gang): no THP/forkserver
@@ -1263,6 +1409,26 @@ def _run(args):
         except Exception as e:  # never trade the headline for the serve tap
             log(f"serve phase failed: {type(e).__name__}: {e}")
             extra["serve"] = None
+
+    # --- speculative wave A/B -------------------------------------------
+    # rides every committed BENCH round so bench_check can gate the
+    # speculative cycles/s + accept-rate trajectory at the 10k x 5k
+    # shape (union/skip semantics keep pre-speculative rounds green)
+    if not args.assume_fallback and not args.skip_engine:
+        try:
+            if args.smoke:
+                extra["speculative"] = measure_speculative(
+                    200, 100, args.seed, reps=1)
+            elif _available_gb() < 10:
+                log("skipping speculative A/B: low host memory")
+                extra["speculative"] = None
+            else:
+                extra["speculative"] = measure_speculative(
+                    max(int(10000 * args.scale), 100),
+                    max(int(5000 * args.scale), 50), args.seed)
+        except Exception as e:  # never trade the headline for this tap
+            log(f"speculative phase failed: {type(e).__name__}: {e}")
+            extra["speculative"] = None
 
     # --- CPU baseline ---------------------------------------------------
     cache_path = Path(__file__).parent / ".bench_cpu_cache.json"

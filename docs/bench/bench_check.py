@@ -57,6 +57,8 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
     lazy = eng.get("lazy") or {}
     lazy10k = eng10k.get("lazy") or {}
     serve = extra.get("serve") or {}
+    spec = (extra.get("speculative") or {}).get("low_contention") or {}
+    spans10k = eng10k.get("spans") or {}
     return {
         "decode_pods_per_sec": (extra.get("decode_pods_per_sec"), "higher"),
         "commit_stream_overlap_seconds":
@@ -78,8 +80,7 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
         "engine_10k_5k_wave_d2h_bytes":
             (lazy10k.get("wave_d2h_bytes"), "lower"),
         "engine_10k_5k_replay_stream_seconds":
-            ((eng10k.get("spans") or {}).get("replay_and_decode_stream"),
-             "lower"),
+            (spans10k.get("replay_and_decode_stream"), "lower"),
         "engine_10k_5k_cold_read_with_d2h_seconds":
             (lazy10k.get("cold_read_seconds"), "lower"),
         # multi-session serving era metrics (absent from pre-session
@@ -95,6 +96,19 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
              "higher"),
         "serve_compile_cache_hit_rate":
             ((serve.get("compile_cache") or {}).get("hit_rate"), "higher"),
+        # speculative-wave era metrics (absent from pre-speculative
+        # rounds — union/skip carries them): the default wave's
+        # cycles/s and accept rate on the low-contention reserved-slot
+        # scenario at the 10k x 5k shape, and the measured speedup over
+        # the KSS_TPU_SPECULATIVE=0 sequential scan in the same process
+        # (a drop means the conflict oracle started rejecting work or
+        # the batched rounds got slower)
+        "engine_10k_5k_speculative_cycles_per_sec":
+            (spec.get("speculative_cycles_per_sec"), "higher"),
+        "engine_10k_5k_speculative_accept_rate":
+            (spec.get("accept_rate"), "higher"),
+        "engine_10k_5k_speculative_speedup_vs_scan":
+            (spec.get("speedup"), "higher"),
     }
 
 

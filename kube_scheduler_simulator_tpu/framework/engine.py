@@ -1135,53 +1135,28 @@ class SchedulerEngine:
 
         from ..store.decode import decode_chunk_into
 
-        if (self.mesh is not None and self.mesh.shape.get("dp", 1) > 1
+        if (os.environ.get("KSS_TPU_SPECULATIVE", "1") != "0"
                 and self.extender_service is None
                 and not self._custom_lifecycle_plugins()):
-            # (uses the divisibility-checked mesh; dp batching itself
-            # works unsharded, so the wave still speculates)
-            from ..parallel.speculative import replay_speculative, speculation_ok
+            # speculative multi-pod rounds are the DEFAULT wave whenever
+            # the active plugin set admits exact batching — a single
+            # device suffices (a mesh additionally fans the batch over
+            # its "dp" axis; this uses the divisibility-checked mesh).
+            # KSS_TPU_SPECULATIVE=0 pins the sequential scan: the parity
+            # baseline the golden suite diffs against.  The engine's
+            # vectorized gang plugin is ignored by the eligibility check
+            # (its PreFilter ran in the prescreen, admission happens in
+            # the quorum pass at commit — it neither filters nor scores
+            # on device)
+            from ..parallel.speculative import speculation_ok
 
-            if speculation_ok(self.plugin_config, have_manifests=True):
-                # dp-axis speculative batches: evaluate a pod batch against
-                # frozen state across the mesh's dp shards, commit the
-                # provably-non-interfering prefix — bit-identical to the
-                # scan (parallel/speculative.py; tests/test_speculative.py)
-                def _spec_replay():
-                    with TRACER.span("speculative_replay",
-                                     pods=len(pending),
-                                     nodes=len(nodes)) as sp:
-                        rr, spec_stats = replay_speculative(
-                            cw, mesh, pods=pending,
-                            namespaces=self._list_shared("namespaces"))
-                        TRACER.count("speculative_rounds_total",
-                                     spec_stats["rounds"])
-                    return rr, sp.seconds
-
-                rr, spec_seconds = self._guarded_replay(
-                    "speculative_replay", pending, _spec_replay)
-                self._record_attribution(rr, spec_seconds)
-                if self._wave_lazy_ok():
-                    from ..store.lazy import LazyWave
-
-                    return self._finish_wave(
-                        cw, rr, None, pending, exclude,
-                        lazy_wave=LazyWave(rr, len(pending), sealed=True))
-                # rr's arrays are final host numpy here: decode through
-                # the pooled chunk decoder like the scan path, not one
-                # pod at a time on the commit thread.  Guarded: nothing
-                # is committed yet, so a transient decode fault retries
-                # the wave instead of aborting the backlog
-                all_annotations = [None] * len(pending)
-
-                def _spec_decode():
-                    with TRACER.span("decode_stream", pods=len(pending)):
-                        decode_chunk_into(rr, 0, len(pending),
-                                          all_annotations)
-
-                self._guarded_replay("decode_stream", pending, _spec_decode)
-                return self._finish_wave(cw, rr, all_annotations, pending,
-                                         exclude)
+            ignore = (frozenset({gp.name})
+                      if gp is not None and self._gang_wave is not None
+                      else frozenset())
+            if speculation_ok(self.plugin_config, have_manifests=True,
+                              ignore=ignore):
+                return self._speculative_wave(cw, mesh, pending, exclude,
+                                              len(nodes), ignore)
 
         if self._custom_lifecycle_plugins():
             # a custom Reserve/Permit/PreBind can reject mid-wave and abort
@@ -1291,6 +1266,95 @@ class SchedulerEngine:
         rr, replay_seconds = self._guarded_replay(
             "replay_stream", pending, _eager_replay)
         self._record_attribution(rr, replay_seconds)
+        return self._finish_wave(cw, rr, all_annotations, pending, exclude)
+
+    def _speculative_wave(self, cw, mesh, pending,
+                          exclude: set[tuple[str, str]] | None,
+                          n_nodes: int, ignore: frozenset = frozenset()
+                          ) -> tuple[int, str | None]:
+        """The engine's default wave (docs/wave-pipeline.md
+        speculative-wave stage): vmapped rounds of B queued pods against
+        the frozen carry, a conflict oracle accepting the provably
+        non-interfering prefix, accepted results streamed to the commit
+        worker on the standard chunk grid — so lazy decode, device
+        residency, the gang-cut watermark and the wave failure
+        protocol's uncommitted-suffix retry all compose unchanged.  A
+        contention collapse hands the wave's remainder to the
+        sequential chunked scan in-stream (parallel/speculative.py)."""
+        from ..parallel.speculative import replay_speculative_stream
+        from ..store.decode import decode_chunk_into
+
+        namespaces = self._list_shared("namespaces")
+        gang = self._gang_wave if self._gang_wave else None
+        chunk = min(self.chunk, max(len(pending), 1))
+        if self._can_stream_commit():
+            committer = _WaveCommitter(self, cw.node_table.names, pending,
+                                       gang=gang, lazy=self._wave_lazy_ok())
+            try:
+                with TRACER.span("replay_and_decode_stream",
+                                 pods=len(pending), nodes=n_nodes,
+                                 mode="speculative") as sp:
+                    committer.parent_span = sp.id
+                    rr, _stats = replay_speculative_stream(
+                        cw, mesh, chunk=chunk, unroll=self.unroll,
+                        pods=pending, namespaces=namespaces,
+                        on_chunk=committer.on_chunk,
+                        device_resident=(
+                            committer.lazy
+                            and self._effective_residency() == 0),
+                        gang=gang, ignore=ignore)
+            except BaseException as e:
+                # abort BEFORE reading the watermark: committed chunks
+                # stand, queued chunks drop — then hand the failure
+                # protocol the settled commit boundary so only the
+                # suffix retries (same shape as the scan stream)
+                committer.abort()
+                raise _WaveAbort(e, pending[committer._upto:],
+                                 committer.n_bound,
+                                 "speculative_replay") from e
+            try:
+                result = committer.finish()
+            except BaseException as e:
+                raise _WaveAbort(e, pending[committer._upto:],
+                                 committer.n_bound, "commit_stream") from e
+            self._record_attribution(rr, sp.seconds,
+                                     att=committer.attribution())
+            return result
+        # sequential-commit shell (pipeline_commit=False, postfilter
+        # preemption, plugin-extender observers): run the stream without
+        # the worker, commit through the shared post-pass.  Eager waves
+        # decode chunk-by-chunk DURING the stream — the pooled chunk
+        # decoder overlapped with later rounds — never one whole-wave
+        # decode_chunk_into(0, P) call on the commit thread
+        lazy = self._wave_lazy_ok()
+        all_annotations = None
+        on_chunk = None
+        if not lazy:
+            all_annotations = [None] * len(pending)
+
+            def on_chunk(rr_, lo, hi):
+                decode_chunk_into(rr_, lo, hi, all_annotations)
+
+        def _spec_replay():
+            with TRACER.span("replay_and_decode_stream", pods=len(pending),
+                             nodes=n_nodes, mode="speculative") as sp:
+                rr, _stats = replay_speculative_stream(
+                    cw, mesh, chunk=chunk, unroll=self.unroll,
+                    pods=pending, namespaces=namespaces, on_chunk=on_chunk,
+                    device_resident=(lazy
+                                     and self._effective_residency() == 0),
+                    gang=gang, ignore=ignore)
+            return rr, sp.seconds
+
+        rr, spec_seconds = self._guarded_replay(
+            "speculative_replay", pending, _spec_replay)
+        self._record_attribution(rr, spec_seconds)
+        if lazy:
+            from ..store.lazy import LazyWave
+
+            return self._finish_wave(
+                cw, rr, None, pending, exclude,
+                lazy_wave=LazyWave(rr, len(pending), sealed=True))
         return self._finish_wave(cw, rr, all_annotations, pending, exclude)
 
     def _wave_lazy_ok(self) -> bool:

@@ -174,6 +174,55 @@ def make_pods(
     return pods
 
 
+SLOT_LABEL = "kss.simulator/slot"
+
+
+def make_slot_pinned_workload(
+    n_pods: int,
+    n_nodes: int,
+    seed: int = 0,
+    slot_size: int = 2,
+) -> tuple[list[dict], list[dict]]:
+    """Reserved-slot DL fleet: nodes partition into slots of `slot_size`
+    and every pod carries a REQUIRED nodeAffinity pin to one slot —
+    the Tesserae-style placement shape where each job owns a reserved
+    node group (PAPERS.md).  Feasibility is SPARSE (slot_size nodes per
+    pod) and pods of different slots never interact, which makes this
+    the low-contention headline scenario for the speculative wave
+    (`make bench-spec`): the conflict oracle accepts near-whole batches,
+    so the wave runs in ~ceil(P/B) device steps.  Scoring stays real:
+    slot_size > 1 keeps feasible_count above the single-node early-out.
+    -> (nodes, pods)."""
+    nodes = make_nodes(n_nodes, seed=seed)
+    n_slots = max(n_nodes // max(slot_size, 1), 1)
+    for i, node in enumerate(nodes):
+        node["metadata"]["labels"][SLOT_LABEL] = f"slot-{i % n_slots}"
+    rng = np.random.default_rng(seed + 1)
+    pods = []
+    for i in range(n_pods):
+        cpu = int(rng.choice([100, 250, 500]))
+        pods.append({
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": f"slot-pod-{i:05d}", "namespace": "default",
+                         "labels": {"app": f"job-{i % n_slots}"}},
+            "spec": {
+                "containers": [{
+                    "name": "main",
+                    "image": "registry.k8s.io/pause:3.9",
+                    "resources": {"requests": {"cpu": f"{cpu}m",
+                                               "memory": str(256 << 20)}},
+                }],
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchExpressions": [{
+                            "key": SLOT_LABEL, "operator": "In",
+                            "values": [f"slot-{i % n_slots}"]}]}]}}},
+            },
+        })
+    return nodes, pods
+
+
 def make_gang_workload(
     n_groups: int,
     members: int,

@@ -1,14 +1,17 @@
-"""Speculative pod-batch scheduling over the "dp" mesh axis.
+"""Speculative pod-batch scheduling: the engine's default wave.
 
 The scan replay is sequential-exact: each pod's evaluation sees every
-earlier bind.  This module adds the dp-axis execution mode the mesh
-design reserves for it (parallel/mesh.py axes doc): evaluate a BATCH of
-pending pods against one frozen carry — vmap over the batch, batch axis
-sharded over "dp", node axis over "nodes" — then commit the longest
-prefix of the batch that is provably unaffected by the binds accepted
-before it, and repeat.  Wall-clock drops because the per-pod [N] vector
-work becomes [B, N] tensor work (MXU-friendly) fanned across dp shards,
-while results stay BIT-IDENTICAL to the sequential scan.
+earlier bind.  With decode (lazy materialization) and bulk D2H
+(device-resident results) off the critical path, that pod-at-a-time
+device scan IS the wave — so this module batches it: evaluate a BATCH of
+B pending pods against one frozen carry (vmap over the batch; on a mesh
+the batch axis shards over "dp" and the node axis over "nodes"), let a
+CONFLICT ORACLE accept the longest provably non-interfering prefix,
+fold the accepted binds into the carry in one device call, and roll the
+rejected suffix into the next round re-scored against the updated
+carry.  Wall-clock drops because the per-pod [N] vector work becomes
+[B, N] tensor work — a contention-free queue needs ~ceil(P/B) device
+steps instead of P — while results stay BIT-IDENTICAL to the scan.
 
 Exactness argument (why the accepted prefix is sequential-parity).  Two
 acceptance rules compose:
@@ -20,7 +23,11 @@ acceptance rules compose:
   and NodePorts infeasibility are monotone in that state, so they stay
   infeasible; all other nodes' node-local state is untouched, so k's
   feasible set, raw scores on it, the feasible-set-wide normalization,
-  and the argmax tie-break are identical to the sequential run.
+  and the argmax tie-break are identical to the sequential run.  (The
+  tie-break itself is pinned: both the scan and the vmapped batch select
+  with the same integer-score argmax, whose first-max-index rule is
+  deterministic — score ties therefore bind identically on both paths,
+  and the golden suite gates them explicitly.)
 * INTERACTION rule (label-coupled plugins, LABEL_COUPLED): a bound pod j
   perturbs k's PodTopologySpread / InterPodAffinity inputs only when j
   matches a selector k reads (k's constraint selectors / terms) or k
@@ -30,36 +37,86 @@ acceptance rules compose:
   equals the sequential state.
 
 The first pod of every round is unconditionally safe, so each round
-commits >= 1 pod and the loop terminates.  Where the win comes from:
+commits >= 1 pod and the loop terminates.  The dirty-node test runs ON
+DEVICE (a [B, B] feasibility-at-selected-nodes gather; only the prefix
+length and the per-pod decision rows cross to host), the interaction
+walk on host over the pod manifests.  Where the win comes from:
 acceptance is long exactly when feasibility is SPARSE (taints, affinity
-pins, zone constraints, tight fit — i.e. realistic clusters); in a fully
-relaxed cluster where every pod fits everywhere, the dirty-node rule
-cuts every batch at 1 and the path degrades gracefully to ~scan cost.
-That conservatism is not incidental: byte-exact annotations require that
-NO feasible node's score inputs changed (normalization ranges over the
-whole feasible set), so any relaxation of the rule would break the
-bit-parity contract, not just the selection.  Commit: core-only plugin sets
-fold all accepted binds in one scatter-add; sets with ports/topology/
-interpod carries fold the pipeline's own _bind_phase over the batch
-(non-accepted selections masked to -1, a no-op bind) — the same carry
-math as the scan.  The volume family stays excluded (PV/PVC bind state
-is cluster-wide and not label-gated), as do custom plugins and
-extenders; those fall back to the scan path.  Parity — including full
-annotation bytes for the headline configs 4 and 5 — is asserted by
-tests/test_speculative.py against the scan and the sequential oracle.
+pins, zone constraints, tight fit — i.e. realistic packed clusters).
+In a fully relaxed cluster where every pod fits everywhere the rule
+cuts every batch at ~1 — so a CONTENTION-AWARE controller watches the
+observed accept rate: full-accept rounds climb the batch ladder,
+heavily-cut rounds step it down, and a sustained accept collapse at the
+bottom rung FALLS BACK to the sequential chunked scan for the rest of
+the wave (the same jitted scan the non-speculative path runs, resumed
+from the speculative carry — which is bit-identical to the sequential
+carry at that pod by the argument above).  That conservatism is not
+incidental: byte-exact annotations require that NO feasible node's
+score inputs changed (normalization ranges over the whole feasible
+set), so any relaxation of the rule would break the bit-parity
+contract, not just the selection.
+
+Streaming (docs/wave-pipeline.md speculative-wave stage): results are
+accumulated ON DEVICE into the same fixed-size compact chunk grid the
+scan emits (`_CompactChunks`), and every filled chunk is delivered
+through the standard `on_chunk(rr, lo, hi)` contract — ascending,
+contiguous, idempotent under width-tier re-delivery — so the pipelined
+commit worker, lazy decode, device residency (chunks retain as live
+device arrays under the HBM budget), gang-cut watermarks and the wave
+failure protocol's uncommitted-suffix retry all compose unchanged: a
+round is just (part of) a chunk.  Gangs compose as all-or-nothing
+prefix units: the acceptance cut pulls back to the gang boundary
+(framework/gang.py `aligned_cut`) so a round never splits a gang it
+could defer whole, and admission itself stays with the vectorized
+segment-reduction quorum at commit.
+
+Commit: core-only plugin sets fold all accepted binds in one
+scatter-add; sets with ports/topology/interpod carries fold the
+pipeline's own _bind_phase over the batch (non-accepted selections
+masked to -1, a no-op bind) — the same carry math as the scan.  The
+volume family stays excluded (PV/PVC bind state is cluster-wide and not
+label-gated), as do custom plugins (except the engine's vectorized gang
+plugin, which the caller names in `ignore`) and extenders; those fall
+back to the scan path.  Parity — full annotation bytes, bind order,
+parked gangs — is asserted by tests/test_speculative.py against the
+scan and the sequential oracle, and by the engine golden suite.
+
+For node-local plugin sets the eval splits into a dense FILTER phase
+(annotation parity needs every node's first-fail code) and a SPARSE
+score/normalize/select tail computed only on the gathered
+feasible-candidate rows (KSS_TPU_SPECULATIVE_CANDIDATES) — at sparse
+feasibility the scoring work drops from [B, N] to [B, K], which is
+where the measured raw-speed win over the scan lives on
+throughput-bound backends.  Raw values at infeasible positions are
+don't-cares by the compact layout (decode, hostnorm and attribution
+read feasible positions only).
+
+Env knobs (docs/environment-variables.md): KSS_TPU_SPECULATIVE=0
+disables the engine default; KSS_TPU_SPECULATIVE_BATCH pins the batch
+(one rung); KSS_TPU_SPECULATIVE_CANDIDATES caps the sparse tail's
+candidate set; KSS_TPU_SPECULATIVE_MIN_ACCEPT /
+KSS_TPU_SPECULATIVE_FALLBACK_ROUNDS tune the scan-fallback trigger;
+KSS_TPU_SPECULATIVE_TILE sizes the CPU backend's cache-tiled vmap.
 """
 
 from __future__ import annotations
 
+import os
+from types import SimpleNamespace
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.replay import ReplayResult
+from ..framework.replay import (
+    ReplayResult, _CompactChunks, _compact_plan, _DeviceAttribution,
+    _DEVICE_BUDGET, _resolve_device_resident, _scan_for, _SCAN_CACHE,
+    _slice_xs, _SlimWorkload, _workload_scan_key)
 from ..state.compile import CompiledWorkload
-from .mesh import speculative_scores
+from ..utils.env import env_float, env_int
+from ..utils.faults import fault_point
+from ..utils.tracing import TRACER
 
 # per-node plugins with no cross-pod coupling: filters are static or
 # monotone in node allocation, scores depend only on the node's own
@@ -82,15 +139,20 @@ SAFE_SPECULATIVE = {
 LABEL_COUPLED = {"PodTopologySpread", "InterPodAffinity"}
 
 
-def speculation_ok(cfg, have_manifests: bool = True) -> bool:
+def speculation_ok(cfg, have_manifests: bool = True,
+                   ignore: frozenset | set = frozenset()) -> bool:
     """True when the ACTIVE plugin set (enabled list plus every per-point
     override — point_enabled can add a plugin cfg.enabled never lists)
     admits exact speculative batching.  Label-coupled plugins require the
     pod manifests (for the interaction rule); without them only the
-    node-local class qualifies."""
-    if cfg.custom:
+    node-local class qualifies.  `ignore` names plugins the CALLER
+    handles outside the device pipeline this wave — the engine passes
+    its vectorized gang plugin, whose PreFilter ran in the prescreen and
+    whose admission happens at commit, so it neither filters nor scores
+    on device."""
+    active = set(cfg.active_plugins()) - set(ignore)
+    if any(cfg.is_custom(n) for n in active):
         return False
-    active = set(cfg.active_plugins())
     if active <= SAFE_SPECULATIVE:
         return True
     return have_manifests and active <= (SAFE_SPECULATIVE | LABEL_COUPLED)
@@ -167,191 +229,836 @@ class _InteractionOracle:
                 or _matches_any(j_writes, self.pods[k]))
 
 
-def _accept_prefix(feasible: np.ndarray, selected: np.ndarray,
-                   inter: _InteractionOracle | None = None,
-                   base: int = 0) -> int:
-    """Longest non-interfering prefix: pod k is accepted iff every node
-    bound by earlier-accepted pods is infeasible for k AND (when
-    label-coupled plugins are active) no earlier-accepted pod interacts
-    with k's spread/interpod selectors (see module doc).
-    feasible: [B, N] bool (speculative), selected: [B] int32; base is the
-    batch's first absolute pod index (the interaction oracle's space)."""
-    b = selected.shape[0]
-    dirty: list[int] = []
-    bound: list[int] = []  # accepted pods that actually bound (only a
-    for k in range(b):     # BIND can perturb later pods' state)
-        if dirty and feasible[k, dirty].any():
-            return k
-        if inter is not None and any(
-                inter.interacts(j, base + k) for j in bound):
-            return k
-        s = int(selected[k])
-        if s >= 0:
-            dirty.append(s)
-            bound.append(base + k)
-    return b
+def _interaction_cut(inter: _InteractionOracle, selected: np.ndarray,
+                     base: int, k: int) -> int:
+    """Shrink the dirty-node-accepted prefix [0, k) to the longest
+    prefix with no label-coupled interaction: pod i is kept only when
+    no earlier-kept BOUND pod interacts with it either way (module
+    doc).  `base` is the batch's first absolute pod index (the
+    oracle's index space)."""
+    bound: list[int] = []
+    for i in range(k):
+        if bound and any(inter.interacts(j, base + i) for j in bound):
+            return i
+        if int(selected[i]) >= 0:
+            bound.append(base + i)
+    return k
 
 
-# plugins whose bind mutates ONLY carry["core"] — eligible for the
-# one-scatter commit; anything else (NodePorts port occupancy, TSP domain
-# counts, interpod term counts) goes through the bind-phase scan commit
-_CORE_ONLY_CARRY = SAFE_SPECULATIVE - {"NodePorts"}
+# ------------------------------------------------------ compiled pieces
+
+def _spec_tile(batch: int) -> int:
+    """Sub-batch tile for the vmapped evals: on the CPU backend a flat
+    [B, N, ...] vmap materializes cache-hostile intermediates (the
+    scan's [N]-sized working set is why the sequential path is already
+    throughput-bound there), so the batch evaluates in lax.map tiles
+    whose per-op footprint stays cache-sized — measured ~1.6x on the
+    2-core geometry.  On accelerators the flat vmap is the MXU-friendly
+    layout and tiling would serialize, so it stays off.  Rungs are
+    powers-of-two multiples of 8, so the default 32 always divides."""
+    tile = env_int("KSS_TPU_SPECULATIVE_TILE",
+                   32 if jax.default_backend() == "cpu" else 0)
+    if tile <= 0 or batch <= tile or batch % tile:
+        return 0
+    return tile
 
 
-def _batch_commit_fn(cw: CompiledWorkload):
-    """jitted (carry, xs_batch, selected, accept) -> carry with every
-    accepted bind applied in one scatter-add.  Core-only workloads only
-    mutate carry["core"] on bind (pipeline._bind_phase), and accepted
-    pods bind distinct nodes, so one batched scatter == the sequential
-    fold of core_bind_update."""
+def _tiled_vmap(fn, batch: int, in_axes):
+    """vmap `fn` over the batch axis, evaluated in sub-batch tiles when
+    _spec_tile says so.  Axis-None args are closed over; axis-0 args
+    reshape to [tiles, tile, ...] and lax.map walks the tiles."""
+    vm = jax.vmap(fn, in_axes=in_axes)
+    tile = _spec_tile(batch)
+    if not tile:
+        return vm
+    mapped_pos = [i for i, ax in enumerate(in_axes) if ax == 0]
 
-    def commit(carry, xs_batch, selected, accept):
-        core_batch = xs_batch["core"]
-        core = carry["core"]
-        bound = accept & (selected >= 0)
-        idx = jnp.maximum(selected, 0)
-        add = jnp.where(bound, 1, 0)
-        requested = core.requested.at[idx].add(
-            core_batch.requests * add[:, None].astype(core.requested.dtype))
-        nonzero = core.nonzero.at[idx].add(
-            core_batch.nonzero * add[:, None].astype(core.nonzero.dtype))
-        num_pods = core.num_pods.at[idx].add(add.astype(core.num_pods.dtype))
-        out = dict(carry)
-        out["core"] = core._replace(
-            requested=requested, nonzero=nonzero, num_pods=num_pods)
-        return out
+    def run(*args):
+        subs = tuple(
+            jax.tree.map(
+                lambda x: x.reshape((batch // tile, tile) + x.shape[1:]),
+                args[i])
+            for i in mapped_pos)
 
-    return jax.jit(commit, donate_argnums=(0,))
+        def body(sub_tuple):
+            call = list(args)
+            for j, i in enumerate(mapped_pos):
+                call[i] = sub_tuple[j]
+            return vm(*call)
+
+        out = jax.lax.map(body, subs)
+        return jax.tree.map(
+            lambda x: x.reshape((batch,) + x.shape[2:]), out)
+
+    return run
 
 
-def _bind_scan_commit_fn(cw: CompiledWorkload):
-    """jitted commit for workloads with non-core carries: fold the
-    pipeline's own _bind_phase over the batch with non-accepted pods'
-    selections masked to -1 (a no-op bind) — exactly the sequential
-    carry fold, so every plugin carry (ports, topology counts, interpod
-    terms) advances identically to the scan path."""
-    from ..framework.pipeline import _bind_phase
+def _oracle_core(packed, prefilter_reject, selected, batch: int):
+    """The dirty-node prefix length on device: feasibility comes from
+    the packed first-fail word (0 == all filter plugins passed), the
+    conflict test gathers each pod's feasibility AT every earlier pod's
+    selected node ([B, B], not [B, N]), and only the prefix length K
+    crosses to host.  Pad rows sit past the real rows (selected == -1,
+    never bound), so a pad conflict can only push K past them — the
+    caller clamps to the round's real size."""
+    feas = (packed == 0) & (prefilter_reject == 0)[:, None]
+    bound = selected >= 0                           # [B]
+    cols = jnp.maximum(selected, 0)
+    feas_at_sel = jnp.take(feas, cols, axis=1)      # [B(k), B(j)]
+    before = jnp.tril(jnp.ones((batch, batch), bool), k=-1)
+    conflict = jnp.any(feas_at_sel & bound[None, :] & before,
+                       axis=1)                      # [B]
+    return jnp.where(jnp.any(conflict), jnp.argmax(conflict),
+                     jnp.int32(batch)).astype(jnp.int32)
 
-    def commit(carry, xs_batch, selected, accept):
-        sel = jnp.where(accept, selected, jnp.int32(-1))
 
-        def body(c, t):
-            sl, s = t
-            return _bind_phase(cw, c, sl, s), None
+def _eval_fn(cw: CompiledWorkload, base_key, batch: int, pack_mode: str,
+             score_dtypes: tuple, wide, mesh):
+    """Cached jitted vmapped compact step — the DENSE eval: full
+    per-node scoring for every pod, used for label-coupled plugin sets
+    and as the wide-feasibility fallback of the sparse eval.  Shares
+    the process-level scan-cache registry, so concurrent sessions
+    serving the same workload shape compile each rung once."""
+    from ..framework.pipeline import build_step
 
-        out, _ = jax.lax.scan(body, carry, (xs_batch, sel))
-        return out
+    # key on the tier STRING (None / "i32" / "i64"): build_step's
+    # overflow branches and the raw32 dtype test it literally, and the
+    # i32/i64 tiers must not alias to one compiled fn
+    key = ("spec_eval", base_key, batch, pack_mode, score_dtypes, wide,
+           _spec_tile(batch))
+    slim = _SlimWorkload(cw)
 
-    return jax.jit(commit, donate_argnums=(0,))
+    def build():
+        step = build_step(slim, out_mode="compact", pack_mode=pack_mode,
+                          score_dtypes=score_dtypes, wide_raw=wide)
+
+        def eval_only(carry, sl):
+            _, out = step(carry, sl)
+            return out
+
+        return jax.jit(_tiled_vmap(eval_only, batch, (None, 0)))
+
+    return _SCAN_CACHE.get_or_build(key, build)
+
+
+def _oracle_fn(batch: int, n: int, pack_mode: str):
+    """Cached jitted standalone oracle (the dense eval path; the sparse
+    tail fuses _oracle_core into its own jit)."""
+    key = ("spec_oracle", batch, n, pack_mode)
+
+    def build():
+        def oracle(packed, prefilter_reject, selected):
+            return _oracle_core(packed, prefilter_reject, selected, batch)
+
+        return jax.jit(oracle)
+
+    return _SCAN_CACHE.get_or_build(key, build)
+
+
+# sparse scoring is exact only for plugins whose node-axis statics/xs
+# rows are accessed POSITIONALLY (gathering candidate rows keeps every
+# read identical); label-coupled plugins index domain tables by VALUE
+# (counts[dom_idx[n]]), so they take the dense eval instead
+def _sparse_ok(active: set) -> bool:
+    return active <= SAFE_SPECULATIVE
+
+
+def _take_nodes(x, idx, n: int):
+    """Gather candidate rows along a leaf's node axis (first axis whose
+    extent == n; leaves without one pass through) — the same node-axis
+    identification rule parallel/mesh.py shards by."""
+    if not hasattr(x, "ndim"):
+        return x
+    for ax in range(x.ndim):
+        if x.shape[ax] == n:
+            return jnp.take(x, idx, axis=ax)
+    return x
+
+
+def _sparse_round_fn(cw: CompiledWorkload, base_key, batch: int,
+                     pack_mode: str, score_dtypes: tuple, wide, kcand: int):
+    """Cached jitted sparse-eval round — ONE fused per-pod pass (each
+    pod's [N]-sized intermediates stay cache-hot) plus the batch-level
+    conflict oracle:
+
+      1. DENSE filters (annotation parity needs every node's first-fail
+         code), packed to the compact word, plus the prefilter reject
+         and the feasible count;
+      2. the first-kcand feasible node indices in ascending node order
+         (the argmax tie-break's order): candidate c is the first index
+         whose running feasible count reaches c+1 — a binary search
+         over the cumsum, O(K log N), where lax.top_k costs a per-row
+         partial sort (measured ~25x slower at 5k nodes on the CPU
+         backend) and a scatter formulation lowers poorly there too;
+      3. score, normalize and select on the GATHERED candidate rows
+         only ([K] instead of [N] — at sparse feasibility this is where
+         the speculative wave's raw-speed win lives), scattering the
+         raw score columns back onto the dense compact grid (values at
+         infeasible nodes are don't-cares by the compact layout:
+         decode, hostnorm and attribution all read feasible positions
+         only);
+      4. the dirty-node oracle over the whole batch's selections.
+
+    Exactness: every normalization reduces over the FEASIBLE set, which
+    the candidate gather preserves exactly (candidates ⊇ feasible when
+    max count <= kcand — the caller falls back to the dense eval
+    otherwise), and argmax over candidates in ascending node order
+    reproduces the dense first-max tie-break."""
+    from ..framework.pipeline import (_filter_phase, _prefilter_reject,
+                                      _score_phase, pack_filter_codes)
+
+    key = ("spec_round", base_key, batch, pack_mode, score_dtypes,
+           wide, kcand, _spec_tile(batch))
+    score_names = cw.config.scorers()
+    filter_names = cw.config.filters()
+    weights = jnp.asarray([cw.config.weight(nm) for nm in score_names],
+                          dtype=jnp.int64)
+    slim = _SlimWorkload(cw)
+    n = cw.n_nodes
+
+    def build():
+        def one(carry, sl):
+            codes, feasible = _filter_phase(slim, carry, sl, filter_names)
+            packed = pack_filter_codes(codes, n, pack_mode)
+            reject = _prefilter_reject(slim, carry, sl)
+            count = jnp.sum(feasible, dtype=jnp.int32)
+            count = jnp.where(reject > 0, 0, count)
+            cum = jnp.cumsum(feasible.astype(jnp.int32))
+            cand = jnp.searchsorted(
+                cum, jnp.arange(1, kcand + 1, dtype=jnp.int32))
+            cand = jnp.minimum(cand, n - 1).astype(jnp.int32)
+            valid = jnp.arange(kcand, dtype=jnp.int32) < count
+            g_sl = jax.tree.map(lambda x: _take_nodes(x, cand, n), sl)
+            g_statics = dict(slim.statics)
+            if "core" in g_statics:
+                g_statics["core"] = jax.tree.map(
+                    lambda x: _take_nodes(x, cand, n), g_statics["core"])
+            g_carry = dict(carry)
+            if "core" in g_carry:
+                g_carry["core"] = jax.tree.map(
+                    lambda x: _take_nodes(x, cand, n), g_carry["core"])
+            view = SimpleNamespace(config=slim.config, statics=g_statics,
+                                   n_nodes=kcand, schema=slim.schema)
+            raws, _finals, total = _score_phase(
+                view, g_carry, g_sl, weights, score_names, valid)
+            sel_k = jnp.argmax(total).astype(jnp.int32)
+            selected = jnp.where(count > 0, cand[sel_k],
+                                 jnp.int32(-1)).astype(jnp.int32)
+            is_pad = g_sl.get("is_pad")
+            if is_pad is not None:
+                selected = jnp.where(is_pad, jnp.int32(-1), selected)
+            # scatter the raw columns onto the dense grid: invalid slots
+            # park in a shed column past n (duplicate indices among them
+            # never touch real nodes), sliced off below
+            park = jnp.where(valid, cand, jnp.int32(n))
+            groups: dict[str, list] = {"i8": [], "i16": [], "i32": []}
+            for s in range(len(score_names)):
+                g = score_dtypes[s]
+                if g == "host":
+                    continue
+                g = "i32" if wide else g
+                groups[g].append(raws[s])
+
+            def scatter(rows, dtype):
+                if not rows:
+                    return jnp.zeros((0, n), dtype=dtype)
+                vals = jnp.stack(rows).astype(dtype)       # [Sg, K]
+                buf = jnp.zeros((vals.shape[0], n + 1), dtype)
+                return buf.at[:, park].set(vals)[:, :n]
+
+            raw8 = scatter(groups["i8"], jnp.int8)
+            raw16 = scatter(groups["i16"], jnp.int16)
+            raw32 = scatter(groups["i32"],
+                            jnp.int64 if wide == "i64" else jnp.int32)
+            ovf = jnp.asarray(False)
+            if wide is None and groups["i16"]:
+                full = jnp.stack(groups["i16"])
+                ovf = jnp.any(valid[None, :]
+                              & (full != full.astype(jnp.int16)
+                                 .astype(full.dtype)))
+            elif wide == "i32" and groups["i32"]:
+                full = jnp.stack(groups["i32"])
+                ovf = jnp.any(valid[None, :]
+                              & (full != full.astype(jnp.int32)
+                                 .astype(full.dtype)))
+            return packed, reject, count, raw8, raw16, raw32, ovf, selected
+
+        def round_fn(carry, xs):
+            (packed, reject, counts, raw8, raw16, raw32, ovf,
+             selected) = _tiled_vmap(one, batch, (None, 0))(carry, xs)
+            k_dev = _oracle_core(packed, reject, selected, batch)
+            return (packed, reject, counts, raw8, raw16, raw32, ovf,
+                    selected, k_dev)
+
+        return jax.jit(round_fn)
+
+    return _SCAN_CACHE.get_or_build(key, build)
+
+
+def _commit_fn(cw: CompiledWorkload, base_key, batch: int):
+    """Cached jitted (carry, xs_batch, selected, accept) -> carry with
+    every accepted bind applied.  Core-only workloads (the carry holds
+    nothing but "core") fold all binds in ONE scatter-add — accepted
+    pods bind distinct nodes (the dirty-node rule), so one batched
+    scatter == the sequential fold of core_bind_update.  Anything with
+    ports/topology/interpod/volume carries folds the pipeline's own
+    _bind_phase over the batch with non-accepted selections masked to
+    -1 (a no-op bind) — exactly the sequential carry fold, so every
+    plugin carry advances identically to the scan path."""
+    core_only = set(cw.init_carry.keys()) <= {"core"}
+    key = ("spec_commit", base_key, batch, core_only)
+    slim = _SlimWorkload(cw)
+
+    def build():
+        if core_only:
+            def commit(carry, xs_batch, selected, accept):
+                core_batch = xs_batch["core"]
+                core = carry["core"]
+                bound = accept & (selected >= 0)
+                idx = jnp.maximum(selected, 0)
+                add = jnp.where(bound, 1, 0)
+                requested = core.requested.at[idx].add(
+                    core_batch.requests
+                    * add[:, None].astype(core.requested.dtype))
+                nonzero = core.nonzero.at[idx].add(
+                    core_batch.nonzero
+                    * add[:, None].astype(core.nonzero.dtype))
+                num_pods = core.num_pods.at[idx].add(
+                    add.astype(core.num_pods.dtype))
+                out = dict(carry)
+                out["core"] = core._replace(
+                    requested=requested, nonzero=nonzero, num_pods=num_pods)
+                return out
+        else:
+            from ..framework.pipeline import _bind_phase
+
+            def commit(carry, xs_batch, selected, accept):
+                sel = jnp.where(accept, selected, jnp.int32(-1))
+
+                def body(c, t):
+                    sl, s = t
+                    return _bind_phase(slim, c, sl, s), None
+
+                out, _ = jax.lax.scan(body, carry, (xs_batch, sel))
+                return out
+
+        return jax.jit(commit, donate_argnums=(0,))
+
+    return _SCAN_CACHE.get_or_build(key, build)
+
+
+def _accum_fns(shapes_key, chunk: int):
+    """Cached jitted chunk-grid accumulator ops over the compact group
+    buffers (dict name -> [chunk + extra, ...]):
+
+      append(bufs, rows, fill) — write a round's rows at the fill mark
+        (the caller advances fill only past the ACCEPTED prefix, so the
+        rejected suffix is overwritten by the next round);
+      emit(bufs) — split off the first grid chunk and shift the
+        remainder down (static shapes: the shift is always by `chunk`).
+    """
+    append_key = ("spec_append", shapes_key, chunk)
+    emit_key = ("spec_emit", shapes_key, chunk)
+
+    def build_append():
+        def append(bufs, rows, fill):
+            return {
+                name: jax.lax.dynamic_update_slice_in_dim(
+                    bufs[name], rows[name].astype(bufs[name].dtype), fill, 0)
+                for name in bufs
+            }
+
+        return jax.jit(append, donate_argnums=(0,))
+
+    def build_emit():
+        def emit(bufs):
+            heads = {name: bufs[name][:chunk] for name in bufs}
+            rest = {
+                name: jnp.concatenate(
+                    [bufs[name][chunk:],
+                     jnp.zeros((chunk,) + bufs[name].shape[1:],
+                               bufs[name].dtype)], axis=0)
+                for name in bufs
+            }
+            return heads, rest
+
+        return jax.jit(emit)
+
+    return (_SCAN_CACHE.get_or_build(append_key, build_append),
+            _SCAN_CACHE.get_or_build(emit_key, build_emit))
+
+
+# ------------------------------------------------------------- ladder
+
+def _batch_ladder(chunk: int, dp: int, pinned: int | None) -> list[int]:
+    """Adaptive batch rungs: dp multiples (the dp shards stay balanced)
+    growing x4 from 8*dp up to the chunk grid.  Each rung is one extra
+    jit specialization, bounded by the ladder length; a pinned batch
+    (KSS_TPU_SPECULATIVE_BATCH or an explicit batch=) is a one-rung
+    ladder."""
+    dp = max(dp, 1)
+
+    def fit(b: int) -> int:
+        b = max(b - b % dp, dp)
+        return max(min(b, max(chunk - chunk % dp, dp)), 1)
+
+    if pinned is not None:
+        return [fit(pinned)]
+    rungs: list[int] = []
+    b = 8 * dp
+    while fit(b) < fit(chunk):
+        rungs.append(fit(b))
+        b *= 4
+    rungs.append(fit(chunk))
+    # dedupe while preserving order (tiny workloads collapse rungs)
+    out: list[int] = []
+    for r in rungs:
+        if not out or r != out[-1]:
+            out.append(r)
+    return out
+
+
+# ------------------------------------------------------------- stream
+
+class _SpecStats:
+    """Per-stream tallies; the final tier's numbers are the wave's."""
+
+    def __init__(self):
+        self.rounds: list[tuple[int, int]] = []   # (accepted, round size)
+        self.scan_pods = 0
+        self.fallback_at: int | None = None
+        self.final_batch = 0
+
+    def as_dict(self, adaptive: bool) -> dict:
+        accepts = [k for k, _ in self.rounds]
+        total = sum(accepts)
+        rolled = sum(m - k for k, m in self.rounds)
+        return {
+            "rounds": len(self.rounds),
+            "batch": self.final_batch,
+            "adaptive": adaptive,
+            "round_batches": [m for _, m in self.rounds],
+            "mean_accept": round(float(np.mean(accepts)), 2) if accepts else 0,
+            "accepted_first_try": int(sum(k == m for k, m in self.rounds)),
+            "accepted": total,
+            "rolled_back": rolled,
+            "accept_rate": round(total / (total + rolled), 4)
+                if total + rolled else None,
+            "fallback_at": self.fallback_at,
+            "scan_pods": self.scan_pods,
+        }
+
+
+def replay_speculative_stream(
+        cw: CompiledWorkload, mesh=None, chunk: int = 512, unroll: int = 1,
+        batch: int | None = None, pods: list[dict] | None = None,
+        namespaces: list[dict] | None = None, on_chunk=None,
+        device_resident: bool | None = None, gang=None,
+        scan_fallback: bool = True, ignore: frozenset | set = frozenset(),
+) -> tuple[ReplayResult, dict]:
+    """Schedule the whole queue in streaming speculative rounds (module
+    doc).  Same consumer contract as framework.replay.replay(): compact
+    chunk-grid results, on_chunk(rr, lo, hi) in ascending contiguous
+    order with idempotent re-delivery from chunk 0 on a width-tier
+    overflow, device residency resolved exactly like the scan.
+
+    pods: the pod manifests, required when label-coupled plugins
+    (PodTopologySpread / InterPodAffinity) are active — the interaction
+    rule reads their selectors.  namespaces: the namespace manifests for
+    interpod namespaceSelector resolution.  gang: an object with `gid`
+    ([P] int32 pod->group, -1 for plain pods) and `start` ([G] first
+    member index) — round cuts pull back to gang boundaries so gangs
+    stream as all-or-nothing prefix units.
+
+    Returns (rr, stats): rr is bit-identical to replay(cw) / the
+    sequential oracle; stats records rounds, acceptance and fallback.
+    Caller must have checked speculation_ok(cw.config, ...)."""
+    device_resident = _resolve_device_resident(device_resident, True,
+                                               on_chunk)
+    active = set(cw.config.active_plugins())
+    inter: _InteractionOracle | None = None
+    if active & LABEL_COUPLED:
+        if pods is None:
+            raise ValueError(
+                "label-coupled plugins active: the speculative stream needs "
+                "the pod manifests for the interaction rule")
+        inter = _InteractionOracle(pods, namespaces)
+
+    if batch is None:
+        raw = os.environ.get("KSS_TPU_SPECULATIVE_BATCH")
+        if raw:
+            batch = env_int("KSS_TPU_SPECULATIVE_BATCH", 0) or None
+
+    tiers = (("i64",) if "i64" in cw.host.get("score_dtypes", ())
+             else (None, "i32", "i64"))
+    for wide in tiers:
+        result = _spec_run(cw, mesh, chunk, unroll, batch, on_chunk,
+                           device_resident, wide, inter, gang, scan_fallback,
+                           ignore)
+        if result is not None:
+            return result
+        TRACER.count("replay_width_retries_total")
+    raise AssertionError("unreachable: i64 speculative replay cannot overflow")
+
+
+def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
+              batch: int | None, on_chunk, device_resident: bool,
+              wide, inter, gang, scan_fallback: bool,
+              ignore: frozenset | set = frozenset(),
+              ) -> tuple[ReplayResult, dict] | None:
+    from ..framework.gang import aligned_cut
+    from .mesh import gather_to_host
+
+    p = cw.n_pods
+    chunk = min(chunk, max(p, 1))
+    pack_mode, score_dtypes, score_cols = _compact_plan(cw, wide)
+    base_key = _workload_scan_key(cw, chunk, mesh)
+    dp = mesh.shape.get("dp", 1) if mesh is not None else 1
+    ladder = _batch_ladder(chunk, dp, batch)
+    adaptive = batch is None and len(ladder) > 1
+    rung = 0
+    min_accept = env_float("KSS_TPU_SPECULATIVE_MIN_ACCEPT", 0.25)
+    fallback_rounds = (env_int("KSS_TPU_SPECULATIVE_FALLBACK_ROUNDS", 3)
+                       if scan_fallback else 0)
+    check_overflow = wide != "i64"
+
+    n = cw.n_nodes
+    compact = _CompactChunks(
+        packed=[], raw8=[], raw16=[], raw32=[],
+        chunk=chunk, pack_mode=pack_mode, score_cols=score_cols,
+    )
+    selected = np.full(p, -1, dtype=np.int32)
+    feasible_count = np.zeros(p, dtype=np.int32)
+    prefilter_reject = np.zeros(p, dtype=np.int32)
+    rr = ReplayResult(cw=cw, selected=selected,
+                      feasible_count=feasible_count,
+                      prefilter_reject=prefilter_reject, compact=compact)
+
+    # device-side chunk-grid accumulator: group buffers big enough for
+    # one grid chunk plus the largest single append (a top-rung round or
+    # a fallback scan chunk)
+    from ..framework.pipeline import PACK_MODES
+
+    extra = max(chunk, max(ladder))
+    n8, n16, n32 = 0, 0, 0
+    for g, _r in score_cols:
+        n8 += g == "raw8"
+        n16 += g == "raw16"
+        n32 += g == "raw32"
+    pack_dtype = PACK_MODES[pack_mode][0]
+    buf_shapes = {
+        "packed": ((chunk + extra, n), pack_dtype),
+        "raw8": ((chunk + extra, n8, n), jnp.int8),
+        "raw16": ((chunk + extra, n16, n), jnp.int16),
+        # the i64 tier's raw32 group IS int64 (the ladder's last rung
+        # cannot overflow) — the buffers must not truncate it
+        "raw32": ((chunk + extra, n32, n),
+                  jnp.int64 if wide == "i64" else jnp.int32),
+        "fc": ((chunk + extra,), jnp.int32),
+    }
+    shapes_key = tuple(sorted((k, tuple(s), str(d))
+                              for k, (s, d) in buf_shapes.items()))
+    append_jit, emit_jit = _accum_fns(shapes_key, chunk)
+    bufs = {name: jnp.zeros(s, d) for name, (s, d) in buf_shapes.items()}
+    fill = 0
+
+    att_ctx = (_DeviceAttribution(cw, chunk, pack_mode, score_cols)
+               if device_resident else None)
+    if att_ctx is not None and not att_ctx.enabled:
+        att_ctx = None
+
+    # single-core CPU backend: XLA's worker threads spin-wait between
+    # device calls and starve a concurrent on_chunk consumer — defer the
+    # callbacks until the stream has fully drained (same rule as the
+    # scan path's dispatch loop)
+    from ..utils.platform import effective_cpu_count
+
+    defer_chunks: list[tuple[int, int]] | None = (
+        [] if on_chunk is not None and jax.default_backend() == "cpu"
+        and effective_cpu_count() < 2 else None)
+
+    def deliver(lo_c: int, hi_c: int) -> None:
+        if on_chunk is None:
+            return
+        if defer_chunks is not None:
+            defer_chunks.append((lo_c, hi_c))
+        else:
+            on_chunk(rr, lo_c, hi_c)
+
+    group_of = {"packed": "packed", "raw8": "raw8", "raw16": "raw16",
+                "raw32": "raw32"}
+
+    def ingest_chunk(heads: dict) -> None:
+        """Land one grid chunk (group name -> [chunk, ...] device
+        arrays) in the compact result: retain on device (budgeted, with
+        the jit'd attribution sums) or fetch to host, then deliver it
+        to the streaming consumer."""
+        ci = len(compact.packed)
+        lo_c = ci * chunk
+        hi_c = min(lo_c + chunk, p)
+        att_host = None
+        if device_resident:
+            if att_ctx is not None:
+                out_like = SimpleNamespace(
+                    packed_filter=heads["packed"], raw8=heads["raw8"],
+                    raw16=heads["raw16"], raw32=heads["raw32"],
+                    feasible_count=heads["fc"])
+                att_dev = att_ctx.run(out_like, lo_c)
+                att_host = {k: np.asarray(v) for k, v in att_dev.items()}
+                TRACER.count("wave_d2h_bytes_total",
+                             sum(a.nbytes for a in att_host.values()))
+            for name, group in group_of.items():
+                getattr(compact, group).append(heads[name])
+            _DEVICE_BUDGET.retain(compact, ci, compact.device_nbytes(ci))
+        else:
+            nbytes = 0
+            for name, group in group_of.items():
+                host = gather_to_host(heads[name])
+                nbytes += host.nbytes
+                getattr(compact, group).append(host)
+            TRACER.count("wave_d2h_bytes_total", nbytes)
+        compact.att.append(att_host)
+        deliver(lo_c, hi_c)
+
+    def emit_chunk() -> None:
+        nonlocal bufs, fill
+        heads, bufs = emit_jit(bufs)
+        fill -= chunk
+        ingest_chunk(heads)
+
+    # copy: the commit/scan fold donates its carry argument, and
+    # cw.init_carry must survive for later replays of the same workload
+    carry = jax.tree.map(jnp.array, cw.init_carry)
+    stats = _SpecStats()
+    cw_scan = None       # mesh-sharded clone, built on first scan round
+    scan_jit = None
+    mode = "speculative"
+    low_streak = 0
+    # sparse-tail eligibility (docs/wave-pipeline.md): node-local plugin
+    # sets score/select on the gathered candidate rows only — the raw-
+    # speed win at sparse feasibility; label-coupled sets (value-indexed
+    # domain tables) and wide-feasibility rounds run the dense eval
+    active_eff = set(cw.config.active_plugins()) - set(ignore)
+    kcand = min(max(env_int("KSS_TPU_SPECULATIVE_CANDIDATES", 128), 1), n)
+    sparse = _sparse_ok(active_eff) and kcand < n
+    if sparse and adaptive:
+        # sparse probes are cheap (dense filters + candidate tail), so
+        # start at the TOP rung: a contention-free wave's steady-state
+        # rounds are then whole aligned chunks ingested directly (no
+        # accumulator passes); a collapse steps the ladder down round
+        # by round and the bottom-rung fallback still engages.  The
+        # dense eval keeps the climb-from-8 ramp — its probes cost a
+        # full [B, N] evaluation
+        rung = len(ladder) - 1
+
+    # per-rung compiled pieces, resolved from the process cache once per
+    # stream instead of per round
+    _fns: dict[tuple, Any] = {}
+
+    def _memo(kind: str, b: int, make):
+        got = _fns.get((kind, b))
+        if got is None:
+            got = _fns[(kind, b)] = make()
+        return got
+
+    def eval_for(b):
+        return _memo("eval", b, lambda: _eval_fn(
+            cw, base_key, b, pack_mode, score_dtypes, wide, mesh))
+
+    def oracle_for(b):
+        return _memo("oracle", b, lambda: _oracle_fn(b, n, pack_mode))
+
+    def commit_for(b):
+        return _memo("commit", b, lambda: _commit_fn(cw, base_key, b))
+
+    def round_for(b):
+        return _memo("round", b, lambda: _sparse_round_fn(
+            cw, base_key, b, pack_mode, score_dtypes, wide, kcand))
+
+    def place_batch(xs_batch):
+        if mesh is None:
+            return xs_batch
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .mesh import _node_axis_spec
+
+        def place(x):
+            if not hasattr(x, "ndim") or x.ndim == 0:
+                return x
+            inner = _node_axis_spec(x[0], n, skip_leading=False)
+            return jax.device_put(x, NamedSharding(mesh, P("dp", *inner)))
+
+        return jax.tree.map(place, xs_batch)
+
+    lo = 0
+    while lo < p:
+        fault_point("speculative.round")
+        if mode == "scan":
+            # contention fallback: the same jitted chunked scan the
+            # sequential path runs, resumed from the speculative carry
+            # (bit-identical to the sequential carry at pod `lo`)
+            if scan_jit is None:
+                cw_scan = cw
+                if mesh is not None:
+                    from .mesh import shard_workload
+
+                    cw_scan = shard_workload(cw, mesh)
+                scan_jit = _scan_for(cw_scan, chunk, unroll, mesh,
+                                     pack_mode=pack_mode,
+                                     score_dtypes=score_dtypes, wide=wide)
+            # the first fallback round is sized to reach the chunk grid;
+            # every later one is a whole aligned chunk whose outputs
+            # ingest DIRECTLY as the compact chunk — no accumulator
+            # append/emit passes, so a fully-fallen-back wave runs at
+            # the sequential path's speed
+            aligned = fill == 0 and lo % chunk == 0
+            hi = min(lo + (chunk if aligned else chunk - fill), p)
+            m = hi - lo
+            fault_point("replay.scan_dispatch")
+            xs_chunk = _slice_xs(cw_scan.xs, lo, hi, chunk)
+            xs_chunk["is_pad"] = (jnp.arange(chunk) >= m)
+            carry, out = scan_jit(carry, xs_chunk)
+            fault_point("replay.decision_fetch")
+            sel = np.asarray(out.selected)
+            fc = np.asarray(out.feasible_count)
+            rej = np.asarray(out.prefilter_reject)
+            ovf = np.asarray(out.raw_overflow)
+            TRACER.count("wave_d2h_bytes_total",
+                         sel.nbytes + fc.nbytes + rej.nbytes + ovf.nbytes)
+            if check_overflow and ovf[:m].any():
+                return None
+            selected[lo:hi] = sel[:m]
+            feasible_count[lo:hi] = fc[:m]
+            prefilter_reject[lo:hi] = rej[:m]
+            if aligned:
+                # a whole aligned chunk (or the final partial one, whose
+                # pad rows are don't-cares exactly like the scan path's)
+                ingest_chunk({"packed": out.packed_filter,
+                              "raw8": out.raw8, "raw16": out.raw16,
+                              "raw32": out.raw32,
+                              "fc": out.feasible_count})
+            else:
+                bufs = append_jit(bufs, {
+                    "packed": out.packed_filter, "raw8": out.raw8,
+                    "raw16": out.raw16, "raw32": out.raw32,
+                    "fc": out.feasible_count}, fill)
+                fill += m
+                while fill >= chunk:
+                    emit_chunk()
+            stats.scan_pods += m
+            lo = hi
+            continue
+
+        b = ladder[rung]
+        hi = min(lo + b, p)
+        m = hi - lo
+        with TRACER.span("speculative_round", batch=m, rung=b):
+            fault_point("replay.scan_dispatch")
+            xs = _slice_xs(cw.xs, lo, hi, b)
+            xs["is_pad"] = (jnp.arange(b) >= m)
+            xs = place_batch(xs)
+            dense = not sparse
+            if sparse:
+                # one fused dispatch per round; a wide-feasibility round
+                # (max count past the candidate cap) simply discards the
+                # sparse output and re-runs dense
+                (packed, reject_d, counts_d, raw8, raw16, raw32, ovf_d,
+                 sel_dev, k_dev) = round_for(b)(carry, xs)
+                fault_point("replay.decision_fetch")
+                fc = np.asarray(counts_d)
+                rej = np.asarray(reject_d)
+                if int(fc[:m].max(initial=0)) > kcand:
+                    dense = True  # wide feasibility: this round runs dense
+                else:
+                    sel = np.asarray(sel_dev)
+                    ovf = np.asarray(ovf_d)
+                    rows = {"packed": packed, "raw8": raw8, "raw16": raw16,
+                            "raw32": raw32, "fc": counts_d}
+            if dense:
+                outs = eval_for(b)(carry, xs)
+                k_dev = oracle_for(b)(outs.packed_filter,
+                                      outs.prefilter_reject, outs.selected)
+                fault_point("replay.decision_fetch")
+                sel = np.asarray(outs.selected)
+                fc = np.asarray(outs.feasible_count)
+                rej = np.asarray(outs.prefilter_reject)
+                ovf = np.asarray(outs.raw_overflow)
+                sel_dev = outs.selected
+                rows = {"packed": outs.packed_filter, "raw8": outs.raw8,
+                        "raw16": outs.raw16, "raw32": outs.raw32,
+                        "fc": outs.feasible_count}
+            k = min(int(k_dev), m)
+            TRACER.count("wave_d2h_bytes_total",
+                         sel.nbytes + fc.nbytes + rej.nbytes + ovf.nbytes + 4)
+            if inter is not None and k > 1:
+                k = _interaction_cut(inter, sel, lo, k)
+            if gang is not None:
+                k = aligned_cut(gang.gid, gang.start, lo, k, p)
+            if check_overflow and ovf[:k].any():
+                return None
+            selected[lo:lo + k] = sel[:k]
+            feasible_count[lo:lo + k] = fc[:k]
+            prefilter_reject[lo:lo + k] = rej[:k]
+            accept = jnp.arange(b) < k
+            carry = commit_for(b)(carry, xs, sel_dev, accept)
+            if k == m == chunk and fill == 0 and lo % chunk == 0:
+                # a fully-accepted top-rung round at an aligned position
+                # IS a grid chunk: ingest its outputs directly — no
+                # accumulator append/emit passes (the steady state of a
+                # contention-free wave)
+                ingest_chunk(rows)
+            else:
+                bufs = append_jit(bufs, rows, fill)
+                fill += k
+                while fill >= chunk:
+                    emit_chunk()
+        stats.rounds.append((k, m))
+        stats.final_batch = b
+        TRACER.count("speculative_rounds_total")
+        TRACER.inc("speculative_accepted_total", k)
+        if m > k:
+            TRACER.inc("speculative_rolled_back_total", m - k)
+        TRACER.observe("speculative_accept_fraction", k / m)
+        lo += k
+        # contention-aware controller: full-accept rounds climb the
+        # ladder, heavily-cut rounds step down, and a sustained accept
+        # collapse at the bottom rung hands the rest of the wave to the
+        # sequential scan (speculation would evaluate ~B pods per
+        # accepted pod — pure waste on a fully-relaxed queue)
+        if adaptive:
+            if k == m and rung < len(ladder) - 1:
+                rung += 1
+            elif k < max(1, m // 4) and rung > 0:
+                rung -= 1
+        if fallback_rounds > 0 and rung == 0 and lo < p:
+            if k / m < min_accept:
+                low_streak += 1
+                if low_streak >= fallback_rounds:
+                    mode = "scan"
+                    stats.fallback_at = lo
+                    TRACER.inc("speculative_fallbacks_total")
+            else:
+                low_streak = 0
+
+    if fill > 0:
+        emit_chunk()
+    if defer_chunks:
+        for lo_c, hi_c in defer_chunks:
+            on_chunk(rr, lo_c, hi_c)
+    return rr, stats.as_dict(adaptive)
 
 
 def replay_speculative(cw: CompiledWorkload, mesh, batch: int | None = None,
                        pods: list[dict] | None = None,
                        namespaces: list[dict] | None = None,
                        ) -> tuple[ReplayResult, dict]:
-    """Schedule the whole queue in speculative batches (see module doc).
-
-    pods: the pod manifests, required when label-coupled plugins
-    (PodTopologySpread / InterPodAffinity) are active — the interaction
-    rule reads their selectors.  namespaces: the namespace manifests for
-    interpod namespaceSelector resolution (pass whatever was given to
-    compile_workload).
-
-    Returns (rr, stats): rr is a full-array ReplayResult bit-identical to
-    replay(cw) / the sequential oracle; stats records round count and
-    acceptance sizes (the speculation efficiency).
-    Caller must have checked speculation_ok(cw.config).
-    """
-    p = cw.n_pods
-    dp = mesh.shape.get("dp", 1) if mesh is not None else 1
-    # adaptive batch ladder (only when the caller didn't pin a size):
-    # rungs are dp multiples so the dp shards stay balanced; climb a rung
-    # after a fully-accepted round, drop after a round cut below a
-    # quarter — contention-free queues reach big MXU-friendly batches,
-    # contended ones stop paying for work they throw away.  Each rung is
-    # one extra jit specialization (shapes differ), bounded by the ladder
-    # length.
-    unit = max(dp, 1) * 8
-    ladder = [unit, unit * 2, unit * 4]
-    adaptive = batch is None
-    if adaptive:
-        rung = 0
-        batch = ladder[rung]
-    spec = speculative_scores(cw, mesh)  # (carry, xs_batch) -> StepOut[B]
-
-    active = set(cw.config.active_plugins())
-    inter: _InteractionOracle | None = None
-    if active & LABEL_COUPLED:
-        if pods is None:
-            raise ValueError(
-                "label-coupled plugins active: replay_speculative needs the "
-                "pod manifests for the interaction rule")
-        inter = _InteractionOracle(pods, namespaces)
-
-    # copy: commit() donates its carry argument, and cw.init_carry must
-    # survive for later replays of the same workload (same guard as
-    # framework/replay.py's scan entry)
-    carry = jax.tree.map(jnp.array, cw.init_carry)
-    commit = (_batch_commit_fn(cw) if active <= _CORE_ONLY_CARRY
-              else _bind_scan_commit_fn(cw))
-
-    f = len(cw.config.filters())
-    s = len(cw.config.scorers())
-    n = cw.n_nodes
-    filter_codes = np.zeros((p, f, n), np.int32)
-    score_raw = np.zeros((p, s, n), np.int64)
-    score_final = np.zeros((p, s, n), np.int64)
-    selected = np.full(p, -1, np.int32)
-    feasible_count = np.zeros(p, np.int32)
-    prefilter_reject = np.zeros(p, np.int32)
-    rounds: list[int] = []
-
-    from ..framework.replay import _slice_xs
-
-    def slice_xs(lo: int, hi: int, pad_to: int):
-        xs = _slice_xs(cw.xs, lo, hi, pad_to)  # the scan path's slicer
-        xs["is_pad"] = jnp.arange(pad_to) >= (hi - lo)
-        return xs
-
-    lo = 0
-    while lo < p:
-        hi = min(lo + batch, p)
-        m = hi - lo  # this round's size (lo/batch both move below)
-        xs = slice_xs(lo, hi, batch)
-        outs = spec(carry, xs)
-        codes = np.asarray(outs.filter_codes[:m])   # [m, F, N]
-        sel = np.asarray(outs.selected[:m])
-        rej = np.asarray(outs.prefilter_reject[:m])
-        feas = (codes == 0).all(axis=1) & (rej == 0)[:, None]
-        k = _accept_prefix(feas, sel, inter, lo)
-        rounds.append((k, m))
-        a = lo + k
-        filter_codes[lo:a] = codes[:k]
-        score_raw[lo:a] = np.asarray(outs.score_raw[:k])
-        score_final[lo:a] = np.asarray(outs.score_final[:k])
-        selected[lo:a] = sel[:k]
-        feasible_count[lo:a] = np.asarray(outs.feasible_count[:k])
-        prefilter_reject[lo:a] = rej[:k]
-        accept = jnp.arange(batch) < k
-        carry = commit(carry, xs, outs.selected, accept)
-        lo = a
-        if adaptive:
-            if k == m and rung < len(ladder) - 1:
-                rung += 1
-            elif k < max(1, m // 4) and rung > 0:
-                rung -= 1
-            batch = ladder[rung]
-
-    rr = ReplayResult(
-        cw=cw, filter_codes=filter_codes, score_raw=score_raw,
-        score_final=score_final, selected=selected,
-        feasible_count=feasible_count, prefilter_reject=prefilter_reject,
-    )
-    accepts = [k for k, _ in rounds]
-    stats = {"rounds": len(rounds),
-             "batch": batch,        # final rung (== configured size when pinned)
-             "adaptive": adaptive,
-             "round_batches": [m for _, m in rounds],
-             "mean_accept": round(float(np.mean(accepts)), 2) if rounds else 0,
-             "accepted_first_try": int(sum(k == m for k, m in rounds))}
-    return rr, stats
+    """Whole-queue speculative replay without a streaming consumer — the
+    direct-call surface tests and what-if tooling use.  Results land in
+    the same compact chunk grid as the scan (decode via the per-pod
+    accessors / decode_pod_result exactly as before).  The scan
+    fallback stays OFF here: direct callers are probing speculation
+    itself, and the contention tests rely on every pod going through a
+    round."""
+    return replay_speculative_stream(cw, mesh, batch=batch, pods=pods,
+                                     namespaces=namespaces,
+                                     scan_fallback=False)

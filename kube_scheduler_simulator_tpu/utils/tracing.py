@@ -63,6 +63,9 @@ BUCKETS: dict[str, tuple[float, ...]] = {
     "scheduling_attempt_duration_seconds": _exp_buckets(0.001, 2, 15),
     "framework_extension_point_duration_seconds": _exp_buckets(0.0001, 2, 12),
     "plugin_execution_duration_seconds": _exp_buckets(1e-5, 1.5, 20),
+    # accept FRACTION per speculative round — a ratio in (0, 1], not a
+    # duration: linear decile buckets (docs/metrics.md)
+    "speculative_accept_fraction": tuple(i / 10 for i in range(1, 11)),
 }
 _DEFAULT_BUCKETS = _exp_buckets(0.001, 2, 15)
 
@@ -136,6 +139,20 @@ _HELP: dict[str, str] = {
     "scheduling_loop_crashes_total":
         "Scheduling-loop waves that raised (the loop stays alive; the "
         "last crash is surfaced on /readyz).",
+    "speculative_accepted_total":
+        "Pods accepted by the speculative conflict oracle (committed as "
+        "part of a round's non-interfering prefix).",
+    "speculative_rolled_back_total":
+        "Pod evaluations rolled into the next round (rejected by the "
+        "dirty-node / interaction / gang-boundary cut; a pod may roll "
+        "more than once before it commits).",
+    "speculative_accept_fraction":
+        "Accepted fraction of each speculative round's batch "
+        "(accepted / round size; 1.0 = the whole batch committed).",
+    "speculative_fallbacks_total":
+        "Speculative waves that handed their remainder to the "
+        "sequential chunked scan after a sustained accept-rate collapse "
+        "at the bottom batch rung (docs/wave-pipeline.md).",
 }
 
 _NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -347,6 +364,19 @@ class Tracer:
         with self._lock:
             series = self._lcounters.setdefault(name, {})
             series[key] = series.get(key, 0) + n
+
+    def labeled_totals(self, name: str, label: str) -> dict[str, float]:
+        """Sum one labeled counter's series grouped by `label`'s value
+        (series without the label fold under "").  Powers the
+        per-session speculative accept-rate surface on /api/v1/sessions
+        and `bench --serve` without a full snapshot()."""
+        out: dict[str, float] = {}
+        with self._lock:
+            series = self._lcounters.get(name, {})
+            for key, v in series.items():
+                val = dict(key).get(label, "")
+                out[val] = out.get(val, 0) + v
+        return out
 
     # --------------------------------------------------------- histograms
 

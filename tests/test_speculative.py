@@ -94,7 +94,7 @@ def test_speculative_oracle_parity():
             assert da[key] == v, f"pod {i} {key}"
 
 
-def test_engine_uses_speculative_path_with_dp_mesh():
+def test_engine_uses_speculative_path_with_dp_mesh(monkeypatch):
     from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
     from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
     from kube_scheduler_simulator_tpu.utils.tracing import TRACER
@@ -121,7 +121,9 @@ def test_engine_uses_speculative_path_with_dp_mesh():
     TRACER.reset()
     spec_out = run(mesh)
     spans = TRACER.summary()["spans"]
-    assert "speculative_replay" in spans, sorted(spans)
+    assert "speculative_round" in spans, sorted(spans)
+    # the sequential-scan parity baseline (KSS_TPU_SPECULATIVE=0)
+    monkeypatch.setenv("KSS_TPU_SPECULATIVE", "0")
     base_out = run(None)
     assert spec_out == base_out
 
@@ -274,6 +276,63 @@ def test_namespace_selector_interaction_detected():
     assert stats["rounds"] == 2 and stats["mean_accept"] == 1.0
 
 
+def test_sparse_tail_mixed_with_dense_fallback_rounds(monkeypatch):
+    """KSS_TPU_SPECULATIVE_CANDIDATES below the cluster size engages the
+    sparse score/select tail; pods whose feasible set exceeds the cap
+    must push their round onto the dense eval — BOTH kinds of round in
+    one stream, byte-identical to the scan."""
+    from kube_scheduler_simulator_tpu.models.workloads import (
+        make_slot_pinned_workload)
+
+    monkeypatch.setenv("KSS_TPU_SPECULATIVE_CANDIDATES", "4")
+    nodes, pinned = make_slot_pinned_workload(20, 16, seed=71)
+    broad = make_pods(10, seed=72)  # feasible on ~all 16 nodes ( > 4 )
+    pods = pinned[:10] + broad + pinned[10:]
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit",
+                                   "NodeResourcesBalancedAllocation",
+                                   "NodeAffinity"])
+    base = replay(compile_workload(nodes, pods, cfg), chunk=8)
+    rr, stats = replay_speculative(compile_workload(nodes, pods, cfg),
+                                   None, batch=8)
+    np.testing.assert_array_equal(rr.selected, base.selected)
+    np.testing.assert_array_equal(rr.feasible_count, base.feasible_count)
+    for i in range(len(pods)):
+        assert decode_pod_result(rr, i) == decode_pod_result(base, i), i
+
+
+def test_wide_i64_tier_keeps_width_through_the_stream(monkeypatch):
+    """Compile-proven i64 scores skip straight to the widest tier: the
+    stream's eval must receive the tier STRING (review finding: a
+    bool(wide) coercion disabled overflow detection and stacked the
+    i64 tier's raw32 as int32) and the chunk-grid buffers must hold
+    int64 — byte parity with the equally-forced scan, through both
+    accumulator rounds (mixed acceptance) and direct-ingest rounds."""
+    from kube_scheduler_simulator_tpu.models.workloads import (
+        make_slot_pinned_workload)
+
+    monkeypatch.setenv("KSS_TPU_SPECULATIVE_CANDIDATES", "4")
+    nodes, pinned = make_slot_pinned_workload(20, 16, seed=81)
+    pods = pinned[:10] + make_pods(8, seed=82) + pinned[10:]
+    cfg = PluginSetConfig(enabled=["NodeResourcesFit",
+                                   "NodeResourcesBalancedAllocation"])
+
+    def force_i64(cw):
+        cw.host["score_dtypes"] = tuple(
+            "i64" for _ in cw.config.scorers())
+        return cw
+
+    base = replay(force_i64(compile_workload(nodes, pods, cfg)), chunk=8)
+    rr, _ = replay_speculative(force_i64(compile_workload(nodes, pods, cfg)),
+                               None, batch=8)
+    assert rr._compact.raw32, "i64 tier must pool scorers into raw32"
+    import jax.numpy as jnp
+    for a in rr._compact.raw32:
+        assert jnp.asarray(a).dtype == jnp.int64, a.dtype
+    np.testing.assert_array_equal(rr.selected, base.selected)
+    for i in range(len(pods)):
+        assert decode_pod_result(rr, i) == decode_pod_result(base, i), i
+
+
 def test_adaptive_batch_ladder_stays_exact():
     """batch=None engages the adaptive ladder (grow on full accept,
     shrink on early cuts); results stay bit-identical to the scan."""
@@ -311,5 +370,8 @@ def test_adaptive_ladder_climbs_on_sparse_feasibility():
     base = replay(compile_workload(nodes, pods, cfg), chunk=16)
     rr, stats = replay_speculative(compile_workload(nodes, pods, cfg), None)
     np.testing.assert_array_equal(rr.selected, base.selected)
-    assert max(stats["round_batches"]) == 32, stats["round_batches"]
+    # the x4 ladder must actually climb off its bottom rung (8 -> 32)
+    assert max(stats["round_batches"]) > stats["round_batches"][0], stats
+    assert stats["round_batches"][:2] == [8, 32], stats["round_batches"]
     assert stats["accepted_first_try"] == stats["rounds"]
+    assert stats["fallback_at"] is None and stats["accept_rate"] == 1.0

@@ -68,6 +68,16 @@ HOT_PATH_ROOTS: list[tuple[str, str]] = [
     ("server.sessions", "SimulationSession.touch"),
     ("server.sessions", "SimulationSession.register_stream"),
     ("server.sessions", "SimulationSession.unregister_stream"),
+    # speculative default wave (PR 13): the streaming round loop, its
+    # conflict-oracle host walk and the engine shell run inside every
+    # wave — they must stay free of per-pod Python loops and eager
+    # host syncs on the compact groups (the accumulator emits whole
+    # chunks through gather_to_host, the one sanctioned crossing)
+    ("framework.engine", "SchedulerEngine._speculative_wave"),
+    ("parallel.speculative", "replay_speculative_stream"),
+    ("parallel.speculative", "_spec_run"),
+    ("parallel.speculative", "_interaction_cut"),
+    ("framework.gang", "aligned_cut"),
 ]
 
 BIG_ITERABLES = {"pending", "pods", "nodes"}

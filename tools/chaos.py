@@ -80,6 +80,12 @@ def _plan_for(seed: int, target: str):
         # transient scan/fetch faults: heal via uncommitted-suffix retry
         FaultRule("replay.scan_dispatch", nth=rng.randint(1, 3),
                   error="runtime", times=1, sessions=[target]),
+        # mid-round speculative fault (the default wave's own seam):
+        # committed round chunks stand through the gang-cut watermark,
+        # the uncommitted suffix retries recompiled against current
+        # store state — byte parity with the fault-free run must hold
+        FaultRule("speculative.round", nth=rng.randint(1, 2),
+                  error="runtime", times=1, sessions=[target]),
         FaultRule("replay.decision_fetch", p=0.15, error="io", times=2,
                   sessions=[target]),
         # structural fault: steps the degradation ladder down a rung
@@ -156,8 +162,19 @@ def _run_once(seed: int, plan, shape: dict) -> dict:
     # RESTORE the previous plan after: an operator's env-armed
     # KSS_TPU_FAULT_PLAN must survive a bench-embedded chaos verdict
     prev = faults.current_plan()
+    prev_retries = os.environ.get("KSS_TPU_WAVE_MAX_RETRIES")
     if plan is not None:
         faults.arm(plan)
+        # the protocol completes a wave iff its retry budget covers the
+        # transient faults landing in it; size the budget to this
+        # plan's worst case (every bounded transient rule trips in ONE
+        # wave) so the gate asserts protocol CORRECTNESS, not a lucky
+        # fault spread.  An unbounded budget would hide retry storms —
+        # the exact worst case keeps the bound meaningful.
+        budget = sum(
+            (r.times or 0) for r in plan.rules
+            if r.error in ("runtime", "io", "timeout", "conflict"))
+        os.environ["KSS_TPU_WAVE_MAX_RETRIES"] = str(max(budget, 3))
     else:
         faults.disarm()
     try:
@@ -181,6 +198,11 @@ def _run_once(seed: int, plan, shape: dict) -> dict:
             faults.arm(prev)
         else:
             faults.disarm()
+        if plan is not None:
+            if prev_retries is None:
+                os.environ.pop("KSS_TPU_WAVE_MAX_RETRIES", None)
+            else:
+                os.environ["KSS_TPU_WAVE_MAX_RETRIES"] = prev_retries
     modes = {sid: eng.result_mode() for sid, (_s, eng) in sessions.items()}
     for sid, (_store, eng) in sessions.items():
         if sid not in errors:  # never block closing a wedged engine
