@@ -9,7 +9,7 @@ PY ?= python
 
 .PHONY: codec native-asan native-tsan test test-asan test-tsan analyze \
         bench bench-check bench-gang bench-serve bench-spec \
-        bench-multichip smoke chaos clean parity-fullscale \
+        bench-multichip blackbox-smoke smoke chaos clean parity-fullscale \
         parity-fullscale-device multichip-scaling host-probe tpu-watch
 
 # measurement artifacts (committed under docs/bench/; see BASELINE.md)
@@ -77,7 +77,16 @@ test-tsan:
 analyze:
 	$(PY) -m tools.analysis
 
-test: analyze
+# wave black-box smoke gate (docs/metrics.md post-mortem dumps): arm a
+# one-rule fault plan via KSS_TPU_FAULT_PLAN, run a wave with the retry
+# budget at 0, and assert a schema-valid post-mortem dump lands in
+# KSS_TPU_BLACKBOX_DIR (fault trip + speculative round history +
+# counter deltas + device fingerprint) — a crashed wave must ship its
+# own evidence
+blackbox-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.blackbox_smoke
+
+test: analyze blackbox-smoke
 	$(PY) -m pytest tests/ -q -m "not slow"
 
 bench:

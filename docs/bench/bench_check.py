@@ -58,6 +58,7 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
     lazy10k = eng10k.get("lazy") or {}
     serve = extra.get("serve") or {}
     spec = (extra.get("speculative") or {}).get("low_contention") or {}
+    bbox = extra.get("blackbox") or {}
     spans10k = eng10k.get("spans") or {}
     return {
         "decode_pods_per_sec": (extra.get("decode_pods_per_sec"), "higher"),
@@ -109,6 +110,12 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
             (spec.get("accept_rate"), "higher"),
         "engine_10k_5k_speculative_speedup_vs_scan":
             (spec.get("speedup"), "higher"),
+        # wave black-box era metric (absent from pre-blackbox rounds —
+        # union/skip carries them): on/off cycles/s ratio of the
+        # always-on event ring's A/B; a drop means recording stopped
+        # being free (the <=2% acceptance bar, noise-bound)
+        "blackbox_overhead_ratio":
+            (bbox.get("overhead_ratio"), "higher"),
     }
 
 
@@ -219,6 +226,17 @@ def main(argv: list[str]) -> int:
         print(f"bench-check: REFUSING to compare — {new_p.name}'s chaos "
               f"harness errored instead of running: {chaos['error']} "
               "(run `make chaos`)")
+        return 2
+    bbox = (new.get("extra") or {}).get("blackbox") or {}
+    if bbox.get("error") or bbox.get("annotations_identical") is False:
+        # the black-box A/B either raised (annotation divergence is a
+        # RuntimeError) or reported non-identical bytes: the recorder
+        # touched the product — refuse the round rather than letting the
+        # union/skip semantics wave it through as a missing metric
+        print(f"bench-check: REFUSING to compare — {new_p.name}'s "
+              f"blackbox A/B failed: "
+              f"{bbox.get('error') or 'annotations diverged'} "
+              "(run bench.py and see extra.blackbox)")
         return 2
     analysis = (new.get("extra") or {}).get("analysis") or {}
     if analysis.get("new_findings"):

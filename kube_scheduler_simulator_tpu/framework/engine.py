@@ -869,6 +869,12 @@ class SchedulerEngine:
         bound, retry = self._profile_wave_run(pending, exclude)
         n = len(pending)
         if n:
+            # per-session SLO window (rolling p50/p99 wave latency +
+            # cycles/s): one deque append, read by /api/v1/sessions and
+            # /readyz (utils/blackbox.py, docs/metrics.md)
+            from ..utils.blackbox import SLO
+
+            SLO.observe_wave(self.session, time.perf_counter() - t0, n)
             per = (time.perf_counter() - t0) / n
             if bound:
                 TRACER.observe("scheduling_attempt_duration_seconds", per,
@@ -918,6 +924,15 @@ class SchedulerEngine:
         TRACER.inc("wave_degradations_total",
                    **{"from": _RESIDENCY_MODES[cur],
                       "to": _RESIDENCY_MODES[cur + 1]})
+        from ..utils.blackbox import BLACKBOX
+
+        BLACKBOX.record("degrade", seam=seam,
+                        from_mode=_RESIDENCY_MODES[cur],
+                        to_mode=_RESIDENCY_MODES[cur + 1])
+        # a degradation is a structural event worth a post-mortem even
+        # though the wave survives: snapshot the ring (in memory; wave
+        # ABORTS additionally write to KSS_TPU_BLACKBOX_DIR)
+        BLACKBOX.dump("degradation", session=self.session)
         return True
 
     def _wave_recovered_ok(self) -> None:
@@ -943,6 +958,10 @@ class SchedulerEngine:
         TRACER.inc("wave_degradations_total",
                    **{"from": _RESIDENCY_MODES[cur],
                       "to": _RESIDENCY_MODES[new]})
+        from ..utils.blackbox import BLACKBOX
+
+        BLACKBOX.record("recover", from_mode=_RESIDENCY_MODES[cur],
+                        to_mode=_RESIDENCY_MODES[new])
 
     def _profile_wave_run(self, pending: list[dict],
                           exclude: set[tuple[str, str]] | None = None
@@ -967,10 +986,15 @@ class SchedulerEngine:
 
         With no fault the attempt's result passes straight through —
         the try block is the only overhead on the happy path."""
+        from ..utils.blackbox import BLACKBOX
         from ..utils.faults import classify_fault
         from .replay import (CompileQuarantined, materialize_failure_streak,
                              reset_materialize_failures)
 
+        # black-box wave marker: records the event AND pins the counter
+        # baseline this wave's post-mortem computes deltas against
+        BLACKBOX.wave_start(self.session, pods=len(pending),
+                            mode=self.result_mode())
         if (self._effective_residency() == 0
                 and materialize_failure_streak(self.session)
                 >= self._env_int("KSS_TPU_MATERIALIZE_FAIL_LIMIT", 3)):
@@ -992,17 +1016,29 @@ class SchedulerEngine:
                 pending = ab.remaining
                 cause = ab.cause
                 seam = getattr(cause, "seam", None) or ab.stage
+                kind = classify_fault(cause)
+                BLACKBOX.record("wave.fault", stage=ab.stage, seam=seam,
+                                error=type(cause).__name__,
+                                classification=kind, bound=ab.n_bound,
+                                remaining=len(pending))
                 if isinstance(cause, CompileQuarantined):
                     # per-key containment already happened in the scan
                     # cache; retrying here would only re-read the
                     # quarantine — surface it to the caller/session
+                    BLACKBOX.record("wave.abort", seam=seam,
+                                    action="quarantined")
+                    BLACKBOX.dump("wave_abort", cause=cause,
+                                  session=self.session, write=True)
                     raise cause
-                kind = classify_fault(cause)
                 if kind == "structural":
                     if self._degrade(seam):
                         continue
                     TRACER.inc("wave_faults_total", seam=seam,
                                action="aborted")
+                    BLACKBOX.record("wave.abort", seam=seam,
+                                    action="aborted")
+                    BLACKBOX.dump("wave_abort", cause=cause,
+                                  session=self.session, write=True)
                     raise cause
                 if kind == "transient" and retries_left > 0:
                     # retry even with an EMPTY suffix: every pod already
@@ -1015,12 +1051,23 @@ class SchedulerEngine:
                     TRACER.count("wave_retries_total")
                     TRACER.inc("wave_faults_total", seam=seam,
                                action="retried")
+                    BLACKBOX.record("wave.retry", seam=seam,
+                                    remaining=len(pending),
+                                    retries_left=retries_left)
                     self._retry_sleep(delay)
                     delay = min(delay * 5, 1.0)
                     continue
                 TRACER.inc("wave_faults_total", seam=seam, action="aborted")
+                BLACKBOX.record("wave.abort", seam=seam, action="aborted")
+                # a failed wave ships its own evidence: the bundle is
+                # auto-written to KSS_TPU_BLACKBOX_DIR when set
+                # (docs/fault-injection.md)
+                BLACKBOX.dump("wave_abort", cause=cause,
+                              session=self.session, write=True)
                 raise cause
             self._wave_recovered_ok()
+            BLACKBOX.record("wave.end", bound=bound + b,
+                            retry=retry or None)
             return bound + b, retry
 
     def _guarded_replay(self, stage: str, pending: list, fn):

@@ -42,6 +42,7 @@ import os
 import threading
 import time
 import weakref
+import zlib
 from collections import OrderedDict
 from typing import Any
 
@@ -214,6 +215,12 @@ class _CompactChunks:
                 TRACER.inc("device_chunks_spilled_total", session=sid)
             else:
                 TRACER.count("device_chunks_spilled_total")
+            # black-box spill evidence: which chunk left HBM, how big —
+            # a post-mortem for an OOM-adjacent wave needs the spill
+            # timeline (utils/blackbox.py)
+            from ..utils.blackbox import BLACKBOX
+
+            BLACKBOX.record("budget.spill", chunk=ci, bytes=int(nbytes))
         else:
             TRACER.count("d2h_on_demand_bytes_total", nbytes)
             TRACER.observe("d2h_on_demand_seconds", dt)
@@ -994,12 +1001,23 @@ class _ScanCacheRegistry:
                 f"after {self._QUARANTINE_AFTER} consecutive build "
                 f"failures (last: {quarantined_err}); other shapes are "
                 "unaffected")
+        from ..utils.blackbox import BLACKBOX
+
+        # short stable id for the shape key: a per-key label for the
+        # build-seconds histogram without exploding cardinality (the
+        # cache itself holds at most max_entries keys)
+        key_id = f"{zlib.crc32(repr(key).encode()) & 0xffffffff:08x}"
+        t0 = time.perf_counter()
         try:
             # the jax.jit wrapper builds OUTSIDE the lock (kss-analyze
             # device-under-lock; jit is lazy but build_step touches jnp)
             fault_point("compile.build")
             scan_jit = builder()
         except BaseException as e:
+            dt = time.perf_counter() - t0
+            TRACER.observe("scan_compile_build_seconds", dt, key=key_id,
+                           result="error")
+            quarantined = False
             with self._mu:
                 del self._building[key]
                 bad = self._failed.get(key) or [0, 0.0, ""]
@@ -1009,15 +1027,26 @@ class _ScanCacheRegistry:
                     bad[1] = time.monotonic() + _compile_quarantine_ttl()
                     TRACER.inc("wave_faults_total", seam="compile.build",
                                action="quarantined")
+                    quarantined = True
+                fails = bad[0]
                 self._failed[key] = bad
+            BLACKBOX.record("compile.fail", key=key_id, fails=fails,
+                            quarantined=quarantined,
+                            error=f"{type(e).__name__}: {e}"[:200])
             ev.set()    # waiters retry; they'll become builders
             raise
+        dt = time.perf_counter() - t0
+        TRACER.observe("scan_compile_build_seconds", dt, key=key_id,
+                       result="ok")
+        BLACKBOX.record("compile.build", key=key_id,
+                        seconds=round(dt, 3))
         with self._mu:
             while len(self._entries) >= self.max_entries:
                 self._entries.popitem(last=False)
             self._entries[key] = scan_jit
             self._failed.pop(key, None)
             del self._building[key]
+            TRACER.gauge("scan_compile_cache_entries", len(self._entries))
         ev.set()
         return scan_jit
 

@@ -114,6 +114,7 @@ from ..framework.replay import (
     _DEVICE_BUDGET, _resolve_device_resident, _scan_for, _SCAN_CACHE,
     _slice_xs, _SlimWorkload, _workload_scan_key)
 from ..state.compile import CompiledWorkload
+from ..utils.blackbox import BLACKBOX
 from ..utils.env import env_float, env_int
 from ..utils.faults import fault_point
 from ..utils.tracing import TRACER
@@ -1019,6 +1020,11 @@ def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
         if m > k:
             TRACER.inc("speculative_rolled_back_total", m - k)
         TRACER.observe("speculative_accept_fraction", k / m)
+        # black-box round history (utils/blackbox.py): the evidence a
+        # post-mortem needs to explain WHY the controller climbed,
+        # dropped, or fell back — batch size, accept fraction, rung
+        BLACKBOX.record("speculative.round", batch=m, accepted=k,
+                        rung=b, accept_fraction=round(k / m, 4))
         lo += k
         # contention-aware controller: full-accept rounds climb the
         # ladder, heavily-cut rounds step down, and a sustained accept
@@ -1037,6 +1043,8 @@ def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
                     mode = "scan"
                     stats.fallback_at = lo
                     TRACER.inc("speculative_fallbacks_total")
+                    BLACKBOX.record("speculative.fallback", at=lo,
+                                    rounds=len(stats.rounds))
             else:
                 low_streak = 0
 

@@ -33,6 +33,8 @@ Observability surface (docs/metrics.md):
   GET  /api/v1/metrics/stream   -> SSE snapshots (?interval=S&count=N)
   GET  /api/v1/trace            -> Perfetto/chrome://tracing JSON
                                    (?limit=N&session=)
+  GET  /api/v1/debug/dump       -> wave black-box post-mortem bundle
+                                   (?session=; utils/blackbox.py)
   POST /api/v1/profile          -> XLA profile start/stop (409 on bad state)
   GET  /healthz | /readyz       -> liveness / scheduling-loop readiness
                                    (readyz surfaces the last loop crash)
@@ -83,6 +85,13 @@ class SimulatorServer:
         return self.manager.default.di
 
     def start(self, block: bool = True):
+        # device telemetry plane (utils/blackbox.py, docs/metrics.md):
+        # the background HBM sampler feeds hbm_* gauges into /metrics;
+        # idempotent, a daemon, explicit no-op gauge on stat-less
+        # backends (CPU)
+        from ..utils.blackbox import TELEMETRY
+
+        TELEMETRY.start()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
         self.port = self.httpd.server_address[1]
@@ -98,6 +107,11 @@ class SimulatorServer:
         if self.httpd:
             self.httpd.shutdown()
         self.manager.shutdown()
+        # release this server's hold on the process-global HBM sampler
+        # (the thread ends when the last holder stops)
+        from ..utils.blackbox import TELEMETRY
+
+        TELEMETRY.stop()
 
 
 def _make_handler(server: SimulatorServer):
@@ -225,6 +239,8 @@ def _make_handler(server: SimulatorServer):
                 return self._metrics_stream(url)
             if path == "/api/v1/trace" and method == "GET":
                 return self._trace(url)
+            if path == "/api/v1/debug/dump" and method == "GET":
+                return self._debug_dump(url)
             if path == "/api/v1/profile" and method == "POST":
                 return self._profile()
             if path == "/api/v1/schedulerconfiguration":
@@ -387,6 +403,30 @@ def _make_handler(server: SimulatorServer):
             return self._json(400, {"reason": "BadRequest",
                                     "message": "action must be start or stop"})
 
+        def _debug_dump(self, url):
+            """GET /api/v1/debug/dump (+ /api/v1/sessions/<id>/debug/dump
+            alias, or ?session=) — the wave black box's post-mortem
+            surface (docs/metrics.md): a LIVE bundle built on request
+            (event ring, open spans, counter deltas since the last wave
+            start, armed fault plan, env knobs, device fingerprint)
+            plus metadata of recently stored dumps (wave aborts write
+            theirs to KSS_TPU_BLACKBOX_DIR)."""
+            from ..utils.blackbox import BLACKBOX
+            from ..utils.tracing import TRACER
+
+            sid = self._session_filter(url)
+            doc = BLACKBOX.bundle("request", session=sid)
+            # counted like every snapshot reason, but NOT stored: a
+            # polling client must not scroll real abort dumps out of
+            # the bounded recent ring
+            TRACER.inc("blackbox_dumps_total", reason="request")
+            recent = BLACKBOX.recent_dumps()
+            if sid is not None:
+                # the scoped alias leaks nothing: not even another
+                # tenant's dump metadata (cause text, on-disk path)
+                recent = [d for d in recent if d.get("session") == sid]
+            return self._json(200, {"dump": doc, "recent": recent})
+
         def _health(self, path: str):
             """GET /healthz (liveness: the HTTP server answers) and
             /readyz (readiness: the session's scheduling loop thread is
@@ -407,6 +447,14 @@ def _make_handler(server: SimulatorServer):
             degraded = [s["id"] for s in sessions if s.get("degraded")]
             if degraded:
                 body["degradedSessions"] = degraded
+            # per-session SLO window (utils/blackbox.py): p99 wave
+            # latency + cycles/s for every session that ran a wave, so
+            # a probe sees tail latency without walking /api/v1/sessions
+            slo = {s["id"]: {"p99WaveSeconds": s["slo"]["p99WaveSeconds"],
+                             "cyclesPerSec": s["slo"]["cyclesPerSec"]}
+                   for s in sessions if s.get("slo")}
+            if slo:
+                body["slo"] = slo
             if loop.last_crash is not None:
                 body["lastCrash"] = {k: loop.last_crash[k]
                                      for k in ("time", "error")}

@@ -46,6 +46,7 @@ import uuid
 
 from ..cluster.store import ApiError, NotFound
 from ..config.config import SimulatorConfiguration
+from ..utils.blackbox import BLACKBOX, SLO
 from ..utils.env import env_int as _env_int
 from ..utils.faults import fault_point
 from ..utils.tracing import TRACER
@@ -188,6 +189,10 @@ class SimulationSession:
             "resultMode": (engine.result_mode()
                            if hasattr(engine, "result_mode") else None),
             "degraded": bool(getattr(engine, "_residency", 0)),
+            # rolling SLO window (utils/blackbox.py, docs/metrics.md):
+            # p50/p99 wave latency + cycles/s over the last
+            # KSS_TPU_SLO_WINDOW waves; None before the first wave
+            "slo": SLO.stats(self.id),
             "lastCrash": (loop.last_crash or None) and {
                 k: loop.last_crash[k] for k in ("time", "error")
             },
@@ -355,6 +360,7 @@ class SessionManager:
             raise SessionError("session manager is shutting down")
         TRACER.count("sessions_created_total")
         TRACER.gauge("sessions_active", n)
+        BLACKBOX.record("session.create", id=sid)
         return sess
 
     def delete(self, session_id: str) -> None:
@@ -405,6 +411,7 @@ class SessionManager:
 
     def _teardown(self, sess: SimulationSession, reason: str) -> None:
         TRACER.inc("sessions_evicted_total", reason=reason)
+        BLACKBOX.record("session.evict", id=sess.id, reason=reason)
         failed = False
         try:
             fault_point("session.evict")
@@ -424,6 +431,12 @@ class SessionManager:
             # count it so chaos runs and operators see it instead of a
             # 500 that leaves the registry in the same state anyway
             TRACER.inc("session_teardown_failures_total", reason=reason)
+        # per-session observability state must not outlive the session:
+        # a churning server (create/evict forever) would otherwise
+        # accumulate one SLO window + one counter baseline per session
+        # id ever seen
+        SLO.drop_session(sess.id)
+        BLACKBOX.drop_session(sess.id)
 
     # -------------------------------------------------------- shutdown
 

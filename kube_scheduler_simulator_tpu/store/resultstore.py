@@ -199,6 +199,7 @@ class ResultStore:
     def put_decoded(self, namespace: str, pod_name: str, annotations: dict[str, str]):
         with self._mu:
             r = self._get(namespace, pod_name)
+            shadowed = False
             if ann.SELECTED_NODE in annotations:
                 # a full-cycle deposit (every cycle's 13 keys include
                 # selected-node, "" when unschedulable) fully shadows a
@@ -206,8 +207,17 @@ class ResultStore:
                 # old wave's replay buffers and costing a dead chunk
                 # decode on read; partial overlays (the extender-bind
                 # record) keep the base
+                shadowed = r.lazy is not None
                 r.lazy = None
             r.decoded.update(annotations)
+        if shadowed:
+            # an UNREAD wave's results just vanished behind a newer
+            # cycle — rare (a pod re-scheduled before anyone read it),
+            # and exactly the evidence loss a post-mortem should show
+            from ..utils.blackbox import BLACKBOX
+
+            BLACKBOX.record("result.lazy_shadowed",
+                            pod=_key(namespace, pod_name), by="decoded")
 
     def has_result(self, pod: dict) -> bool:
         """True when an entry exists for the pod — the informer's cheap
@@ -225,8 +235,16 @@ class ResultStore:
         later put_decoded / granular adds overlay it."""
         with self._mu:
             r = self._get(namespace, pod_name)
+            shadowed = r.lazy is not None
             r.lazy = (wave, index)
             r.decoded = {}
+        if shadowed:
+            # only the rare cross-wave overwrite records (never the
+            # per-pod hot path: fresh entries have no handle to shadow)
+            from ..utils.blackbox import BLACKBOX
+
+            BLACKBOX.record("result.lazy_shadowed",
+                            pod=_key(namespace, pod_name), by="lazy")
 
     def add_filter_result(self, namespace, pod_name, node_name, plugin_name, reason):
         with self._mu:

@@ -269,6 +269,14 @@ def fault_point(seam: str) -> None:
     exc = plan.check(seam)
     if exc is not None:
         TRACER.inc("fault_injected_total", seam=seam)
+        # black-box evidence: the trip, where it fired, and how the
+        # wave failure protocol will triage it (utils/blackbox.py) —
+        # imported lazily so the unarmed fast path pays nothing
+        from .blackbox import BLACKBOX
+
+        BLACKBOX.record("fault.trip", seam=seam,
+                        error=type(exc).__name__,
+                        classification=classify_fault(exc))
         raise exc
 
 

@@ -40,6 +40,13 @@ from contextlib import contextmanager
 
 _PREFIX = "kss_tpu"
 
+# open-span bookkeeping rides the wave black box's enable flag
+# (utils/blackbox.py owns the user-facing toggle and mirrors it here —
+# tracing cannot import blackbox without a cycle): with the black box
+# off, span entry pays no extra lock and the post-mortem surface
+# reports no open spans, keeping the KSS_TPU_BLACKBOX=0 A/B honest
+BLACKBOX_OPEN_SPANS = os.environ.get("KSS_TPU_BLACKBOX", "1") != "0"
+
 
 class ProfileStateError(RuntimeError):
     """Invalid XLA-profile state transition (double start, stop without
@@ -66,6 +73,9 @@ BUCKETS: dict[str, tuple[float, ...]] = {
     # accept FRACTION per speculative round — a ratio in (0, 1], not a
     # duration: linear decile buckets (docs/metrics.md)
     "speculative_accept_fraction": tuple(i / 10 for i in range(1, 11)),
+    # XLA scan builds run ~0.1s (warm shapes) to tens of seconds (cold
+    # giant meshes): a wider exponential ladder than the attempt buckets
+    "scan_compile_build_seconds": _exp_buckets(0.01, 2, 14),
 }
 _DEFAULT_BUCKETS = _exp_buckets(0.001, 2, 15)
 
@@ -153,6 +163,32 @@ _HELP: dict[str, str] = {
         "Speculative waves that handed their remainder to the "
         "sequential chunked scan after a sustained accept-rate collapse "
         "at the bottom batch rung (docs/wave-pipeline.md).",
+    "tracer_events_dropped_total":
+        "Span events evicted from the tracer's fixed-size ring because "
+        "it was full — a long soak whose trace tail silently scrolled "
+        "away shows up here (utils/tracing.py).",
+    "blackbox_dumps_total":
+        "Post-mortem bundles snapshotted by the wave black box, by "
+        "reason (wave_abort, degradation, chaos_failure, request; "
+        "docs/metrics.md post-mortem dumps).",
+    "hbm_bytes_in_use":
+        "Device memory currently in use per local device (device "
+        "label) and summed across devices (unlabeled), sampled from "
+        "jax memory_stats(); only exported where the backend reports "
+        "memory stats — see hbm_stats_available.",
+    "hbm_peak_bytes":
+        "Peak device memory in use per local device (device label) "
+        "and summed (unlabeled), from jax memory_stats().",
+    "hbm_stats_available":
+        "1 when the backend exposes device memory_stats (HBM gauges "
+        "are live), 0 as the explicit no-op marker where it does not "
+        "(the CPU backend).",
+    "scan_compile_build_seconds":
+        "Wall seconds of one XLA scan build, labeled by the workload "
+        "shape's cache key (key=<crc32 of the shape key>) and result.",
+    "scan_compile_cache_entries":
+        "Compiled scan executables currently held by the process-level "
+        "LRU cache (framework/replay._ScanCacheRegistry).",
 }
 
 _NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
@@ -223,8 +259,15 @@ class Tracer:
         self._agg: dict[str, dict] = {}
         self._counters: dict[str, float] = {}
         # gauges: absolute values set by gauge() (current device-retained
-        # chunk count etc.), exported with TYPE gauge
+        # chunk count etc.), exported with TYPE gauge; labeled series
+        # (HBM per-device samples) live separately and merge into one
+        # family at exposition, like counters do
         self._gauges: dict[str, float] = {}
+        self._lgauges: dict[str, dict[tuple, float]] = {}
+        # spans currently OPEN (entered, not yet exited): the wave black
+        # box snapshots these into a post-mortem bundle so a dump shows
+        # WHERE the wave was when the fault fired (utils/blackbox.py)
+        self._open: dict[int, dict] = {}
         # labeled counters: name -> {((k, v), ...) sorted: value}
         self._lcounters: dict[str, dict[tuple, float]] = {}
         # histograms: name -> {((k, v), ...) sorted: _Hist}
@@ -245,6 +288,12 @@ class Tracer:
         # touching the aggregate families
         self._scounters: dict[str, dict[str, float]] = {}
         self._sagg: dict[str, dict[str, dict]] = {}
+        # per-session gauge view: gauge() under a session scope mirrors
+        # the last-set value here so snapshot(session=) can answer
+        # (counters/histograms fold a session label; gauges are
+        # absolute values, so the aggregate sample stays unlabeled and
+        # the session view is a mirror, not a label)
+        self._sgauges: dict[str, dict[str, float]] = {}
 
     # ---------------------------------------------------------- sessions
 
@@ -309,13 +358,48 @@ class Tracer:
         if session is not None and "session" not in attrs:
             attrs["session"] = session
         t0 = time.perf_counter()
+        if BLACKBOX_OPEN_SPANS:
+            with self._lock:
+                self._open[sp.id] = {
+                    "name": name, "span_id": sp.id,
+                    "parent_id": sp.parent_id,
+                    "tid": self._tid(), "t0": time.time(),
+                    **({"session": session} if session is not None else {}),
+                }
         try:
             yield sp
+        except BaseException as exc:
+            # first (innermost) span this exception unwinds through:
+            # stash the open-span tree AS OF THE FAULT so the black
+            # box's post-mortem can report where the wave was, even
+            # though every span has closed by the time the wave failure
+            # protocol builds the bundle (utils/blackbox.py).  An
+            # explicit except (not sys.exc_info() in the finally) so a
+            # span exiting NORMALLY inside an outer except handler
+            # never tags the handled exception with stale spans.
+            if not hasattr(exc, "_kss_open_spans"):
+                try:
+                    exc._kss_open_spans = self.open_spans()
+                # builtins with __slots__ reject attributes — best-effort
+                # kss-analyze: allow(swallowed-exception)
+                except Exception:
+                    pass
+            raise
         finally:
             dt = time.perf_counter() - t0
             sp.seconds = dt
             st.pop()
             with self._lock:
+                self._open.pop(sp.id, None)
+                if (self._events.maxlen is not None
+                        and len(self._events) == self._events.maxlen):
+                    # the ring is full: this append evicts the oldest
+                    # span silently — count it so long soaks can see
+                    # their trace tail scrolled away (summary(),
+                    # /metrics tracer_events_dropped_total)
+                    self._counters["tracer_events_dropped_total"] = \
+                        self._counters.get(
+                            "tracer_events_dropped_total", 0) + 1
                 tid = self._tid()
                 self._events.append({
                     "name": name, "t": time.time(), "seconds": dt,
@@ -347,11 +431,57 @@ class Tracer:
                 sc = self._scounters.setdefault(session, {})
                 sc[name] = sc.get(name, 0) + n
 
-    def gauge(self, name: str, value: float) -> None:
+    def gauge(self, name: str, value: float, **labels) -> None:
         """Set a gauge to an absolute value (unlike count(), which
-        accumulates): the exporter emits it with TYPE gauge."""
+        accumulates): the exporter emits it with TYPE gauge.  With
+        labels (e.g. the HBM sampler's device=<id>) the series lands in
+        a labeled family that merges with the unlabeled sample at
+        exposition, like counters.  Under an active session scope the
+        last-set value is ALSO mirrored into the per-session view that
+        snapshot(session=) reports — gauges are absolute, so the
+        aggregate sample stays unlabeled rather than splitting into
+        per-session series that would each claim the global value."""
+        session = self.current_session()
+        if labels and session is not None and "session" not in labels:
+            labels["session"] = session
+        key = (tuple(sorted((k, str(v)) for k, v in labels.items()))
+               if labels else None)
         with self._lock:
-            self._gauges[name] = value
+            if key:
+                self._lgauges.setdefault(name, {})[key] = value
+            else:
+                self._gauges[name] = value
+            if session is not None:
+                self._sgauges.setdefault(session, {})[name] = value
+
+    def open_spans(self) -> list[dict]:
+        """Spans entered but not yet exited, oldest first, with
+        seconds_so_far — the black box snapshots these at fault time
+        (utils/blackbox.py post-mortem bundles)."""
+        now = time.time()
+        with self._lock:
+            spans = [dict(v) for v in self._open.values()]
+        spans.sort(key=lambda s: s["t0"])
+        for s in spans:
+            s["seconds_so_far"] = round(max(now - s.pop("t0"), 0.0), 6)
+        return spans
+
+    def counter_totals(self) -> dict[str, float]:
+        """Every counter flattened to one {key: value} dict: plain
+        counters under their name, labeled series under
+        name{k=v,...}.  The black box captures this at wave start and
+        diffs at dump time — the per-wave counter deltas a post-mortem
+        carries."""
+        with self._lock:
+            out = dict(self._counters)
+            for name, series in self._lcounters.items():
+                for key, v in series.items():
+                    if not key:
+                        out[name] = out.get(name, 0) + v
+                        continue
+                    flat = ",".join(f"{k}={lv}" for k, lv in key)
+                    out[f"{name}{{{flat}}}"] = v
+        return out
 
     def inc(self, name: str, n: float = 1, **labels) -> None:
         """Labeled counter increment; identical label sets merge
@@ -414,6 +544,43 @@ class Tracer:
             evs = list(self._events)
         return evs[-limit:]
 
+    # the span names that bound the wave's device window (the replay /
+    # speculative stream holds the device scan) vs its host-side work
+    # (commit, decode, fetch, compile).  commit_stream runs on the
+    # worker DURING the device window — the overlap counter quantifies
+    # how much of the host total was hidden inside it.
+    _DEVICE_WINDOW_SPANS = ("replay_and_decode_stream", "device_replay")
+    _HOST_SPANS = ("compile_workload", "commit_and_reflect",
+                   "commit_stream", "decode_chunk", "decode_lazy",
+                   "d2h_fetch")
+
+    def time_split(self, session: str | None = None) -> dict:
+        """Per-wave device-window vs host-time split, derived from the
+        span aggregates (docs/metrics.md device telemetry): total
+        seconds inside the device-replay window, total host-side
+        commit/decode/compile seconds, the overlapped share (commit
+        work hidden inside the replay window), and the wave count to
+        amortize by.  Cumulative since the last reset; session=<id>
+        reads the per-session aggregates."""
+        with self._lock:
+            agg = self._sagg.get(session, {}) if session is not None \
+                else self._agg
+            device = sum(agg[n]["total_seconds"]
+                         for n in self._DEVICE_WINDOW_SPANS if n in agg)
+            host = sum(agg[n]["total_seconds"]
+                       for n in self._HOST_SPANS if n in agg)
+            waves = sum(agg[n]["count"]
+                        for n in self._DEVICE_WINDOW_SPANS if n in agg)
+            cnt = (self._scounters.get(session, {}) if session is not None
+                   else self._counters)
+            overlap = cnt.get("commit_stream_overlap_seconds", 0.0)
+        return {
+            "device_window_seconds": round(device, 6),
+            "host_seconds": round(host, 6),
+            "overlapped_seconds": round(float(overlap), 6),
+            "waves": waves,
+        }
+
     def summary(self) -> dict:
         """Back-compat aggregate view: span aggregates + plain counters
         (the pre-flight-recorder shape; snapshot() adds the labeled
@@ -445,7 +612,16 @@ class Tracer:
                     "spans": sagg,
                     "counters": dict(self._scounters.get(session, {})),
                     "time": time.time(),
-                    "gauges": {},
+                    # the session's gauge view: last values set under
+                    # its scope, plus labeled series carrying its label
+                    "gauges": dict(self._sgauges.get(session, {})),
+                    "labeled_gauges": {
+                        name: [{"labels": dict(key), "value": v}
+                               for key, v in sorted(series.items())
+                               if skey in key]
+                        for name, series in sorted(self._lgauges.items())
+                        if any(skey in key for key in series)
+                    },
                     "labeled_counters": {
                         name: [{"labels": dict(key), "value": v}
                                for key, v in sorted(series.items())
@@ -467,11 +643,17 @@ class Tracer:
                         if any(skey in key for key in series)
                     },
                 }
+            out["time_split"] = self.time_split(session)
             return out
         out = self.summary()
         with self._lock:
             out["time"] = time.time()
             out["gauges"] = dict(self._gauges)
+            out["labeled_gauges"] = {
+                name: [{"labels": dict(key), "value": v}
+                       for key, v in sorted(series.items())]
+                for name, series in sorted(self._lgauges.items())
+            }
             out["labeled_counters"] = {
                 name: [{"labels": dict(key), "value": v}
                        for key, v in sorted(series.items())]
@@ -488,6 +670,7 @@ class Tracer:
                 }
                 for name, series in sorted(self._hists.items())
             }
+        out["time_split"] = self.time_split()
         return out
 
     # ------------------------------------------------------- prometheus
@@ -508,6 +691,7 @@ class Tracer:
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
+            lgauges = {n: dict(s) for n, s in self._lgauges.items()}
             lcounters = {n: dict(s) for n, s in self._lcounters.items()}
             hists = {
                 n: (self._hist_bounds[n],
@@ -536,9 +720,15 @@ class Tracer:
                 out.append(f"{m} {_fmt_float(counters[name])}")
             for key, v in sorted(lcounters.get(name, {}).items()):
                 out.append(f"{m}{self._render_labels(key)} {_fmt_float(v)}")
-        for name, v in sorted(gauges.items()):
+        # gauges merge plain + labeled series (the HBM sampler sets the
+        # per-device labeled samples AND the unlabeled aggregate) into
+        # one family, exactly like counters above
+        for name in sorted(set(gauges) | set(lgauges)):
             m = family(name, "gauge")
-            out.append(f"{m} {_fmt_float(v)}")
+            if name in gauges:
+                out.append(f"{m} {_fmt_float(gauges[name])}")
+            for key, v in sorted(lgauges.get(name, {}).items()):
+                out.append(f"{m}{self._render_labels(key)} {_fmt_float(v)}")
         for name, (bounds, series) in sorted(hists.items()):
             m = family(name, "histogram")
             for key, (bcounts, hsum, hcount) in sorted(series.items()):
@@ -611,11 +801,14 @@ class Tracer:
             self._agg.clear()
             self._counters.clear()
             self._gauges.clear()
+            self._lgauges.clear()
             self._lcounters.clear()
             self._hists.clear()
             self._hist_bounds.clear()
             self._scounters.clear()
             self._sagg.clear()
+            self._sgauges.clear()
+            self._open.clear()
 
     # -------------------------------------------------------- XLA profile
 

@@ -43,6 +43,10 @@ const API = {
         (limit ? "limit=" + limit : "") +
         (limit && session ? "&" : "") +
         (session ? "session=" + session : "")),
+  // wave black box (docs/metrics.md post-mortem dumps): a live bundle
+  // plus metadata of recently stored dumps
+  getDebugDump: (session) =>
+    api("GET", "/api/v1/debug/dump" + (session ? "?session=" + session : "")),
   // multi-session serving (docs/api.md): CRUD + per-session routing —
   // sessionPath("a", "pods") -> "/api/v1/sessions/a/pods"
   sessions: () => api("GET", "/api/v1/sessions"),

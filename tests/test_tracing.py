@@ -29,6 +29,73 @@ def test_tracer_spans_and_counters():
     assert t.summary() == {"spans": {}, "counters": {}}
 
 
+def test_event_ring_counts_drops():
+    """A full span ring must not lose its tail silently: every evicted
+    event counts in tracer_events_dropped_total, surfaced by summary()
+    and the Prometheus exposition."""
+    t = Tracer(capacity=4)
+    for _ in range(4):
+        with t.span("s"):
+            pass
+    assert "tracer_events_dropped_total" not in t.summary()["counters"]
+    for _ in range(3):
+        with t.span("s"):
+            pass
+    assert t.summary()["counters"]["tracer_events_dropped_total"] == 3
+    assert "kss_tpu_tracer_events_dropped_total 3" in t.prometheus_text()
+    assert len(t.events(limit=100)) == 4  # the ring itself stays bounded
+
+
+def test_gauge_session_scope_and_labels():
+    """Gauges honor the session scope (mirrored into the per-session
+    snapshot view) and accept labels (the HBM sampler's per-device
+    series), folding the active session label in like inc() does."""
+    from kube_scheduler_simulator_tpu.utils.tracing import validate_exposition
+
+    t = Tracer()
+    t.gauge("plain_g", 7)
+    with t.session_scope("sa"):
+        t.gauge("scoped_g", 3)
+        t.gauge("labeled_g", 11, device="0")
+    with t.session_scope("sb"):
+        t.gauge("scoped_g", 5)
+    snap = t.snapshot()
+    assert snap["gauges"]["plain_g"] == 7
+    assert snap["gauges"]["scoped_g"] == 5  # last write wins aggregate
+    assert snap["labeled_gauges"]["labeled_g"] == [
+        {"labels": {"device": "0", "session": "sa"}, "value": 11}]
+    sa = t.snapshot(session="sa")
+    assert sa["gauges"]["scoped_g"] == 3
+    assert sa["gauges"]["labeled_g"] == 11
+    assert sa["labeled_gauges"]["labeled_g"][0]["value"] == 11
+    sb = t.snapshot(session="sb")
+    assert sb["gauges"] == {"scoped_g": 5}
+    assert "labeled_g" not in sb["labeled_gauges"]
+    # one family per gauge name even when plain + labeled series mix
+    t.gauge("labeled_g", 20)
+    fams = validate_exposition(t.prometheus_text())
+    assert fams["kss_tpu_labeled_g"]["type"] == "gauge"
+    assert len(fams["kss_tpu_labeled_g"]["samples"]) == 2
+
+
+def test_open_spans_and_time_split():
+    t = Tracer()
+    with t.span("replay_and_decode_stream"):
+        with t.span("inner"):
+            open_now = t.open_spans()
+    names = [s["name"] for s in open_now]
+    assert names == ["replay_and_decode_stream", "inner"]
+    assert all(s["seconds_so_far"] >= 0 for s in open_now)
+    assert t.open_spans() == []
+    with t.span("commit_and_reflect"):
+        pass
+    split = t.time_split()
+    assert split["waves"] == 1
+    assert split["device_window_seconds"] >= 0
+    assert split["host_seconds"] >= 0
+    assert "time_split" in t.snapshot()
+
+
 def test_engine_emits_spans_and_counts():
     TRACER.reset()
     store = ObjectStore()

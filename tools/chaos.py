@@ -294,8 +294,23 @@ def run_seed(seed: int, shape: dict, witness=None) -> dict:
             witness.assert_no_cycles()
         except AssertionError as e:
             failures.append(f"lock witness: {e}")
+    dump_path = None
+    if failures:
+        # a red chaos run ships its own evidence: snapshot the wave
+        # black box (event ring, counter deltas, armed plan, device
+        # fingerprint) next to the reproducing seed so debugging starts
+        # from the dump, not from a re-run (docs/fault-injection.md)
+        import tempfile
+
+        from kube_scheduler_simulator_tpu.utils.blackbox import BLACKBOX
+
+        _doc, dump_path = BLACKBOX.dump(
+            "chaos_failure", write=True,
+            directory=(os.environ.get("KSS_TPU_BLACKBOX_DIR")
+                       or tempfile.gettempdir()))
     return {"ok": not failures, "seed": seed, "failures": failures,
-            "injected": injected, "modes": chaos["modes"]}
+            "injected": injected, "modes": chaos["modes"],
+            "dump": dump_path}
 
 
 QUICK_SHAPE = {"nodes": 5, "pods": 14, "gangs": 1, "gang_members": 3,
@@ -318,6 +333,9 @@ def chaos_verdict(seeds: int = DEFAULT_SEEDS, seed_base: int = 1,
         "injected_total": sum(r["injected"] for r in results),
         "failures": [f for r in results for f in
                      (f"seed {r['seed']}: {m}" for m in r["failures"])],
+        # black-box dumps written for failing seeds (None entries for
+        # green seeds are dropped): the first thing to open on a red run
+        "dumps": [r["dump"] for r in results if r.get("dump")],
         "seconds": round(time.perf_counter() - t0, 2),
     }
 
@@ -350,6 +368,9 @@ def main(argv=None) -> int:
         print(f"chaos: FAIL — reproduce with: KSS_TPU_LOCK_WITNESS=1 "
               f"JAX_PLATFORMS=cpu python -m tools.chaos --seeds 1 "
               f"--seed-base {bad.split()[-1]}", file=sys.stderr)
+        for p in verdict.get("dumps") or []:
+            print(f"chaos: black-box post-mortem dump: {p}",
+                  file=sys.stderr)
         return 1
     print(f"chaos: ok — {len(verdict['seeds'])} seeds, "
           f"{verdict['injected_total']} faults injected, "
