@@ -8,7 +8,7 @@
 PY ?= python
 
 .PHONY: codec native-asan native-tsan test test-asan test-tsan analyze \
-        bench bench-check bench-gang bench-serve bench-spec \
+        bench bench-check bench-gang bench-serve bench-spec bench-fuse \
         bench-multichip blackbox-smoke smoke chaos clean parity-fullscale \
         parity-fullscale-device multichip-scaling host-probe tpu-watch
 
@@ -134,6 +134,31 @@ bench-spec:
 	    print('bench-spec: %.1fx vs scan (%.0f vs %.0f cycles/s), accept rate %.2f over %d rounds; contended: %.2fx, accept %.2f, %d fallback(s)' \
 	        % (low['speedup'], low['speculative_cycles_per_sec'], low['sequential_cycles_per_sec'], low['accept_rate'], low['rounds'], \
 	           s['contended']['speedup'], s['contended']['accept_rate'], s['contended']['fallbacks']))"
+
+# cross-session fused dispatch A/B (docs/wave-pipeline.md fused-dispatch
+# stage): K sessions' speculative rounds stacked into one vmapped device
+# call vs KSS_TPU_FUSE=0 time-sharing, asserting byte-identical
+# per-session bindings/annotations in the same run.  The gate enforces
+# the parity bar and that fused batches actually form (>= 1 fused device
+# call per K) — NOT a speedup floor: on the 2-core CPU geometry the
+# time-shared arm already parallelizes K solo calls across cores, so
+# fusion measures ~0.5x at K=4 / ~0.8x at K=8 (docs/wave-pipeline.md
+# states the mesh-dp projection: on a dp-extent mesh the stacked session
+# axis lays over devices and the fused call IS the parallelism, minus
+# K-1 dispatches).  bench_check tracks the committed trajectory.
+bench-fuse:
+	$(PY) bench.py --fuse | tee /tmp/bench_fuse.json
+	$(PY) -c "import json; d = [json.loads(l) for l in open('/tmp/bench_fuse.json') if l.startswith('{')][-1]; \
+	    allk = d['extra']['fuse']; \
+	    ks = {k: v for k, v in allk.items() if 'parity_byte_identical' in v}; \
+	    skipped = {k: v.get('error') for k, v in allk.items() if k not in ks}; \
+	    assert ks, 'no fuse measurements landed'; \
+	    assert all(v['parity_byte_identical'] for v in ks.values()), (ks, 'fused vs time-shared parity violated'); \
+	    assert all(v['fused_device_calls'] >= 1 for v in ks.values()), (ks, 'no fused batches formed'); \
+	    print('\n'.join('bench-fuse %s: SKIPPED (%s)' % kv for kv in skipped.items())); \
+	    print('\n'.join('bench-fuse k=%s: fused %.0f vs time-shared %.0f aggregate cycles/s (%.2fx), p99 %.0f vs %.0f, %d fused calls, parity OK' \
+	        % (k.lstrip('k'), v['fuse_aggregate_cycles_per_sec'], v['timeshared_aggregate_cycles_per_sec'], v['aggregate_speedup'], \
+	           v['fuse_p99_session_cycles_per_sec'], v['timeshared_p99_session_cycles_per_sec'], v['fused_device_calls']) for k, v in sorted(ks.items())))"
 
 # chaos gate (docs/fault-injection.md): concurrent multi-session waves
 # under seeded fault plans at every seam, asserting completion via

@@ -1022,6 +1022,163 @@ def measure_speculative(scale_pods: int, scale_nodes: int, seed: int,
             "low_contention": low, "contended": contended}
 
 
+def measure_fuse(k_sessions: int, scale_pods: int, scale_nodes: int,
+                 seed: int, reps: int = 2, window_ms: int = 200):
+    """`make bench-fuse`: cross-session fused dispatch A/B
+    (parallel/fuse.py).  K sessions over the SAME reserved-slot fleet
+    shape schedule concurrently twice — once with fusion on
+    (KSS_TPU_FUSE=1, a generous straggler window so batch-mates
+    reliably meet) and once time-shared (KSS_TPU_FUSE=0) — arms
+    interleaved in one process so host noise hits both.  Reports
+    best-of-`reps` aggregate and p99 per-session cycles/s per arm, the
+    coordinator's dispatch tallies, and asserts the parity bar IN THE
+    SAME RUN: every session's bound state (nodeName + annotations per
+    pod) byte-identical across arms."""
+    import copy
+    import os
+    import threading
+
+    import numpy as np
+
+    from kube_scheduler_simulator_tpu.models.workloads import (
+        make_slot_pinned_workload)
+    from kube_scheduler_simulator_tpu.parallel.fuse import FUSE
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+    from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+
+    enabled = ["NodeResourcesFit", "NodeResourcesBalancedAllocation",
+               "NodeAffinity"]
+    nodes, pods = make_slot_pinned_workload(scale_pods, scale_nodes,
+                                            seed=seed)
+    log(f"fuse A/B: {k_sessions} sessions x ({scale_pods} pods x "
+        f"{scale_nodes} nodes slot-pinned), fused vs time-shared")
+    mgr = SessionManager(max_sessions=k_sessions + 1, idle_ttl=0,
+                         start_scheduler=False)
+    sessions = []
+    for i in range(k_sessions):
+        sess = mgr.create(f"fuse-{i}")
+        sess.di.engine.set_profiles(None)
+        sess.di.engine.plugin_config = PluginSetConfig(enabled=list(enabled))
+        for n in nodes:
+            sess.di.store.create("nodes", copy.deepcopy(n))
+        sessions.append(sess)
+
+    def wave(fuse_on: bool, capture: bool) -> tuple[float, list, list]:
+        for sess in sessions:
+            for p in pods:
+                sess.di.store.create("pods", copy.deepcopy(p))
+        prev = {k: os.environ.get(k)
+                for k in ("KSS_TPU_FUSE", "KSS_TPU_FUSE_WINDOW_MS")}
+        os.environ["KSS_TPU_FUSE"] = "1" if fuse_on else "0"
+        os.environ["KSS_TPU_FUSE_WINDOW_MS"] = str(window_ms)
+        barrier = threading.Barrier(k_sessions)
+        walls = [0.0] * k_sessions
+        errs: list = []
+
+        def run(i: int):
+            try:
+                barrier.wait()
+                t0 = time.perf_counter()
+                sessions[i].di.engine.schedule_pending()
+                walls[i] = time.perf_counter() - t0
+            except Exception as e:
+                errs.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                   for i in range(k_sessions)]
+        try:
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if errs:
+            raise RuntimeError(f"fuse wave ({fuse_on=}): {errs[0]}")
+        states = []
+        for sess in sessions:
+            state = None
+            if capture:
+                state = {}
+                for p in sess.di.store.list("pods", copy_objects=False)[0]:
+                    meta = p["metadata"]
+                    state[meta["name"]] = (
+                        (p.get("spec") or {}).get("nodeName"),
+                        tuple(sorted((meta.get("annotations")
+                                      or {}).items())))
+            states.append(state)
+            for p in sess.di.store.list("pods", copy_objects=False)[0][:]:
+                meta = p["metadata"]
+                sess.di.store.delete("pods", meta["name"],
+                                     meta.get("namespace"))
+        return wall, walls, states
+
+    # one warm wave per arm: XLA compiles (the solo rungs, then the
+    # fused K-stacked executables) stay out of the measured reps
+    wave(True, capture=False)
+    wave(False, capture=False)
+    stats0 = FUSE.stats()
+    fused_states = solo_states = None
+    fused_aggs, fused_p99s, solo_aggs, solo_p99s = [], [], [], []
+    for r in range(reps):
+        capture = r == 0
+        wall, walls, st = wave(True, capture=capture)
+        if capture:
+            fused_states = st
+        fused_aggs.append(k_sessions * scale_pods / wall)
+        fused_p99s.append(float(np.percentile(
+            [scale_pods / w for w in walls], 1)))
+        wall, walls, st = wave(False, capture=capture)
+        if capture:
+            solo_states = st
+        solo_aggs.append(k_sessions * scale_pods / wall)
+        solo_p99s.append(float(np.percentile(
+            [scale_pods / w for w in walls], 1)))
+    stats1 = FUSE.stats()
+    mgr.shutdown()
+    fused_calls = stats1["fusedDeviceCalls"] - stats0["fusedDeviceCalls"]
+    tally = {k: stats1["dispatches"].get(k, 0)
+             - stats0["dispatches"].get(k, 0)
+             for k in ("fused", "timeshared", "window_timeout")}
+    # the parity bar, asserted in the same run as the measurement: a
+    # fused wave that drifted a single annotation byte is a wrong
+    # answer, not a fast one
+    parity = fused_states == solo_states
+    if not parity:
+        raise AssertionError(
+            "fused vs time-shared session state diverged — parity bar "
+            "violated")
+    if fused_calls < 1:
+        log("  WARNING: no fused device call happened in the fused arm "
+            "(window too short or rungs diverged)")
+    fig = {
+        "sessions": k_sessions, "pods": scale_pods, "nodes": scale_nodes,
+        "window_ms": window_ms,
+        "fuse_aggregate_cycles_per_sec": round(max(fused_aggs), 1),
+        "fuse_p99_session_cycles_per_sec": round(max(fused_p99s), 1),
+        "timeshared_aggregate_cycles_per_sec": round(max(solo_aggs), 1),
+        "timeshared_p99_session_cycles_per_sec": round(max(solo_p99s), 1),
+        "aggregate_speedup": round(max(fused_aggs) / max(solo_aggs), 3)
+            if solo_aggs and max(solo_aggs) else None,
+        "fused_device_calls": fused_calls,
+        "dispatches": tally,
+        "parity_byte_identical": parity,
+    }
+    log(f"  fused {fig['fuse_aggregate_cycles_per_sec']:,.0f} vs "
+        f"time-shared {fig['timeshared_aggregate_cycles_per_sec']:,.0f} "
+        f"aggregate cycles/s ({fig['aggregate_speedup']}x), p99 "
+        f"{fig['fuse_p99_session_cycles_per_sec']:,.0f} vs "
+        f"{fig['timeshared_p99_session_cycles_per_sec']:,.0f}, "
+        f"{fused_calls} fused device calls, parity OK")
+    return fig
+
+
 def measure_blackbox(scale_pods: int, scale_nodes: int, seed: int,
                      reps: int = 3):
     """Wave black-box overhead A/B (docs/metrics.md post-mortem dumps):
@@ -1242,6 +1399,11 @@ def main():
                          "(make bench-spec): default speculative wave vs "
                          "KSS_TPU_SPECULATIVE=0 sequential scan, "
                          "low-contention + contention-heavy scenarios")
+    ap.add_argument("--fuse", action="store_true",
+                    help="run ONLY the cross-session fused-dispatch A/B "
+                         "(make bench-fuse): K sessions fused "
+                         "(KSS_TPU_FUSE=1) vs time-shared (=0), aggregate "
+                         "+ p99 cycles/s as K scales, parity asserted")
     ap.add_argument("--skip-parity", action="store_true")
     ap.add_argument("--skip-config5", action="store_true")
     ap.add_argument("--skip-engine", action="store_true")
@@ -1272,6 +1434,32 @@ def main():
             "metric": "speculative_bench",
             "value": fig["low_contention"]["speculative_cycles_per_sec"],
             "unit": "cycles/s", "extra": {"speculative": fig}}))
+        return
+    if args.fuse:
+        # standalone fused-dispatch A/B (make bench-fuse): session
+        # workloads are far under the page cliff, no THP machinery
+        if args.smoke:
+            ks, fig = [2], {2: measure_fuse(2, 60, 30, args.seed, reps=1)}
+        else:
+            ks = [2, 4, 8]
+            fig = {k: measure_fuse(k, 600, 300, args.seed) for k in ks}
+        headline = fig[4 if 4 in fig else ks[0]]
+        extra = {f"k{k}": fig[k] for k in ks}
+        if not args.smoke:
+            # the big-fleet point: K=2 at the 10k x 5k slot-pinned
+            # shape, one rep (compile-dominated past that); skip-safe so
+            # a memory-starved host still ships the 600x300 sweep
+            try:
+                extra["k2_10k"] = measure_fuse(2, 10000, 5000, args.seed,
+                                               reps=1)
+            except Exception as e:  # noqa: BLE001 — reported, not fatal
+                extra["k2_10k"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps({
+            "metric": "fuse_bench",
+            "value": headline["fuse_aggregate_cycles_per_sec"],
+            "unit": "cycles/s",
+            "extra": {"fuse": extra}}))
         return
     if args.gang:
         # standalone gang shape (make bench-gang): no THP/forkserver
@@ -1508,6 +1696,19 @@ def _run(args):
         except Exception as e:  # never trade the headline for this tap
             log(f"speculative phase failed: {type(e).__name__}: {e}")
             extra["speculative"] = None
+
+    # --- cross-session fused dispatch A/B -------------------------------
+    # rides every committed BENCH round so bench_check can gate the
+    # fused aggregate/p99 trajectory at K=4 (union/skip semantics keep
+    # pre-fuse rounds green); parity asserted inside the measurement
+    if not args.assume_fallback:
+        try:
+            extra["fuse"] = (measure_fuse(2, 60, 30, args.seed, reps=1)
+                             if args.smoke else
+                             measure_fuse(4, 600, 300, args.seed))
+        except Exception as e:  # never trade the headline for this tap
+            log(f"fuse phase failed: {type(e).__name__}: {e}")
+            extra["fuse"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     # --- wave black box -------------------------------------------------
     # overhead A/B (on vs KSS_TPU_BLACKBOX=0) + byte-identity assert

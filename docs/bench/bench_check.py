@@ -59,6 +59,7 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
     serve = extra.get("serve") or {}
     spec = (extra.get("speculative") or {}).get("low_contention") or {}
     bbox = extra.get("blackbox") or {}
+    fuse = extra.get("fuse") or {}
     spans10k = eng10k.get("spans") or {}
     return {
         "decode_pods_per_sec": (extra.get("decode_pods_per_sec"), "higher"),
@@ -116,6 +117,15 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
         # being free (the <=2% acceptance bar, noise-bound)
         "blackbox_overhead_ratio":
             (bbox.get("overhead_ratio"), "higher"),
+        # cross-session fused dispatch era metrics (absent from pre-fuse
+        # rounds — union/skip carries them): the K=4 fused arm's
+        # aggregate and slowest-session cycles/s; a drop means the fused
+        # batches stopped forming (window/rung divergence) or the
+        # stacked executable got slower than time-sharing
+        "fuse_aggregate_cycles_per_sec":
+            (fuse.get("fuse_aggregate_cycles_per_sec"), "higher"),
+        "fuse_p99_session_cycles_per_sec":
+            (fuse.get("fuse_p99_session_cycles_per_sec"), "higher"),
     }
 
 

@@ -44,6 +44,16 @@ def make_mesh(n_devices: int | None = None, dp: int = 1) -> Mesh:
     n = n_devices or len(devices)
     if n > len(devices):
         raise ValueError(f"asked for {n} devices, only {len(devices)} present")
+    if dp < 1:
+        raise ValueError(f"dp must be >= 1, got {dp}")
+    if n % dp:
+        # the reshape below would otherwise fail with an opaque numpy
+        # shape error (or, for a floor-divided node count, silently drop
+        # devices off the mesh) — name the actual constraint instead
+        raise ValueError(
+            f"n_devices ({n}) must divide evenly by dp ({dp}): a "
+            f"(dp={dp}) x (nodes={n}/{dp}) mesh is not integral — pick a "
+            f"dp that divides the device count")
     nodes = n // dp
     arr = np.array(devices[:n]).reshape(dp, nodes)
     return Mesh(arr, axis_names=("dp", "nodes"))

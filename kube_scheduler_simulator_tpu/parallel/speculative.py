@@ -118,6 +118,7 @@ from ..utils.blackbox import BLACKBOX
 from ..utils.env import env_float, env_int
 from ..utils.faults import fault_point
 from ..utils.tracing import TRACER
+from .fuse import FUSE, fuse_enabled, session_admitted
 
 # per-node plugins with no cross-pod coupling: filters are static or
 # monotone in node allocation, scores depend only on the node's own
@@ -693,19 +694,56 @@ def replay_speculative_stream(
     tiers = (("i64",) if "i64" in cw.host.get("score_dtypes", ())
              else (None, "i32", "i64"))
     for wide in tiers:
-        result = _spec_run(cw, mesh, chunk, unroll, batch, on_chunk,
-                           device_resident, wide, inter, gang, scan_fallback,
-                           ignore)
+        # cross-session fused dispatch (parallel/fuse.py): announce this
+        # stream's shape family so compatible tenants' rounds can stack
+        # into one device call.  The try/finally — not the happy path —
+        # is the lifecycle contract: a wave abort mid-round must not
+        # leave partners counting a dead stream as a batch-mate, and the
+        # retry re-opens cleanly.
+        fuse_stream = None
+        if fuse_enabled():
+            fuse_stream = FUSE.stream_open(
+                _fuse_family(cw, chunk, mesh, wide, ignore),
+                admitted=session_admitted(TRACER.current_session()),
+                mesh=mesh)
+        try:
+            result = _spec_run(cw, mesh, chunk, unroll, batch, on_chunk,
+                               device_resident, wide, inter, gang,
+                               scan_fallback, ignore,
+                               fuse_stream=fuse_stream)
+        finally:
+            if fuse_stream is not None:
+                FUSE.stream_close(fuse_stream)
         if result is not None:
             return result
         TRACER.count("replay_width_retries_total")
     raise AssertionError("unreachable: i64 speculative replay cannot overflow")
 
 
+def _fuse_family(cw: CompiledWorkload, chunk: int, mesh, wide,
+                 ignore: frozenset | set):
+    """The fuse-compatibility family: everything that picks which
+    compiled round executables a stream will call, short of the rung
+    (which joins the per-dispatch key).  Mirrors _spec_run's own cheap
+    derivations — two streams with equal families resolve the SAME
+    callables from the process compile cache, which is exactly the
+    stacking precondition.  Note the scan key fingerprints statics
+    CONTENT but only xs/carry SHAPES: heterogeneous tenants (different
+    pods, same fleet and queue size) fuse — the Gavel framing."""
+    chunk = min(chunk, max(cw.n_pods, 1))
+    base_key = _workload_scan_key(cw, chunk, mesh)
+    active_eff = set(cw.config.active_plugins()) - set(ignore)
+    kcand = min(max(env_int("KSS_TPU_SPECULATIVE_CANDIDATES", 128), 1),
+                cw.n_nodes)
+    sparse = _sparse_ok(active_eff) and kcand < cw.n_nodes
+    return (base_key, wide, sparse, kcand if sparse else None)
+
+
 def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
               batch: int | None, on_chunk, device_resident: bool,
               wide, inter, gang, scan_fallback: bool,
               ignore: frozenset | set = frozenset(),
+              fuse_stream=None,
               ) -> tuple[ReplayResult, dict] | None:
     from ..framework.gang import aligned_cut
     from .mesh import gather_to_host
@@ -876,6 +914,35 @@ def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
         return _memo("round", b, lambda: _sparse_round_fn(
             cw, base_key, b, pack_mode, score_dtypes, wide, kcand))
 
+    def dense_round_for(b):
+        # the dense round's two device calls as ONE function so fusion
+        # has a single dispatch to stack; unfused it invokes the same
+        # two jitted callables the dense site always ran — byte-for-byte
+        # the solo path
+        def make():
+            ev, orc = eval_for(b), oracle_for(b)
+
+            def both(carry_in, xs_in):
+                outs = ev(carry_in, xs_in)
+                return outs, orc(outs.packed_filter, outs.prefilter_reject,
+                                 outs.selected)
+
+            return both
+
+        return _memo("dense_round", b, make)
+
+    def fused_call(kind: str, b: int, fn, carry_in, xs_in):
+        """Route one round's device call through the fuse coordinator:
+        with no open stream (fusion off) or a closed one (this stream
+        already fell back to the scan) it IS the direct call.  The
+        dispatch key extends the family with everything else the solo
+        executable was cached under, so only calls to the same compiled
+        program ever stack."""
+        if fuse_stream is None or fuse_stream.closed:
+            return fn(carry_in, xs_in)
+        return FUSE.dispatch(fuse_stream, (fuse_stream.family, kind, b),
+                             fn, (carry_in, xs_in))
+
     def place_batch(xs_batch):
         if mesh is None:
             return xs_batch
@@ -964,7 +1031,8 @@ def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
                 # (max count past the candidate cap) simply discards the
                 # sparse output and re-runs dense
                 (packed, reject_d, counts_d, raw8, raw16, raw32, ovf_d,
-                 sel_dev, k_dev) = round_for(b)(carry, xs)
+                 sel_dev, k_dev) = fused_call("round", b, round_for(b),
+                                              carry, xs)
                 fault_point("replay.decision_fetch")
                 fc = np.asarray(counts_d)
                 rej = np.asarray(reject_d)
@@ -976,9 +1044,8 @@ def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
                     rows = {"packed": packed, "raw8": raw8, "raw16": raw16,
                             "raw32": raw32, "fc": counts_d}
             if dense:
-                outs = eval_for(b)(carry, xs)
-                k_dev = oracle_for(b)(outs.packed_filter,
-                                      outs.prefilter_reject, outs.selected)
+                outs, k_dev = fused_call("dense", b, dense_round_for(b),
+                                         carry, xs)
                 fault_point("replay.decision_fetch")
                 sel = np.asarray(outs.selected)
                 fc = np.asarray(outs.feasible_count)
@@ -1041,6 +1108,12 @@ def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
                 low_streak += 1
                 if low_streak >= fallback_rounds:
                     mode = "scan"
+                    # the scan tail dispatches no more rounds: close the
+                    # fuse stream NOW (idempotent — the tier loop's
+                    # finally closes again harmlessly) so partner
+                    # leaders stop counting this stream as a batch-mate
+                    if fuse_stream is not None:
+                        FUSE.stream_close(fuse_stream)
                     stats.fallback_at = lo
                     TRACER.inc("speculative_fallbacks_total")
                     BLACKBOX.record("speculative.fallback", at=lo,

@@ -273,6 +273,7 @@ class SessionManager:
     def stats(self) -> dict:
         """Process-shell view: admission knobs + the shared pieces."""
         from ..framework.replay import _DEVICE_BUDGET, scan_cache_stats
+        from ..parallel.fuse import FUSE
         from ..utils.tracing import TRACER
 
         retained = {
@@ -295,8 +296,11 @@ class SessionManager:
             "deviceChunksRetained": retained,
             # per-session speculative commit rate (docs/metrics.md):
             # accepted / (accepted + rolled back) since process start —
-            # the measured baseline cross-session wave batching builds on
+            # the admission signal cross-session fused dispatch reads
             "speculative": speculative_commit_rates(TRACER),
+            # cross-session fused dispatch (parallel/fuse.py): knob
+            # state + lifetime outcome tallies (docs/api.md)
+            "fuse": FUSE.stats(),
         }
 
     # ------------------------------------------------------- admission

@@ -76,6 +76,10 @@ BUCKETS: dict[str, tuple[float, ...]] = {
     # XLA scan builds run ~0.1s (warm shapes) to tens of seconds (cold
     # giant meshes): a wider exponential ladder than the attempt buckets
     "scan_compile_build_seconds": _exp_buckets(0.01, 2, 14),
+    # sessions sharing one fused device dispatch — a small integer
+    # (1 = ran solo), not a duration (parallel/fuse.py, docs/metrics.md)
+    "fused_sessions_per_dispatch": (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0,
+                                    16.0),
 }
 _DEFAULT_BUCKETS = _exp_buckets(0.001, 2, 15)
 

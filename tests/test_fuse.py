@@ -1,0 +1,384 @@
+"""Cross-session fused wave dispatch (parallel/fuse.py): coordinator
+protocol units plus the engine-level golden parity bar — each session's
+annotations and bind order byte-identical fused vs `KSS_TPU_FUSE=0`
+solo, including a gang-bearing session fused with a plain-pod session
+and a mid-dispatch injected fault retrying only the faulted session's
+suffix (docs/wave-pipeline.md fused-dispatch stage)."""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+
+import jax.numpy as jnp
+
+from kube_scheduler_simulator_tpu.models.workloads import (
+    make_slot_pinned_workload)
+from kube_scheduler_simulator_tpu.parallel.fuse import (
+    FUSE, FuseCoordinator, session_admitted)
+from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+from kube_scheduler_simulator_tpu.utils.tracing import TRACER
+
+ENABLED = ["NodeResourcesFit", "NodeResourcesBalancedAllocation",
+           "NodeAffinity"]
+
+
+# ------------------------------------------------- coordinator protocol
+
+
+def _solo_fn(c, x):
+    return c + x, (c * x).sum()
+
+
+def test_dispatch_timeshares_without_a_live_partner(monkeypatch):
+    monkeypatch.setenv("KSS_TPU_FUSE_WINDOW_MS", "5000")
+    c = FuseCoordinator()
+    s = c.stream_open("fam-alone")
+    out = c.dispatch(s, ("fam-alone", "k1"), _solo_fn,
+                     (jnp.arange(4), jnp.ones(4)))
+    assert jnp.array_equal(out[0], jnp.arange(4) + 1)
+    # a benched stream never joins batches either, even with partners
+    s2 = c.stream_open("fam-alone")
+    benched = c.stream_open("fam-alone", admitted=False)
+    out = c.dispatch(benched, ("fam-alone", "k1"), _solo_fn,
+                     (jnp.arange(4), jnp.ones(4)))
+    assert jnp.array_equal(out[0], jnp.arange(4) + 1)
+    assert c.stats()["dispatches"]["timeshared"] == 2
+    assert c.stats()["fusedDeviceCalls"] == 0
+    for st in (s, s2, benched):
+        c.stream_close(st)
+    assert c.stats()["openFamilies"] == 0
+
+
+def test_leader_times_out_when_partner_never_dispatches(monkeypatch):
+    monkeypatch.setenv("KSS_TPU_FUSE_WINDOW_MS", "40")
+    c = FuseCoordinator()
+    s1 = c.stream_open("fam-to")
+    s2 = c.stream_open("fam-to")  # live partner that never calls
+    t0 = time.monotonic()
+    out = c.dispatch(s1, ("fam-to", "k1"), _solo_fn,
+                     (jnp.arange(3), jnp.ones(3)))
+    waited = time.monotonic() - t0
+    assert jnp.array_equal(out[0], jnp.arange(3) + 1)
+    assert waited >= 0.03, "leader should have waited out the window"
+    assert c.stats()["dispatches"]["window_timeout"] == 1
+    c.stream_close(s1)
+    c.stream_close(s2)
+
+
+def test_two_streams_fuse_one_device_call(monkeypatch):
+    monkeypatch.setenv("KSS_TPU_FUSE_WINDOW_MS", "5000")
+    c = FuseCoordinator()
+    streams = [c.stream_open("fam-2"), c.stream_open("fam-2")]
+    rows = [(jnp.arange(4) + 10 * i, jnp.full(4, float(i + 1)))
+            for i in range(2)]
+    outs: dict = {}
+
+    def run(i):
+        outs[i] = c.dispatch(streams[i], ("fam-2", "kA"), _solo_fn, rows[i])
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for i in range(2):
+        solo = _solo_fn(*rows[i])
+        assert jnp.array_equal(outs[i][0], solo[0]), f"row {i} diverged"
+        assert jnp.array_equal(outs[i][1], solo[1])
+    st = c.stats()
+    assert st["fusedDeviceCalls"] == 1
+    assert st["dispatches"]["fused"] == 2
+    assert st["meanSessionsPerFusedCall"] == 2.0
+    for s in streams:
+        c.stream_close(s)
+
+
+def test_mutual_leader_deadlock_breaks_and_realigns(monkeypatch):
+    """Two streams whose round ladders slipped out of phase: stream B
+    arriving at a DIFFERENT key while A leads must run solo immediately
+    (not sleep out the window), then fuse with A when it re-arrives at
+    A's key — the ladder-realignment rescue."""
+    monkeypatch.setenv("KSS_TPU_FUSE_WINDOW_MS", "10000")
+    c = FuseCoordinator()
+    sa, sb = c.stream_open("fam-dl"), c.stream_open("fam-dl")
+    args = (jnp.arange(4), jnp.ones(4))
+    out_a: list = []
+
+    ta = threading.Thread(
+        target=lambda: out_a.append(
+            c.dispatch(sa, ("fam-dl", "k1"), _solo_fn, args)))
+    ta.start()
+    time.sleep(0.2)  # A is now the registered leader at k1, waiting
+
+    t0 = time.monotonic()
+    out_b1 = c.dispatch(sb, ("fam-dl", "k2"), _solo_fn, args)
+    assert time.monotonic() - t0 < 5.0, (
+        "second leader at a different key slept toward the window "
+        "instead of breaking the mutual-leader deadlock")
+    # B catches up to A's rung: joins A's still-open batch, both fuse
+    out_b2 = c.dispatch(sb, ("fam-dl", "k1"), _solo_fn, args)
+    ta.join(timeout=30)
+    assert not ta.is_alive(), "leader A never completed"
+    solo = _solo_fn(*args)
+    for out in (out_a[0], out_b1, out_b2):
+        assert jnp.array_equal(out[0], solo[0])
+    st = c.stats()
+    assert st["fusedDeviceCalls"] == 1
+    assert st["dispatches"]["window_timeout"] == 1  # B's k2 solo
+    assert st["dispatches"]["fused"] == 2
+    c.stream_close(sa)
+    c.stream_close(sb)
+
+
+def test_fused_call_failure_surfaces_to_every_member(monkeypatch):
+    monkeypatch.setenv("KSS_TPU_FUSE_WINDOW_MS", "5000")
+    c = FuseCoordinator()
+    streams = [c.stream_open("fam-err"), c.stream_open("fam-err")]
+
+    def boom(carry, xs):
+        raise ValueError("device fell over")
+
+    errs: dict = {}
+
+    def run(i):
+        try:
+            c.dispatch(streams[i], ("fam-err", "kE"), boom,
+                       (jnp.ones(2), jnp.ones(2)))
+        except ValueError as e:
+            errs[i] = str(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert errs == {0: "device fell over", 1: "device fell over"}
+    assert c.stats()["dispatches"]["fused"] == 0
+    for s in streams:
+        c.stream_close(s)
+
+
+def test_admission_reads_session_accept_rates(monkeypatch):
+    monkeypatch.setenv("KSS_TPU_FUSE_MIN_ACCEPT", "0.25")
+    TRACER.reset()
+    with TRACER.session_scope("adm-hot"):
+        TRACER.inc("speculative_accepted_total", 9)
+        TRACER.inc("speculative_rolled_back_total", 1)
+    with TRACER.session_scope("adm-cold"):
+        TRACER.inc("speculative_accepted_total", 1)
+        TRACER.inc("speculative_rolled_back_total", 9)
+    assert session_admitted("adm-hot")
+    assert not session_admitted("adm-cold")
+    assert session_admitted("adm-never-seen")  # no history: optimistic
+
+
+# ----------------------------------------------- engine golden parity
+
+
+def _mk_sessions(specs):
+    """specs: [(name, nodes, config, podgroups)] -> (mgr, {name: sess},
+    {name: bind-order list})."""
+    mgr = SessionManager(max_sessions=len(specs) + 1, idle_ttl=0,
+                         start_scheduler=False)
+    sessions, orders = {}, {}
+    for name, nodes, cfg, pgs in specs:
+        sess = mgr.create(name)
+        eng = sess.di.engine
+        eng.set_profiles(None)
+        eng.plugin_config = cfg
+        if pgs is not None:
+            from kube_scheduler_simulator_tpu.plugins.coscheduling import (
+                ensure_podgroup_resource)
+
+            ensure_podgroup_resource(sess.di.store)
+            for pg in pgs:
+                sess.di.store.create("podgroups", copy.deepcopy(pg))
+        for n in nodes:
+            sess.di.store.create("nodes", copy.deepcopy(n))
+        order: list = []
+        orig_batch, orig_bind = eng._commit_pod_batch, eng._bind
+
+        def batch_spy(items, _orig=orig_batch, _order=order):
+            _order.extend((ns, n, node) for ns, n, node in items if node)
+            return _orig(items)
+
+        def bind_spy(ns, n, node, _orig=orig_bind, _order=order):
+            _order.append((ns, n, node))
+            return _orig(ns, n, node)
+
+        eng._commit_pod_batch = batch_spy
+        eng._bind = bind_spy
+        sessions[name] = sess
+        orders[name] = order
+    return mgr, sessions, orders
+
+
+def _run_arm(monkeypatch, sessions, orders, pods_by_session, fuse_on,
+             window_ms=4000):
+    """One concurrent wave across all sessions; returns per-session
+    (state, bind order) where state maps pod -> (nodeName, annotations)."""
+    monkeypatch.setenv("KSS_TPU_SPECULATIVE", "1")
+    monkeypatch.setenv("KSS_TPU_FUSE", "1" if fuse_on else "0")
+    monkeypatch.setenv("KSS_TPU_FUSE_WINDOW_MS", str(window_ms))
+    for name, sess in sessions.items():
+        for p in pods_by_session[name]:
+            sess.di.store.create("pods", copy.deepcopy(p))
+        orders[name].clear()
+    barrier = threading.Barrier(len(sessions))
+    errs: list = []
+
+    def run(sess):
+        try:
+            barrier.wait()
+            sess.di.engine.schedule_pending()
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=run, args=(s,), daemon=True)
+               for s in sessions.values()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errs, errs
+    result = {}
+    for name, sess in sessions.items():
+        state = {}
+        for p in sess.di.store.list("pods", copy_objects=False)[0]:
+            meta = p["metadata"]
+            state[meta["name"]] = (
+                (p.get("spec") or {}).get("nodeName"),
+                tuple(sorted((meta.get("annotations") or {}).items())))
+        result[name] = (state, list(orders[name]))
+        for p in sess.di.store.list("pods", copy_objects=False)[0][:]:
+            meta = p["metadata"]
+            sess.di.store.delete("pods", meta["name"],
+                                 meta.get("namespace"))
+    return result
+
+
+def _assert_arms_identical(fused, solo):
+    for name in solo:
+        fs, fo = fused[name]
+        ss, so = solo[name]
+        diff = sorted(k for k in ss if ss[k] != fs.get(k))
+        assert fs == ss, f"{name}: state diverged at {diff[:4]}"
+        assert fo == so, f"{name}: bind order diverged"
+
+
+def test_fused_sessions_byte_identical_to_solo(monkeypatch):
+    """The flagship bar: two sessions with DIFFERENT pods over the same
+    fleet fuse into shared device calls, and every annotation byte and
+    bind order matches their KSS_TPU_FUSE=0 runs — plus the fused
+    metric families land validator-clean."""
+    from kube_scheduler_simulator_tpu.utils.tracing import (
+        validate_exposition)
+
+    nodes, pods_a = make_slot_pinned_workload(24, 12, seed=71)
+    pods_b = make_slot_pinned_workload(24, 12, seed=72)[1]
+    cfg = lambda: PluginSetConfig(enabled=list(ENABLED))  # noqa: E731
+    mgr, sessions, orders = _mk_sessions(
+        [("fz-a", nodes, cfg(), None), ("fz-b", nodes, cfg(), None)])
+    try:
+        pods = {"fz-a": pods_a, "fz-b": pods_b}
+        before = FUSE.stats()["fusedDeviceCalls"]
+        fused = _run_arm(monkeypatch, sessions, orders, pods, fuse_on=True)
+        assert FUSE.stats()["fusedDeviceCalls"] - before >= 1, (
+            "the fused arm never stacked a cross-session batch")
+        solo = _run_arm(monkeypatch, sessions, orders, pods, fuse_on=False)
+        _assert_arms_identical(fused, solo)
+        assert all(v[0] for st, _o in fused.values() for v in st.values()), \
+            "slot-pinned workload should bind every pod"
+        fams = validate_exposition(TRACER.prometheus_text())
+        assert fams["kss_tpu_fused_dispatch_total"]["type"] == "counter"
+        assert fams["kss_tpu_fused_sessions_per_dispatch"]["type"] == \
+            "histogram"
+    finally:
+        mgr.shutdown()
+
+
+def test_gang_bearing_session_fuses_with_plain_session(monkeypatch):
+    """A gang-bearing session and a plain-pod session share one fused
+    batch (same fleet, same config — the shared Coscheduling instance
+    keeps the compile-cache family identical; the vectorized quorum
+    pass never consults the instance's engine binding) and both stay
+    byte-identical to their solo runs, gang admission included."""
+    from kube_scheduler_simulator_tpu.framework.gang import (
+        POD_GROUP_API_VERSION, POD_GROUP_LABEL)
+    from kube_scheduler_simulator_tpu.plugins.coscheduling import (
+        Coscheduling)
+
+    nodes, base_pods = make_slot_pinned_workload(16, 8, seed=81)
+    gang_pods = copy.deepcopy(base_pods)
+    pgs = []
+    for g, lo in enumerate((0, 3)):
+        gname = f"fzgang-{g}"
+        pgs.append({"apiVersion": POD_GROUP_API_VERSION,
+                    "kind": "PodGroup",
+                    "metadata": {"name": gname, "namespace": "default"},
+                    "spec": {"minMember": 3,
+                             "scheduleTimeoutSeconds": 30}})
+        for p in gang_pods[lo:lo + 3]:
+            p["metadata"].setdefault("labels", {})[POD_GROUP_LABEL] = gname
+    cos = Coscheduling()
+    enabled = ["NodeResourcesFit", "Coscheduling"]
+    cfg = lambda: PluginSetConfig(  # noqa: E731
+        enabled=list(enabled), custom={"Coscheduling": cos})
+    mgr, sessions, orders = _mk_sessions(
+        [("fz-gang", nodes, cfg(), pgs), ("fz-plain", nodes, cfg(), [])])
+    try:
+        pods = {"fz-gang": gang_pods, "fz-plain": base_pods}
+        before = FUSE.stats()["fusedDeviceCalls"]
+        fused = _run_arm(monkeypatch, sessions, orders, pods, fuse_on=True)
+        assert FUSE.stats()["fusedDeviceCalls"] - before >= 1, (
+            "gang-bearing and plain sessions never fused")
+        solo = _run_arm(monkeypatch, sessions, orders, pods, fuse_on=False)
+        _assert_arms_identical(fused, solo)
+        gang_state = fused["fz-gang"][0]
+        members = {}
+        for p in pods["fz-gang"]:
+            g = (p["metadata"].get("labels") or {}).get(POD_GROUP_LABEL)
+            if g:
+                members.setdefault(g, []).append(p["metadata"]["name"])
+        for g, names in members.items():
+            bound = [n for n in names if gang_state[n][0]]
+            assert len(bound) == 3, f"{g}: admitted gang must bind whole"
+    finally:
+        mgr.shutdown()
+
+
+def test_mid_dispatch_fault_retries_only_faulted_session(monkeypatch):
+    """An injected fault at the fuse.dispatch seam scoped to one session
+    aborts only that session's wave (suffix retry through the wave
+    failure protocol); its batch-mate proceeds untouched, and BOTH end
+    byte-identical to the fault-free solo runs — neighbor isolation."""
+    from kube_scheduler_simulator_tpu.utils import faults
+
+    nodes, pods_a = make_slot_pinned_workload(24, 12, seed=91)
+    pods_b = make_slot_pinned_workload(24, 12, seed=92)[1]
+    cfg = lambda: PluginSetConfig(enabled=list(ENABLED))  # noqa: E731
+    mgr, sessions, orders = _mk_sessions(
+        [("fz-f0", nodes, cfg(), None), ("fz-f1", nodes, cfg(), None)])
+    try:
+        pods = {"fz-f0": pods_a, "fz-f1": pods_b}
+        solo = _run_arm(monkeypatch, sessions, orders, pods, fuse_on=False)
+        TRACER.reset()
+        plan = faults.FaultPlan([
+            faults.FaultRule("fuse.dispatch", nth=2, error="runtime",
+                             sessions=["fz-f0"]),
+        ], seed=3)
+        with faults.armed(plan):
+            faulted = _run_arm(monkeypatch, sessions, orders, pods,
+                               fuse_on=True, window_ms=500)
+        assert plan.stats()["rules"][0]["trips"] == 1, "fault never fired"
+        retried = TRACER.snapshot(session="fz-f0")["counters"]
+        neighbor = TRACER.snapshot(session="fz-f1")["counters"]
+        assert retried.get("wave_retries_total", 0) >= 1, retried
+        assert neighbor.get("wave_retries_total", 0) == 0, (
+            "the fault leaked into the batch-mate's wave", neighbor)
+        _assert_arms_identical(faulted, solo)
+    finally:
+        mgr.shutdown()

@@ -106,6 +106,19 @@ def test_engine_schedules_with_mesh():
     assert a1 == a0
 
 
+def test_make_mesh_rejects_non_divisible_dp():
+    # regression (PR 16): a dp that does not divide the device count used
+    # to surface as an opaque numpy reshape error (or silently drop
+    # devices for floor-divided node counts) — make_mesh now names the
+    # constraint up front
+    with pytest.raises(ValueError, match="divide"):
+        make_mesh(8, dp=3)
+    with pytest.raises(ValueError, match="dp must be >= 1"):
+        make_mesh(8, dp=0)
+    # the divisible shapes still build
+    assert make_mesh(8, dp=2).shape == {"dp": 2, "nodes": 4}
+
+
 def test_speculative_batch_consistent_with_step():
     nodes, pods, cfg = _workload(n_nodes=8, n_pods=4, seed=82)
     cw = compile_workload(nodes, pods, cfg)

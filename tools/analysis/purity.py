@@ -78,6 +78,12 @@ HOT_PATH_ROOTS: list[tuple[str, str]] = [
     ("parallel.speculative", "_spec_run"),
     ("parallel.speculative", "_interaction_cut"),
     ("framework.gang", "aligned_cut"),
+    # cross-session fused dispatch (PR 16): the coordinator's join/
+    # stack/split path runs inside every speculative round of every
+    # session — it must stay free of per-pod loops, eager host syncs on
+    # stacked device pytrees, and (via the lock rules) device calls
+    # under the coordinator condition
+    ("parallel.fuse", "*"),
 ]
 
 BIG_ITERABLES = {"pending", "pods", "nodes"}

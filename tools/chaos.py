@@ -86,6 +86,11 @@ def _plan_for(seed: int, target: str):
         # store state — byte parity with the fault-free run must hold
         FaultRule("speculative.round", nth=rng.randint(1, 2),
                   error="runtime", times=1, sessions=[target]),
+        # fused-dispatch fault: fires on the requesting thread BEFORE it
+        # joins a batch, so only the target's wave aborts and retries —
+        # batch-mates (the neighbor) must be untouched (parallel/fuse.py)
+        FaultRule("fuse.dispatch", nth=rng.randint(1, 2),
+                  error="runtime", times=1, sessions=[target]),
         FaultRule("replay.decision_fetch", p=0.15, error="io", times=2,
                   sessions=[target]),
         # structural fault: steps the degradation ladder down a rung
