@@ -9,8 +9,9 @@ PY ?= python
 
 .PHONY: codec native-asan native-tsan test test-asan test-tsan analyze \
         bench bench-check bench-gang bench-serve bench-spec bench-fuse \
-        bench-multichip blackbox-smoke smoke chaos clean parity-fullscale \
-        parity-fullscale-device multichip-scaling host-probe tpu-watch
+        bench-multichip bench-scale blackbox-smoke smoke chaos clean \
+        parity-fullscale parity-fullscale-device multichip-scaling \
+        host-probe tpu-watch
 
 # measurement artifacts (committed under docs/bench/; see BASELINE.md)
 parity-fullscale:
@@ -44,6 +45,22 @@ bench-multichip:
 	    assert d.get('all_parity_ok') is True, 'sharded parity failed'; \
 	    assert d.get('result_mode') == 'device_resident', d.get('result_mode'); \
 	    print('bench-multichip: ok=true skipped=false (device-resident path, %d devices)' % d['devices'])"
+
+# CI-enforceable columnar scale gate: the 25k/50k/100k-node curve on the
+# columnar data plane (docs/data-plane.md) — every point parity-pinned
+# against the dict plane, the 100k workload build >=3x over the dict
+# baseline (same-process interleaved A/B), and an unchanged node set
+# must reuse the node table, never rebuild it
+bench-scale:
+	JAX_PLATFORMS=cpu $(PY) docs/bench/multichip_scaling.py --scale \
+	    /tmp/bench_scale.json
+	$(PY) -c "import json; d = json.load(open('/tmp/bench_scale.json')); \
+	    assert d['all_parity_ok'], 'columnar-vs-dict parity failed'; \
+	    assert d['never_rebuilt_on_unchanged_nodes'], 'node table rebuilt on an unchanged node set'; \
+	    assert d['all_delta_patched'], 'bounded node delta did not patch'; \
+	    assert d['scale_100k_build_speedup_vs_dict'] >= 3, 'speedup %.2fx < 3x' % d['scale_100k_build_speedup_vs_dict']; \
+	    print('bench-scale: ok=true all_parity_ok=true (100k: %.1fx build, %.1f cycles/s, %.0fMB RSS)' \
+	        % (d['scale_100k_build_speedup_vs_dict'], d['scale_100k_cycles_per_sec'], d['scale_100k_host_rss_mb']))"
 
 host-probe:
 	$(PY) docs/bench/host_page_backing.py

@@ -196,6 +196,63 @@ def check_multichip(root: Path) -> str | None:
     return None
 
 
+# columnar scale-curve keys gated across SCALE_*.json rounds (the
+# bench-scale artifact, docs/data-plane.md): throughput and build time at
+# the 100k-node point, and the host RSS the columnar plane is supposed to
+# hold down.  Same union/skip semantics as the BENCH keys: a key missing
+# on either side SKIPs, only a present-on-both-sides regression fails.
+SCALE_KEYS: list[tuple[str, str]] = [
+    ("scale_100k_cycles_per_sec", "higher"),
+    ("scale_100k_build_seconds", "lower"),
+    ("scale_100k_host_rss_mb", "lower"),
+]
+
+
+def check_scale(root: Path,
+                threshold: float = DEFAULT_THRESHOLD) -> tuple[str | None,
+                                                               list[dict]]:
+    """(sanity error or None, trajectory rows) over SCALE_*.json rounds.
+
+    Sanity: the newest round must have run parity-pinned (all_parity_ok)
+    and never rebuilt the node table on an unchanged node set — a round
+    that lost either invalidates the scale trajectory outright.
+    Trajectory: SCALE_KEYS compared newest-vs-previous with union/skip
+    semantics; fewer than two rounds yields no rows."""
+    rounds = _round_files(root, prefix="SCALE")
+    if not rounds:
+        return None, []
+    try:
+        new = json.loads(rounds[-1].read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return f"{rounds[-1].name}: unreadable ({e})", []
+    if not new.get("all_parity_ok"):
+        return (f"{rounds[-1].name}: all_parity_ok!=true — the columnar "
+                "data plane diverged from the dict baseline"), []
+    if not new.get("never_rebuilt_on_unchanged_nodes"):
+        return (f"{rounds[-1].name}: an unchanged node set rebuilt the "
+                "node table (reuse/delta path regressed)"), []
+    if len(rounds) < 2:
+        return None, []
+    try:
+        prev = json.loads(rounds[-2].read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return f"{rounds[-2].name}: unreadable ({e})", []
+    rows = []
+    for key, direction in SCALE_KEYS:
+        old_v, new_v = prev.get(key), new.get(key)
+        if not old_v or new_v is None:
+            rows.append({"metric": key, "old": old_v, "new": new_v,
+                         "ratio": None, "status": "skip"})
+            continue
+        ratio = new_v / old_v
+        bad = (ratio < 1 - threshold if direction == "higher"
+               else ratio > 1 + threshold)
+        rows.append({"metric": key, "old": old_v, "new": new_v,
+                     "ratio": round(ratio, 3),
+                     "status": "regression" if bad else "ok"})
+    return None, rows
+
+
 def main(argv: list[str]) -> int:
     import argparse
 
@@ -207,6 +264,10 @@ def main(argv: list[str]) -> int:
     mc_err = check_multichip(Path(args.dir))
     if mc_err is not None:
         print(f"bench-check: MULTICHIP sanity failed — {mc_err}")
+        return 2
+    sc_err, scale_rows = check_scale(Path(args.dir), args.threshold)
+    if sc_err is not None:
+        print(f"bench-check: SCALE sanity failed — {sc_err}")
         return 2
     files = _round_files(Path(args.dir))
     if len(files) < 2:
@@ -260,7 +321,7 @@ def main(argv: list[str]) -> int:
     print(f"bench-check: {prev_p.name} -> {new_p.name} "
           f"(threshold {args.threshold:.0%})")
     rc = 0
-    for row in compare(prev, new, args.threshold):
+    for row in compare(prev, new, args.threshold) + scale_rows:
         mark = {"ok": "OK  ", "skip": "SKIP", "regression": "FAIL"}[row["status"]]
         ratio = f'{row["ratio"]:.3f}' if row["ratio"] is not None else "-"
         print(f"  {mark} {row['metric']}: {row['old']} -> {row['new']} "

@@ -10,9 +10,18 @@ throughput CURVE shows SPMD structure (the program builds, shards, and
 executes at every mesh size), not hardware speedup — on real multi-chip
 the same code lays the node axis over ICI (parallel/mesh.py).
 
+The --scale mode runs the columnar data-plane curve instead: 25k/50k/
+100k-node waves on the ColumnarStatusStore (cluster/columnar.py), each
+point parity-pinned against the dict data plane (same bank rows
+materialized through the pre-columnar path), with an interleaved
+same-process workload-build A/B at 100k, per-point host RSS +
+HBM/D2H gauges, and TRACER counters proving an unchanged node set
+never rebuilds the node table (docs/data-plane.md).
+
 Usage:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
       python docs/bench/multichip_scaling.py [outfile]
+  JAX_PLATFORMS=cpu python docs/bench/multichip_scaling.py --scale [outfile]
 """
 
 from __future__ import annotations
@@ -29,7 +38,225 @@ force_cpu()
 import jax
 
 
+def _rss_mb() -> float:
+    """Current (not peak) resident set of this process, in MB."""
+    import os
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+    except (OSError, ValueError, IndexError):
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e3
+
+
+def _tree_equal(a, b) -> bool:
+    import numpy as np
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    if str(ta) != str(tb) or len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        try:
+            ok = np.array_equal(np.asarray(x), np.asarray(y))
+        except Exception:
+            ok = x == y
+        if not ok:
+            return False
+    return True
+
+
+def scale_curve(out_path: str):
+    """25k/50k/100k-node columnar data-plane curve (see module docstring)."""
+    import copy
+    import os
+
+    import numpy as np
+
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.models.workloads import (
+        make_nodes_columnar, make_pods_columnar, make_pods)
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+    from kube_scheduler_simulator_tpu.state.compile import compile_workload
+    from kube_scheduler_simulator_tpu.utils.blackbox import TELEMETRY
+    from kube_scheduler_simulator_tpu.utils.tracing import TRACER
+
+    POINTS = (25_000, 50_000, 100_000)
+    PODS = 400
+    cfg = PluginSetConfig(enabled=[
+        "NodeResourcesFit", "NodeResourcesBalancedAllocation",
+        "TaintToleration"])
+
+    def counters():
+        return dict(TRACER.summary()["counters"])
+
+    def delta(c_after, c_before, key):
+        return c_after.get(key, 0) - c_before.get(key, 0)
+
+    points = []
+    for n in POINTS:
+        node_bank = make_nodes_columnar(n, seed=5, taint_fraction=0.02)
+        pod_bank = make_pods_columnar(PODS, seed=6)
+        store = ObjectStore()
+        store.load_columnar("nodes", node_bank)
+        store.load_columnar("pods", pod_bank)
+        shared_nodes, _ = store.list("nodes", copy_objects=False)
+        shared_pods, _ = store.list("pods", copy_objects=False)
+        # the dict baseline is THIS bank's rows materialized to plain
+        # dicts (LazyManifest.__deepcopy__), so both arms compile the
+        # byte-identical population — parity, not generator agreement
+        dict_nodes = [copy.deepcopy(o) for o in shared_nodes]
+        dict_pods = [copy.deepcopy(o) for o in shared_pods]
+
+        # interleaved same-process build A/B: dict, columnar, dict,
+        # columnar — min of each arm, so warmup hits both arms equally
+        t_dict, t_col = [], []
+        cw_d = cw_c = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            cw_d = compile_workload(dict_nodes, dict_pods, cfg)
+            t_dict.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            cw_c = compile_workload(
+                shared_nodes, shared_pods, cfg,
+                pod_columns=getattr(shared_pods, "columns", None))
+            t_col.append(time.perf_counter() - t0)
+        build_dict, build_col = min(t_dict), min(t_col)
+
+        parity_ok = (
+            list(cw_d.node_table.names) == list(cw_c.node_table.names)
+            and np.array_equal(cw_d.node_table.allocatable,
+                               cw_c.node_table.allocatable)
+            and _tree_equal(cw_d.statics, cw_c.statics)
+            and _tree_equal(cw_d.xs, cw_c.xs)
+            and _tree_equal(cw_d.init_carry, cw_c.init_carry))
+
+        # wave 1: schedule the full queue on the columnar store
+        c0 = counters()
+        eng = SchedulerEngine(store, plugin_config=cfg, chunk=128)
+        t0 = time.perf_counter()
+        bound = eng.schedule_pending()
+        wave_s = time.perf_counter() - t0
+        c1 = counters()
+
+        # wave 2: new pods, UNCHANGED node set -> the node table must be
+        # reused, never rebuilt
+        extra = make_pods(50, seed=97)
+        for i, p in enumerate(extra):
+            p["metadata"]["name"] = f"extra-{i:04d}"
+            store.create("pods", p)
+        bound2 = eng.schedule_pending()
+        c2 = counters()
+
+        # wave 3: touch a bounded node subset -> delta patch, no rebuild
+        touched = 16
+        for i in range(touched):
+            nd = store.get("nodes", f"node-{i:05d}")
+            nd["metadata"].setdefault("labels", {})["kss.io/touched"] = "y"
+            store.update("nodes", nd)
+        extra2 = make_pods(50, seed=98)
+        for i, p in enumerate(extra2):
+            p["metadata"]["name"] = f"extra2-{i:04d}"
+            store.create("pods", p)
+        bound3 = eng.schedule_pending()
+        c3 = counters()
+
+        hbm = TELEMETRY.sample_once()
+        point = {
+            "nodes": n,
+            "pods": PODS,
+            "bound": [bound, bound2, bound3],
+            "build_dict_seconds": round(build_dict, 3),
+            "build_columnar_seconds": round(build_col, 3),
+            "build_speedup_vs_dict": round(build_dict / build_col, 2),
+            "parity_ok": parity_ok,
+            "wave_seconds": round(wave_s, 2),
+            "cycles_per_sec": round(PODS / wave_s, 1),
+            "node_table_builds": delta(c3, c0, "node_table_builds_total"),
+            "node_table_reuses": delta(c3, c1, "node_table_reuse_total"),
+            "delta_patches": delta(c3, c2, "node_table_delta_patches_total"),
+            "delta_rows": delta(c3, c2, "node_table_delta_rows_total"),
+            "never_rebuilt_on_unchanged_nodes":
+                delta(c2, c1, "node_table_builds_total") == 0
+                and delta(c3, c2, "node_table_builds_total") == 0
+                and delta(c2, c1, "node_table_reuse_total") >= 1,
+            "delta_patched_not_rebuilt":
+                delta(c3, c2, "node_table_delta_patches_total") >= 1
+                and delta(c3, c2, "node_table_delta_rows_total") == touched,
+            "wave_d2h_bytes": delta(c1, c0, "wave_d2h_bytes_total"),
+            "host_rss_mb": round(_rss_mb(), 1),
+            "hbm_bytes_in_use": hbm.get("bytes_in_use"),
+            "hbm_stats_available": bool(hbm.get("available")),
+        }
+
+        if n == POINTS[0]:
+            # end-to-end bind parity at the smallest point: a dict-plane
+            # store (KSS_TPU_COLUMNAR=0) scheduling the same population
+            # must place every pod on the same node
+            os.environ["KSS_TPU_COLUMNAR"] = "0"
+            try:
+                dstore = ObjectStore()
+            finally:
+                os.environ.pop("KSS_TPU_COLUMNAR", None)
+            for nd in dict_nodes:
+                dstore.create("nodes", copy.deepcopy(nd))
+            for p in dict_pods:
+                dstore.create("pods", copy.deepcopy(p))
+            SchedulerEngine(dstore, plugin_config=cfg,
+                            chunk=128).schedule_pending()
+
+            def binds(s):
+                pods_all, _ = s.list("pods")
+                return {p["metadata"]["name"]:
+                        (p.get("spec") or {}).get("nodeName")
+                        for p in pods_all
+                        if p["metadata"]["name"].startswith("pod-")}
+
+            point["binds_parity_ok"] = binds(store) == binds(dstore)
+
+        points.append(point)
+        print(f"scale {n}: build dict {build_dict:.2f}s vs columnar "
+              f"{build_col:.2f}s ({point['build_speedup_vs_dict']}x), "
+              f"wave {wave_s:.1f}s ({point['cycles_per_sec']} c/s), "
+              f"parity={parity_ok} "
+              f"reuse={point['never_rebuilt_on_unchanged_nodes']} "
+              f"delta={point['delta_patched_not_rebuilt']} "
+              f"rss={point['host_rss_mb']}MB", flush=True)
+
+    p100k = points[-1]
+    artifact = {
+        "mode": "scale",
+        "platform": jax.devices()[0].platform,
+        "plugins": cfg.enabled,
+        "points": points,
+        "all_parity_ok": all(
+            p["parity_ok"] and p.get("binds_parity_ok", True)
+            for p in points),
+        "never_rebuilt_on_unchanged_nodes": all(
+            p["never_rebuilt_on_unchanged_nodes"] for p in points),
+        "all_delta_patched": all(
+            p["delta_patched_not_rebuilt"] for p in points),
+        "scale_100k_cycles_per_sec": p100k["cycles_per_sec"],
+        "scale_100k_build_seconds": p100k["build_columnar_seconds"],
+        "scale_100k_build_speedup_vs_dict": p100k["build_speedup_vs_dict"],
+        "scale_100k_host_rss_mb": p100k["host_rss_mb"],
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"wrote {out_path}; all_parity_ok={artifact['all_parity_ok']} "
+          f"100k: {p100k['build_speedup_vs_dict']}x build, "
+          f"{p100k['cycles_per_sec']} c/s, {p100k['host_rss_mb']}MB RSS",
+          flush=True)
+    return artifact
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--scale":
+        scale_curve(sys.argv[2] if len(sys.argv) > 2
+                    else "docs/bench/r06-columnar-scale.json")
+        return
     out_path = (sys.argv[1] if len(sys.argv) > 1
                 else "docs/bench/r04-multichip-scaling.json")
     from kube_scheduler_simulator_tpu.framework.replay import replay

@@ -1151,6 +1151,9 @@ class SchedulerEngine:
                 nodes, pending, self.plugin_config, bound_pods=bound,
                 volumes=volumes, reuse=getattr(self, "_last_cw", None),
                 namespaces=self._list_shared("namespaces"),
+                # columnar pod view (when the store lists columnar):
+                # request rows gather from pre-parsed bank columns
+                pod_columns=getattr(pods_all, "columns", None),
             )
             self._last_cw = NodeTableReuse(cw)
         if self._needs_host_path():

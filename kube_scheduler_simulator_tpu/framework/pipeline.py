@@ -110,7 +110,7 @@ def _filter_one(name: str, cw: CompiledWorkload, carry, sl) -> jnp.ndarray:
     if name == "NodeResourcesFit":
         return noderesources.fit_filter(cw.statics["core"], sl["core"], carry["core"])
     if name == "NodeAffinity":
-        return affinity.filter_kernel(sl["NodeAffinity"])
+        return affinity.filter_kernel(cw.statics["NodeAffinity"], sl["NodeAffinity"])
     if name == "TaintToleration":
         return taints.taint_filter(sl["TaintToleration"])
     if name == "NodeUnschedulable":
@@ -177,7 +177,7 @@ def _score_one(name: str, cw: CompiledWorkload, carry, sl, feasible):
         raw = volumebinding.score_kernel(cw.n_nodes)
         return raw, raw  # scorer nil with VolumeCapacityPriority off
     if name == "NodeAffinity":
-        raw = affinity.score_kernel(sl["NodeAffinity"])
+        raw = affinity.score_kernel(cw.statics["NodeAffinity"], sl["NodeAffinity"])
         return raw, affinity.normalize(raw, feasible)
     if name == "TaintToleration":
         raw = taints.taint_score(sl["TaintToleration"])

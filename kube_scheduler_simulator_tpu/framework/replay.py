@@ -1529,6 +1529,13 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
 
     def ingest(c: dict, lo: int, dev_out) -> bool:
         if check_overflow and c["raw_overflow"].any():
+            # pre-overflow chunks already ingested this tier DELIVER
+            # before the wider rerun re-delivers them: the deferred-
+            # delivery path (single-effective-core hosts) must observe
+            # the same redelivery contract as the immediate path, where
+            # on_chunk fired the moment each chunk landed — consumers
+            # rely on idempotent per-pod writes either way
+            flush_deferred()
             return False  # caller reruns at the next width tier
         hi = min(lo + chunk, p)
         m = hi - lo
@@ -1573,6 +1580,12 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
             defer_chunks.append((lo, hi))
         else:
             on_chunk(rr, lo, hi)
+
+    def flush_deferred() -> None:
+        if defer_chunks:
+            for lo, hi in defer_chunks:
+                on_chunk(rr, lo, hi)
+            defer_chunks.clear()
 
     futures: list = []
     heavy: list = []   # device-resident: the chunk's CompactOut (device refs)
@@ -1624,7 +1637,5 @@ def _replay_run(cw: CompiledWorkload, chunk: int, collect: bool, unroll: int,
                 return None
             heavy[drained] = None
             drained += 1
-    if defer_chunks:
-        for lo, hi in defer_chunks:
-            on_chunk(rr, lo, hi)
+    flush_deferred()
     return rr

@@ -431,14 +431,14 @@ def _sparse_round_fn(cw: CompiledWorkload, base_key, batch: int,
             cand = jnp.minimum(cand, n - 1).astype(jnp.int32)
             valid = jnp.arange(kcand, dtype=jnp.int32) < count
             g_sl = jax.tree.map(lambda x: _take_nodes(x, cand, n), sl)
-            g_statics = dict(slim.statics)
-            if "core" in g_statics:
-                g_statics["core"] = jax.tree.map(
-                    lambda x: _take_nodes(x, cand, n), g_statics["core"])
-            g_carry = dict(carry)
-            if "core" in g_carry:
-                g_carry["core"] = jax.tree.map(
-                    lambda x: _take_nodes(x, cand, n), g_carry["core"])
+            # every sparse-eligible plugin (SAFE_SPECULATIVE) reads its
+            # node-axis statics/carry rows positionally, so gather ALL
+            # entries — NodeAffinity keeps its match rows in statics
+            # ([U, N] pools the xs index into), not in per-pod xs
+            g_statics = {k: jax.tree.map(lambda x: _take_nodes(x, cand, n), v)
+                         for k, v in slim.statics.items()}
+            g_carry = {k: jax.tree.map(lambda x: _take_nodes(x, cand, n), v)
+                       for k, v in carry.items()}
             view = SimpleNamespace(config=slim.config, statics=g_statics,
                                    n_nodes=kcand, schema=slim.schema)
             raws, _finals, total = _score_phase(

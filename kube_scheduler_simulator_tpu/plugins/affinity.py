@@ -38,19 +38,28 @@ NAME = "NodeAffinity"
 ERR_REASON = "node(s) didn't match Pod's node affinity/selector"
 
 
+class NodeAffinityStatic(NamedTuple):
+    """Unique match rows, shared across pods.  Pods stamped from one
+    template dedup to the same row, so device residency is [U, N] +
+    [V, N] (U/V = unique specs) instead of two dense [P, N] tensors —
+    the per-pod xs are just row indices the kernels gather."""
+
+    req_rows: jnp.ndarray       # [U, N] bool  (row 0 = all-True)
+    pref_rows: jnp.ndarray      # [V, N] int32 (row 0 = zeros)
+
+
 class NodeAffinityXS(NamedTuple):
-    required_ok: jnp.ndarray    # [P, N] bool
-    pref_raw: jnp.ndarray       # [P, N] int32
+    req_idx: jnp.ndarray        # [P] int32 into static.req_rows
+    pref_idx: jnp.ndarray       # [P] int32 into static.pref_rows
     filter_skip: jnp.ndarray    # [P] bool (PreFilter returned Skip)
     score_skip: jnp.ndarray     # [P] bool (PreScore returned Skip)
 
 
 def build(table: NodeTable, pods: list[dict],
           args: dict | None = None,
-          host_out: dict | None = None) -> NodeAffinityXS:
+          host_out: dict | None = None
+          ) -> tuple[NodeAffinityStatic, NodeAffinityXS]:
     n, p = table.n, len(pods)
-    required_ok = np.ones((p, n), dtype=bool)
-    pref_raw = np.zeros((p, n), dtype=np.int32)
     filter_skip = np.zeros(p, dtype=bool)
     score_skip = np.zeros(p, dtype=bool)
 
@@ -70,8 +79,14 @@ def build(table: NodeTable, pods: list[dict],
             added_pref_row += int(t.get("weight", 0)) * node_selector_term_rows(
                 t.get("preference") or {}, idx)
 
-    req_rows: dict[str, np.ndarray] = {}   # unique spec -> [N] row
-    pref_rows: dict[str, np.ndarray] = {}
+    # row 0 of each pool is the identity row — what skipped pods gather
+    # (their kernel output is masked by the skip flag downstream)
+    req_pool: list[np.ndarray] = [np.ones(n, dtype=bool)]
+    pref_pool: list[np.ndarray] = [np.zeros(n, dtype=np.int32)]
+    req_by_key: dict[str, int] = {}
+    pref_by_key: dict[str, int] = {}
+    req_idx = np.zeros(p, dtype=np.int32)
+    pref_idx = np.zeros(p, dtype=np.int32)
     for i, pod in enumerate(pods):
         spec = pod.get("spec") or {}
         node_sel = spec.get("nodeSelector") or {}
@@ -83,49 +98,67 @@ def build(table: NodeTable, pods: list[dict],
             filter_skip[i] = True
         else:
             key = spec_key(node_sel, required)
-            row = req_rows.get(key)
-            if row is None:
+            j = req_by_key.get(key)
+            if j is None:
                 row = np.ones(n, dtype=bool)
                 if node_sel:
                     row &= match_labels_rows(node_sel, idx)
                 if required:
                     row &= node_selector_rows(required, idx)
-                req_rows[key] = row
-            required_ok[i] = row if added_req_row is None else (row & added_req_row)
+                if added_req_row is not None:
+                    row &= added_req_row
+                j = len(req_pool)
+                req_pool.append(row)
+                req_by_key[key] = j
+            req_idx[i] = j
 
         if not preferred and added_pref_row is None:
             score_skip[i] = True
         else:
             key = spec_key(preferred)
-            row = pref_rows.get(key)
-            if row is None:
+            j = pref_by_key.get(key)
+            if j is None:
                 row = np.zeros(n, dtype=np.int32)
                 for term in preferred:
                     row += int(term.get("weight", 0)) * node_selector_term_rows(
                         term.get("preference") or {}, idx)
-                pref_rows[key] = row
-            pref_raw[i] = row if added_pref_row is None else (row + added_pref_row)
+                if added_pref_row is not None:
+                    row += added_pref_row
+                j = len(pref_pool)
+                pref_pool.append(row)
+                pref_by_key[key] = j
+            pref_idx[i] = j
 
-    if host_out is not None:
-        # the raw score IS this precompiled row (score_kernel is a pure
-        # pass-through), so the compact replay never transfers it back
-        # from the device — the decoder reads this host copy directly
-        # (framework/replay.py "host" score group)
-        host_out.setdefault("static_score_rows", {})[NAME] = pref_raw
-    return NodeAffinityXS(
-        required_ok=jnp.asarray(required_ok),
-        pref_raw=jnp.asarray(pref_raw),
+    pref_mat = np.stack(pref_pool)
+    if host_out is not None and not score_skip.all():
+        # the raw score IS the precompiled row (score_kernel is a pure
+        # gather), so the compact replay never transfers it back from the
+        # device — the decoder reads this host copy directly
+        # (framework/replay.py "host" score group).  Materialized [P, N]
+        # int32, C-contiguous: the native decoder indexes it by raw
+        # pointer.  Skipped-for-every-pod scoring stashes nothing (the
+        # decoder emits no annotations for skipped scorers).
+        host_out.setdefault("static_score_rows", {})[NAME] = (
+            np.ascontiguousarray(np.take(pref_mat, pref_idx, axis=0)))
+    static = NodeAffinityStatic(
+        req_rows=jnp.asarray(np.stack(req_pool)),
+        pref_rows=jnp.asarray(pref_mat),
+    )
+    return static, NodeAffinityXS(
+        req_idx=jnp.asarray(req_idx),
+        pref_idx=jnp.asarray(pref_idx),
         filter_skip=jnp.asarray(filter_skip),
         score_skip=jnp.asarray(score_skip),
     )
 
 
-def filter_kernel(pod_xs) -> jnp.ndarray:
-    return jnp.where(pod_xs.required_ok, 0, 1).astype(jnp.int32)
+def filter_kernel(static: NodeAffinityStatic, pod_xs) -> jnp.ndarray:
+    row = static.req_rows[pod_xs.req_idx]
+    return jnp.where(row, 0, 1).astype(jnp.int32)
 
 
-def score_kernel(pod_xs) -> jnp.ndarray:
-    return pod_xs.pref_raw.astype(jnp.int64)
+def score_kernel(static: NodeAffinityStatic, pod_xs) -> jnp.ndarray:
+    return static.pref_rows[pod_xs.pref_idx].astype(jnp.int64)
 
 
 def normalize(raw, feasible):

@@ -68,6 +68,12 @@ class RecorderService:
             self._record(event_type, obj)
 
     def _record(self, event_type: str, obj: dict) -> None:
+        # watch events may carry lazy columnar rows; json's C encoder
+        # bypasses dict-subclass overrides, so materialize before the
+        # object is queued for serialization
+        fill = getattr(obj, "fill", None)
+        if fill is not None:
+            fill()
         if event_type == DELETED:
             # keep only identity fields (reference: recorder.go:121-133)
             obj = {

@@ -41,6 +41,12 @@ class StreamWriter:
         self._lock = threading.Lock()
 
     def send(self, kind: str, event_type: str, obj: dict) -> bool:
+        # lazy columnar rows (cluster/columnar.LazyManifest) must be
+        # materialized explicitly: json's C encoder walks dict storage
+        # directly, bypassing the subclass's lazy-read overrides
+        fill = getattr(obj, "fill", None)
+        if fill is not None:
+            fill()
         data = json.dumps({"kind": kind, "eventType": event_type, "obj": obj})
         with self._lock:
             try:

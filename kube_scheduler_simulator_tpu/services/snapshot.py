@@ -68,10 +68,11 @@ class SnapshotService:
 
         opts = options or SnapshotOptions()
         # the export must carry deferred lazy annotations (store/lazy.py)
-        # even though the shared-manifest listing below skips read hooks
+        # and full bytes for lazy columnar rows, even though the
+        # shared-manifest listing below skips read hooks
         flush = getattr(self.store, "materialize_reads", None)
         if flush is not None:
-            flush("pods")
+            flush()
         out: dict = {}
         for field, resource in _FIELDS + self._extra_fields():
             try:

@@ -25,8 +25,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import resources as res
-from .nodes import NodeTable, build_node_table
+from .nodes import (
+    NodeTable,
+    build_node_table,
+    build_node_table_columnar,
+    patch_node_table,
+    patch_node_table_columnar,
+)
 from .resources import ResourceSchema, pod_resource_request
+from ..utils.env import env_int
+from ..utils.tracing import TRACER
 from .volumes import build_volume_table
 from ..plugins import registry as reg
 from ..plugins import (
@@ -84,6 +92,7 @@ def compile_workload(
     volumes: dict | None = None,
     reuse: "CompiledWorkload | NodeTableReuse | None" = None,
     namespaces: list[dict] | None = None,
+    pod_columns=None,
 ) -> CompiledWorkload:
     """Compile (nodes, queue pods, already-bound pods) into device tensors.
 
@@ -95,31 +104,61 @@ def compile_workload(
     reuse: a prior wave's workload — its NodeTable (the expensive per-node
     manifest parse) is reused when the node set, resourceVersions, and the
     discovered resource schema are unchanged (the common case between
-    scheduler waves; the engine passes its previous workload).
+    scheduler waves; the engine passes its previous workload).  When only
+    a bounded subset of nodes changed (<= KSS_TPU_COLUMNAR_DELTA_MAX
+    rows), the table is PATCHED row-wise instead of rebuilt.
+    pod_columns: the pod listing's columnar view (ColumnarManifestList
+    .columns) — per-pod request rows are gathered from the bank's
+    pre-parsed columns by uid instead of re-parsed per wave.
     """
     config = config or reg.PluginSetConfig()
     bound_pods = bound_pods or []
     volumes = volumes or {}
-    schema = ResourceSchema.discover(pods + [bp for bp, _ in bound_pods], nodes)
-    node_key = tuple(
-        ((n.get("metadata") or {}).get("name", ""),
-         (n.get("metadata") or {}).get("resourceVersion", ""))
-        for n in nodes
-    )
+    # columnar fast path: listings from the columnar store carry their
+    # bank view (cluster/columnar.ColumnarManifestList) — schema
+    # discovery, the node-table identity, and the table build all read
+    # columns instead of walking N manifests
+    cols = getattr(nodes, "columns", None)
+    if cols is not None:
+        schema = ResourceSchema.discover_columnar(
+            pods + [bp for bp, _ in bound_pods], cols)
+        node_key = cols.identity()
+    else:
+        schema = ResourceSchema.discover(
+            pods + [bp for bp, _ in bound_pods], nodes)
+        node_key = tuple(
+            ((n.get("metadata") or {}).get("name", ""),
+             (n.get("metadata") or {}).get("resourceVersion", ""))
+            for n in nodes
+        )
+    table = None
     if (reuse is not None
-            and reuse.host.get("node_key") == node_key
             and tuple(reuse.schema.columns) == tuple(schema.columns)
             and reuse.schema.n == schema.n):
-        schema = reuse.schema
-        table = reuse.node_table
-    else:
-        table = build_node_table(nodes, schema)
+        old_key = reuse.host.get("node_key")
+        if old_key == node_key:
+            schema = reuse.schema
+            table = reuse.node_table
+            TRACER.count("node_table_reuse_total")
+        else:
+            delta = _node_delta(old_key, node_key, cols)
+            if delta is not None:
+                schema = reuse.schema
+                if cols is not None:
+                    table = patch_node_table_columnar(
+                        reuse.node_table, cols, delta, schema)
+                else:
+                    table = patch_node_table(
+                        reuse.node_table, nodes, delta, schema)
+                TRACER.count("node_table_delta_patches_total")
+                TRACER.count("node_table_delta_rows_total", len(delta))
+    if table is None:
+        table = (build_node_table_columnar(cols, schema) if cols is not None
+                 else build_node_table(nodes, schema))
+        TRACER.count("node_table_builds_total")
 
     p = len(pods)
-    requests = np.zeros((p, schema.n), dtype=np.int64)
-    nonzero = np.zeros((p, 2), dtype=np.int64)
-    for i, pod in enumerate(pods):
-        requests[i], nonzero[i] = pod_resource_request(pod, schema)
+    requests, nonzero = _pod_requests(pods, schema, pod_columns)
 
     statics: dict[str, Any] = {}
     xs: dict[str, Any] = {}
@@ -132,14 +171,16 @@ def compile_workload(
     req0 = table.initial_requested.copy()
     nz0 = table.initial_nonzero.copy()
     np0 = table.initial_num_pods.copy()
-    for bp, node_name in bound_pods:
-        j = name_idx.get(node_name)
-        if j is None:
-            continue
-        r, nz = pod_resource_request(bp, schema)
-        req0[j] += r
-        nz0[j] += nz
-        np0[j] += 1
+    if bound_pods:
+        b_req, b_nz = _pod_requests(
+            [bp for bp, _ in bound_pods], schema, pod_columns)
+        for bi, (_, node_name) in enumerate(bound_pods):
+            j = name_idx.get(node_name)
+            if j is None:
+                continue
+            req0[j] += b_req[bi]
+            nz0[j] += b_nz[bi]
+            np0[j] += 1
 
     enabled = set(config.active_plugins())
     # Fit static/xs double as the core resource tensors even when the Fit
@@ -158,8 +199,10 @@ def compile_workload(
     )
 
     if "NodeAffinity" in enabled:
-        xs["NodeAffinity"] = affinity.build(
+        st, x = affinity.build(
             table, pods, args=config.args.get("NodeAffinity"), host_out=host)
+        statics["NodeAffinity"] = st
+        xs["NodeAffinity"] = x
     if "NodePorts" in enabled:
         st, x, carry = ports.build(table, pods, bound_pods)
         statics["NodePorts"] = st
@@ -264,6 +307,78 @@ def compile_workload(
     )
     _collect_host_flags(cw)
     return cw
+
+
+def _node_delta(old_key, node_key, cols):
+    """Positions whose node rows changed between waves, or None when the
+    delta path doesn't apply (different membership/order, too many
+    changes, incomparable keys).  Bounded by KSS_TPU_COLUMNAR_DELTA_MAX
+    rows — past that a full rebuild is cheaper than the patch walk."""
+    delta_max = env_int("KSS_TPU_COLUMNAR_DELTA_MAX", 256)
+    if delta_max <= 0 or not isinstance(old_key, tuple):
+        return None
+    if cols is not None:
+        # columnar identity: ("columnar", bank_id, names_version, rv bytes)
+        if (len(old_key) != 4 or len(node_key) != 4
+                or old_key[:3] != node_key[:3]):
+            return None
+        old_rv = np.frombuffer(old_key[3], dtype=np.int64)
+        if len(old_rv) != cols.n:
+            return None
+        changed = np.flatnonzero(old_rv != cols.rv)
+        return changed if 0 < len(changed) <= delta_max else None
+    # dict identity: ((name, rv), ...)
+    if len(old_key) != len(node_key):
+        return None
+    changed = []
+    for i, (a, b) in enumerate(zip(old_key, node_key)):
+        if a == b:
+            continue
+        if a[0] != b[0]:
+            return None  # membership/order changed: rebuild
+        changed.append(i)
+        if len(changed) > delta_max:
+            return None
+    return np.asarray(changed, dtype=np.int64) if changed else None
+
+
+def _pod_requests(pods: list[dict], schema: ResourceSchema, pod_columns):
+    """[P, R] requests + [P, 2] nonzero rows.  With a columnar pod view,
+    rows are GATHERED from the bank's pre-parsed request columns by uid
+    (one vectorized fancy-index per schema column); pods the bank can't
+    answer (no uid match, opaque rows) fall back to the per-pod parse."""
+    p = len(pods)
+    requests = np.zeros((p, schema.n), dtype=np.int64)
+    nonzero = np.zeros((p, 2), dtype=np.int64)
+    misses = range(p)
+    if pod_columns is not None and p:
+        bank = pod_columns.bank
+        by_uid = bank.row_by_uid
+        rows = np.full(p, -1, dtype=np.int64)
+        miss = []
+        # wave-SETUP uid->row mapping: dict lookups can't vectorize; the
+        # per-schema-column request gather below is the vectorized part
+        # kss-analyze: allow(pod-loop)
+        for i, pod in enumerate(pods):
+            uid = (pod.get("metadata") or {}).get("uid")
+            row = by_uid.get(uid) if uid else None
+            if row is None or bank.opaque[row] or bank.deleted[row]:
+                miss.append(i)
+            else:
+                rows[i] = row
+        ok = rows >= 0
+        if ok.any():
+            okr = rows[ok]
+            for j, rname in enumerate(schema.columns):
+                col = bank.req.get(rname)
+                if col is not None:
+                    requests[ok, j] = col[okr]
+            nonzero[ok] = bank.nonzero[okr]
+            TRACER.count("compile_requests_gathered_total", int(ok.sum()))
+        misses = miss
+    for i in misses:
+        requests[i], nonzero[i] = pod_resource_request(pods[i], schema)
+    return requests, nonzero
 
 
 def _missing_pvc_message(vt, pod: dict) -> str | None:
@@ -407,8 +522,11 @@ def _score_dtype(cw: CompiledWorkload, name: str) -> str:
     # carries a scores field but has_score is False -> bound 0)
     x = cw.xs.get(name)
     rows = None
-    if name == "NodeAffinity" and x is not None:
-        rows = x.pref_raw
+    if name == "NodeAffinity":
+        st = cw.statics.get(name)
+        # unique pref rows bound == per-pod rows bound (xs just index
+        # into them)
+        rows = st.pref_rows if st is not None else None
     elif cw.config.is_custom(name) and x is not None and hasattr(x, "scores"):
         rows = x.scores
     if rows is not None:

@@ -72,6 +72,14 @@ class ResourceSchema:
             scan_res(spec.get("overhead") or {})
         return ResourceSchema(tuple(sorted(ext)))
 
+    @staticmethod
+    def discover_columnar(pods: list[dict], node_columns) -> "ResourceSchema":
+        """discover() with the node half answered by the columnar view's
+        presence columns (exact per live row) instead of a manifest scan."""
+        pod_side = ResourceSchema.discover(pods, ())
+        ext = set(pod_side.extended) | node_columns.extended_names()
+        return ResourceSchema(tuple(sorted(ext)))
+
     def parse_map(self, res: dict) -> np.ndarray:
         """Parse a k8s resource map into a dense int64 row (base units)."""
         row = np.zeros(self.n, dtype=np.int64)

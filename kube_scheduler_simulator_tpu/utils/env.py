@@ -30,3 +30,17 @@ def env_float(name: str, default: float) -> float:
         return float(raw)
     except ValueError:
         return default
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Boolean knob: "0"/"false"/"no"/"off" (any case) is False,
+    "1"/"true"/"yes"/"on" is True; unset/empty/unparsable falls back."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    v = raw.strip().lower()
+    if v in ("0", "false", "no", "off"):
+        return False
+    if v in ("1", "true", "yes", "on"):
+        return True
+    return default

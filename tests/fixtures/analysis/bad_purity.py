@@ -53,3 +53,25 @@ def eager_compact_fetch(cc, ci):
 
 def contiguous_compact_fetch(cc, ci):
     return np.ascontiguousarray(cc.raw16[ci][:8])
+
+
+def row_loop_over_columns(cols):
+    # columnar-row-loop: per-row Python iteration over a bank's row
+    # arrays undoes the vectorization the columns exist for
+    out = []
+    for name in cols.names:
+        out.append(name)
+    for i in range(len(cols.rv)):
+        out.append(i)
+    return out
+
+
+def column_dict_loop_ok(bank, row):
+    # NOT flagged: per-COLUMN dict iteration and single-row subscripts
+    # are the sanctioned forms
+    total = 0
+    for key, col in bank.label_cols.items():
+        total += col[row] is not None
+    for t in bank.taints[row]:
+        total += 1
+    return total

@@ -122,6 +122,22 @@ def test_compact_host_sync_detected():
                and "raw16" in f.detail for f in hits), hits
 
 
+def test_columnar_row_loop_detected():
+    """A per-row Python loop over a columnar bank's row arrays
+    (cluster/columnar.py) is flagged; per-column dict iteration and
+    single-row subscripts are the sanctioned forms and stay clean
+    (docs/data-plane.md)."""
+    roots = _PURITY_ROOTS + [("bad_purity", "row_loop_over_columns"),
+                             ("bad_purity", "column_dict_loop_ok")]
+    res = _fixture_result("bad_purity.py", purity_roots=roots)
+    hits = [f for f in res["findings"] if f.rule == "columnar-row-loop"]
+    assert any(f.qualname == "row_loop_over_columns"
+               and "names" in f.detail for f in hits), hits
+    assert any(f.qualname == "row_loop_over_columns"
+               and "range(len(cols.rv))" in f.detail for f in hits), hits
+    assert not any(f.qualname == "column_dict_loop_ok" for f in hits), hits
+
+
 # ------------------------------------------------------------ span rules
 
 

@@ -279,6 +279,43 @@ def test_bench_check_multichip_sanity():
         assert err is not None and "skipped" in err
 
 
+def test_bench_check_scale_sanity_and_trajectory(tmp_path):
+    """check_scale: the newest SCALE round must be parity-pinned and
+    reuse-clean (sanity), and the 100k keys compare newest-vs-previous
+    with union/skip semantics — a missing key SKIPs, a present-on-both
+    regression fails."""
+    import json
+
+    bc = _bench_check()
+    assert bc.check_scale(tmp_path) == (None, [])  # no rounds
+
+    good = {"n": 1, "all_parity_ok": True,
+            "never_rebuilt_on_unchanged_nodes": True,
+            "scale_100k_cycles_per_sec": 12.0,
+            "scale_100k_build_seconds": 0.25,
+            "scale_100k_host_rss_mb": 9000.0}
+    (tmp_path / "SCALE_r01.json").write_text(json.dumps(good))
+    err, rows = bc.check_scale(tmp_path)
+    assert err is None and rows == []  # one round: sanity only
+
+    # second round: throughput collapsed, build time fine, RSS key absent
+    bad = dict(good, n=2, scale_100k_cycles_per_sec=4.0)
+    del bad["scale_100k_host_rss_mb"]
+    (tmp_path / "SCALE_r02.json").write_text(json.dumps(bad))
+    err, rows = bc.check_scale(tmp_path)
+    assert err is None
+    by = {r["metric"]: r["status"] for r in rows}
+    assert by["scale_100k_cycles_per_sec"] == "regression"
+    assert by["scale_100k_build_seconds"] == "ok"
+    assert by["scale_100k_host_rss_mb"] == "skip"
+
+    # a parity-broken newest round fails sanity outright
+    (tmp_path / "SCALE_r03.json").write_text(json.dumps(
+        dict(good, n=3, all_parity_ok=False)))
+    err, rows = bc.check_scale(tmp_path)
+    assert err is not None and "parity" in err and rows == []
+
+
 def test_bench_check_extracts_line_from_round_tail():
     import json
 

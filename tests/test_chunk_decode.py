@@ -157,6 +157,55 @@ def test_chunk_decode_width_tier_redelivery(monkeypatch):
         assert ca == pa, f"pod {i} diverged after width-tier re-delivery"
 
 
+def test_chunk_decode_width_tier_redelivery_deferred_path(monkeypatch):
+    """Regression: on single-effective-core hosts replay buffers on_chunk
+    callbacks until the scan drains (deferred delivery).  When a width
+    tier overflows mid-stream, the buffered pre-overflow chunks must
+    still be delivered BEFORE the wider rerun re-delivers them — the
+    deferred path observes the same redelivery contract as the immediate
+    path, so idempotent consumers see >= 2 deliveries of chunk 0."""
+    import sys
+
+    from kube_scheduler_simulator_tpu.utils import platform as plat_mod
+
+    replay_mod = sys.modules["kube_scheduler_simulator_tpu.framework.replay"]
+    monkeypatch.setattr(plat_mod, "effective_cpu_count", lambda: 1)
+
+    nodes, pods, cfg = baseline_config(4, scale=0.02, seed=11)
+    cw = compile_workload(nodes, pods, cfg)
+    real_fetch = replay_mod._fetch_chunk
+    state = {"fired": False, "count": 0}
+
+    def inject_overflow(out_dev):
+        c = real_fetch(out_dev)
+        state["count"] += 1
+        if not state["fired"] and state["count"] == 3 and "raw_overflow" in c:
+            c["raw_overflow"] = np.asarray(True)
+            state["fired"] = True
+        return c
+
+    monkeypatch.setattr(replay_mod, "_fetch_chunk", inject_overflow)
+
+    out: list = [None] * len(pods)
+    deliveries: list = []
+
+    def on_chunk(rr_, lo, hi):
+        deliveries.append((lo, hi))
+        decode_chunk_into(rr_, lo, hi, out)
+
+    rr = replay(cw, chunk=32, on_chunk=on_chunk)
+    assert deliveries.count(deliveries[0]) >= 2, (
+        f"deferred path suppressed pre-overflow re-delivery: {deliveries}")
+
+    monkeypatch.setenv("KSS_TPU_DISABLE_NATIVE", "1")
+    try:
+        pure = [decode_pod_result(rr, i) for i in range(len(pods))]
+    finally:
+        monkeypatch.delenv("KSS_TPU_DISABLE_NATIVE")
+    for i, (ca, pa) in enumerate(zip(out, pure)):
+        assert ca == pa, f"pod {i} diverged after deferred re-delivery"
+
+
 def _localize_ndarrays(root) -> None:
     """Replace every numpy array reachable from `root` with a
     main-thread-owned copy.  The TSan harness (tests/test_native_tsan.py)

@@ -84,6 +84,19 @@ HOT_PATH_ROOTS: list[tuple[str, str]] = [
     # stacked device pytrees, and (via the lock rules) device calls
     # under the coordinator condition
     ("parallel.fuse", "*"),
+    # columnar data plane (PR 17): the node-table build/patch and the
+    # column read surface run once per wave over up to 100k-node arrays
+    # — a per-ROW Python loop here (columnar-row-loop below) undoes the
+    # vectorization the columns exist for.  Bounded opaque-row fallbacks
+    # iterate opaque_positions(), never the row arrays themselves.
+    ("state.nodes", "build_node_table_columnar"),
+    ("state.nodes", "patch_node_table_columnar"),
+    ("state.compile", "_node_delta"),
+    ("cluster.columnar", "NodeColumns.alloc_matrix"),
+    ("cluster.columnar", "NodeColumns.extended_names"),
+    ("cluster.columnar", "NodeColumns.allowed_pods"),
+    ("cluster.columnar", "NodeColumns.unschedulable"),
+    ("cluster.columnar", "_LabelRows.column"),
 ]
 
 BIG_ITERABLES = {"pending", "pods", "nodes"}
@@ -101,6 +114,16 @@ NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
 COMPACT_FIELDS = {"packed", "raw8", "raw16", "raw32"}
 COMPACT_SYNC_CALLS = HOST_SYNC_CALLS | {
     "np.ascontiguousarray", "numpy.ascontiguousarray", "jax.device_get"}
+
+# columnar-row-loop: per-ROW arrays of the columnar banks
+# (cluster/columnar.py) — one entry per stored object.  A Python `for`
+# directly over one of these (or enumerate/zip/range(len(...)) of one)
+# re-serializes O(rows) work the columns were built to vectorize.  The
+# per-COLUMN dicts (res, label_cols, req) are ~dozens of entries and are
+# deliberately NOT listed; neither are single-row subscripts like
+# `taints[row]`.
+COLUMNAR_ROW_ARRAYS = {"names", "rv", "uid", "created", "manifests",
+                       "opaque", "deleted", "taints", "nonzero"}
 
 
 def resolve_roots(graph: CallGraph,
@@ -144,6 +167,17 @@ class PurityAnalyzer:
                         lineno=node.lineno,
                         message=f"Python for-loop over {big} in the wave "
                                 "hot path (should be a fused tensor op)"))
+                col = self._columnar_row_iterable(node.iter)
+                if col:
+                    out.append(Finding(
+                        rule="columnar-row-loop", path=info.module.path,
+                        qualname=info.qualname, detail=f"for over {col}",
+                        lineno=node.lineno,
+                        message=f"Python for-loop over columnar row array "
+                                f"{col}: per-row work on the data plane "
+                                "must be a vectorized numpy op (bounded "
+                                "opaque-row fallbacks iterate "
+                                "opaque_positions())"))
             elif isinstance(node, ast.Call):
                 name = dotted_name(node.func) or ""
                 last = name.split(".")[-1]
@@ -191,6 +225,29 @@ class PurityAnalyzer:
                 if (isinstance(sub, ast.Attribute)
                         and sub.attr in COMPACT_FIELDS):
                     return sub.attr
+        return None
+
+    def _columnar_row_iterable(self, it: ast.AST) -> str | None:
+        """`x.names` / `enumerate(bank.rv)` / `range(len(cols.uid))` —
+        an iteration over a per-row columnar array (attribute access
+        only: bare names and single-row subscripts don't match)."""
+        if (isinstance(it, ast.Attribute)
+                and it.attr in COLUMNAR_ROW_ARRAYS):
+            return dotted_name(it) or it.attr
+        if isinstance(it, ast.Call):
+            cname = dotted_name(it.func)
+            if cname in ("range", "enumerate", "reversed", "sorted", "zip"):
+                for arg in it.args:
+                    inner = self._columnar_row_iterable(arg)
+                    if inner:
+                        return f"{cname}({inner})"
+                for arg in it.args:
+                    if (isinstance(arg, ast.Call)
+                            and dotted_name(arg.func) == "len"
+                            and arg.args):
+                        inner = self._columnar_row_iterable(arg.args[0])
+                        if inner:
+                            return f"{cname}(len({inner}))"
         return None
 
     def _big_iterable(self, it: ast.AST) -> str | None:
