@@ -9,7 +9,8 @@ PY ?= python
 
 .PHONY: codec native-asan native-tsan test test-asan test-tsan analyze \
         bench bench-check bench-gang bench-serve bench-spec bench-fuse \
-        bench-multichip bench-scale blackbox-smoke smoke chaos clean \
+        bench-multichip bench-scale bench-soak blackbox-smoke smoke chaos \
+        clean \
         parity-fullscale parity-fullscale-device multichip-scaling \
         host-probe tpu-watch
 
@@ -61,6 +62,23 @@ bench-scale:
 	    assert d['scale_100k_build_speedup_vs_dict'] >= 3, 'speedup %.2fx < 3x' % d['scale_100k_build_speedup_vs_dict']; \
 	    print('bench-scale: ok=true all_parity_ok=true (100k: %.1fx build, %.1f cycles/s, %.0fMB RSS)' \
 	        % (d['scale_100k_build_speedup_vs_dict'], d['scale_100k_cycles_per_sec'], d['scale_100k_host_rss_mb']))"
+
+# CI-enforceable autopilot soak gate (docs/autopilot.md): sustained
+# multi-session churn + overload against a live server with the
+# controller ON — the standard tenant's p99 stays inside the SLO
+# target, every shed response carries Retry-After, the shed lifts when
+# the overload stops, and the degradation ladder recovers to rung 0
+bench-soak:
+	JAX_PLATFORMS=cpu $(PY) -m tools.soak /tmp/bench_soak.json
+	$(PY) -c "import json; d = json.load(open('/tmp/bench_soak.json')); \
+	    assert d['ok'], d['failures']; \
+	    assert d['soak_p99_wave_seconds'] <= d['slo_target_p99_s'], \
+	        'std p99 %.3fs over target' % d['soak_p99_wave_seconds']; \
+	    assert d['all_shed_had_retry_after'], 'shed without Retry-After'; \
+	    assert d['soak_recovered_to_rung0'], 'ladder pinned degraded'; \
+	    print('bench-soak: ok=true (p99 %.3fs, shed rate %.2f, %d decisions)' \
+	        % (d['soak_p99_wave_seconds'], d['soak_shed_rate'], \
+	           d['autopilot']['decisions']))"
 
 host-probe:
 	$(PY) docs/bench/host_page_backing.py

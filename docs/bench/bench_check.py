@@ -253,6 +253,64 @@ def check_scale(root: Path,
     return None, rows
 
 
+# autopilot soak keys gated across SOAK_*.json rounds (the bench-soak
+# artifact, docs/autopilot.md): the standard tenant's churn p99 and the
+# fraction of overload submissions shed.  Same union/skip semantics.
+SOAK_KEYS: list[tuple[str, str]] = [
+    ("soak_p99_wave_seconds", "lower"),
+    ("soak_shed_rate", "lower"),
+]
+
+
+def check_soak(root: Path,
+               threshold: float = DEFAULT_THRESHOLD) -> tuple[str | None,
+                                                              list[dict]]:
+    """(sanity error or None, trajectory rows) over SOAK_*.json rounds.
+
+    Sanity: the newest round must be green end to end — ok=true, every
+    shed response carried Retry-After, and the degradation ladder
+    recovered to rung 0.  A soak that lost any of those invalidates the
+    trajectory outright.  Trajectory: SOAK_KEYS newest-vs-previous with
+    union/skip semantics; fewer than two rounds yields no rows."""
+    rounds = _round_files(root, prefix="SOAK")
+    if not rounds:
+        return None, []
+    try:
+        new = json.loads(rounds[-1].read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return f"{rounds[-1].name}: unreadable ({e})", []
+    if not new.get("ok"):
+        return (f"{rounds[-1].name}: ok!=true — "
+                f"{(new.get('failures') or ['unspecified'])[0]}"), []
+    if not new.get("all_shed_had_retry_after"):
+        return (f"{rounds[-1].name}: a shed response was missing the "
+                "Retry-After contract (or nothing was ever shed)"), []
+    if not new.get("soak_recovered_to_rung0"):
+        return (f"{rounds[-1].name}: the degradation ladder ended the "
+                "soak off rung 0 — the autopilot pinned a session "
+                "degraded"), []
+    if len(rounds) < 2:
+        return None, []
+    try:
+        prev = json.loads(rounds[-2].read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return f"{rounds[-2].name}: unreadable ({e})", []
+    rows = []
+    for key, direction in SOAK_KEYS:
+        old_v, new_v = prev.get(key), new.get(key)
+        if not old_v or new_v is None:
+            rows.append({"metric": key, "old": old_v, "new": new_v,
+                         "ratio": None, "status": "skip"})
+            continue
+        ratio = new_v / old_v
+        bad = (ratio < 1 - threshold if direction == "higher"
+               else ratio > 1 + threshold)
+        rows.append({"metric": key, "old": old_v, "new": new_v,
+                     "ratio": round(ratio, 3),
+                     "status": "regression" if bad else "ok"})
+    return None, rows
+
+
 def main(argv: list[str]) -> int:
     import argparse
 
@@ -268,6 +326,10 @@ def main(argv: list[str]) -> int:
     sc_err, scale_rows = check_scale(Path(args.dir), args.threshold)
     if sc_err is not None:
         print(f"bench-check: SCALE sanity failed — {sc_err}")
+        return 2
+    soak_err, soak_rows = check_soak(Path(args.dir), args.threshold)
+    if soak_err is not None:
+        print(f"bench-check: SOAK sanity failed — {soak_err}")
         return 2
     files = _round_files(Path(args.dir))
     if len(files) < 2:
@@ -321,7 +383,7 @@ def main(argv: list[str]) -> int:
     print(f"bench-check: {prev_p.name} -> {new_p.name} "
           f"(threshold {args.threshold:.0%})")
     rc = 0
-    for row in compare(prev, new, args.threshold) + scale_rows:
+    for row in compare(prev, new, args.threshold) + scale_rows + soak_rows:
         mark = {"ok": "OK  ", "skip": "SKIP", "regression": "FAIL"}[row["status"]]
         ratio = f'{row["ratio"]:.3f}' if row["ratio"] is not None else "-"
         print(f"  {mark} {row['metric']}: {row['old']} -> {row['new']} "

@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .pipeline import build_step
+from ..control import CONTROLS
 from ..state.compile import CompiledWorkload
 from ..utils.faults import fault_point
 from ..utils.tracing import TRACER
@@ -330,18 +331,26 @@ class _DeviceResultBudget:
         if limit is None:
             return
         to_spill: list[tuple[_CompactChunks, int, str | None]] = []
+        # autopilot HBM rebalancing (control/autopilot.py): per-session
+        # share weights in integer milli-units.  The registry is empty
+        # (or a session unlisted) at weight 1000, so with no autopilot —
+        # or one that failed safe — every bucket computes EXACTLY
+        # limit // n, the byte-identical equal-split baseline.
+        mweights = CONTROLS.budget_milliweights()
         with self._mu:
             self._prune_locked()
-            # equal split of the global pool across the sessions holding
-            # entries: each bucket is enforced against ITS share, in LRU
-            # order WITHIN the bucket — a fat session spills its own
-            # chunks, never a neighbor's.  One bucket -> share == limit,
-            # the pre-session behavior.
+            # weighted split of the global pool across the sessions
+            # holding entries: each bucket is enforced against ITS
+            # share, in LRU order WITHIN the bucket — a fat session
+            # spills its own chunks, never a neighbor's.  One bucket ->
+            # share == limit, the pre-session behavior.
             totals: dict = {}
             for ent in self._entries.values():
                 totals[ent[5]] = totals.get(ent[5], 0) + ent[2]
-            share = limit // max(1, len(totals))
-            over = {s: t - share for s, t in totals.items()}
+            mw = {s: max(mweights.get(s, 1000), 1) for s in totals}
+            mw_sum = max(sum(mw.values()), 1)
+            over = {s: t - limit * mw[s] // mw_sum
+                    for s, t in totals.items()}
             for ent in self._entries.values():
                 if over.get(ent[5], 0) <= 0:
                     continue

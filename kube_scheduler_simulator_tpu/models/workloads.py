@@ -403,6 +403,80 @@ def make_gang_workload(
     return podgroups, pods
 
 
+def make_churn_workload(
+    n_nodes: int,
+    ticks: int,
+    seed: int = 0,
+    arrival_rate: float = 20.0,
+    departure_rate: float = 10.0,
+    name_prefix: str = "churn",
+    slot_size: int = 2,
+) -> tuple[list[dict], list[dict]]:
+    """Arrival-churn traffic over the reserved-slot fleet shape
+    (Tesserae's placement-under-churn setting — PAPERS.md): a Poisson
+    stream of pod arrivals plus Poisson departures of previously
+    arrived pods, bucketed into `ticks` discrete steps.  The traffic
+    source for `make bench-soak` (tools/soak.py) and the first seed of
+    the generator family ROADMAP item 3 calls for.
+
+    Fully deterministic for a (seed, shape) pair: one
+    ``np.random.default_rng(seed)`` drives arrival counts, departure
+    counts and departure selection, so two runs replay byte-identical
+    schedules.  Departures only ever pick pods that arrived in an
+    EARLIER tick and never pick twice — a driver can create/delete in
+    schedule order without bookkeeping.
+
+    -> (nodes, schedule) where schedule is a list of per-tick dicts
+    {"create": [pod manifests], "delete": [pod names]}.  Nodes carry
+    SLOT_LABEL partitions and pods carry per-slot `app` labels, but the
+    pods are NOT affinity-pinned: required nodeAffinity terms are baked
+    into the compiled scan's statics, so a churn stream of ever-fresh
+    term sets would recompile every wave — sustained-load drivers
+    (tools/soak.py) need steady waves to hit the scan cache."""
+    nodes = make_nodes(n_nodes, seed=seed)
+    n_slots = max(n_nodes // max(slot_size, 1), 1)
+    for i, node in enumerate(nodes):
+        node["metadata"]["labels"][SLOT_LABEL] = f"slot-{i % n_slots}"
+    rng = np.random.default_rng(seed + 1)
+    schedule: list[dict] = []
+    live: list[str] = []   # arrival order; departures sample from here
+    serial = 0
+    for _t in range(max(ticks, 1)):
+        n_arrive = int(rng.poisson(arrival_rate))
+        n_depart = min(int(rng.poisson(departure_rate)), len(live))
+        delete: list[str] = []
+        if n_depart:
+            picks = rng.choice(len(live), size=n_depart, replace=False)
+            # pop from the back first so earlier indices stay valid
+            for idx in sorted((int(p) for p in picks), reverse=True):
+                delete.append(live.pop(idx))
+            delete.reverse()
+        create: list[dict] = []
+        for _ in range(n_arrive):
+            slot = int(rng.integers(n_slots))
+            cpu = int(rng.choice([100, 250, 500]))
+            name = f"{name_prefix}-pod-{serial:06d}"
+            serial += 1
+            create.append({
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": name, "namespace": "default",
+                             "labels": {"app": f"job-{slot}"}},
+                "spec": {
+                    "containers": [{
+                        "name": "main",
+                        "image": "registry.k8s.io/pause:3.9",
+                        "resources": {"requests": {
+                            "cpu": f"{cpu}m",
+                            "memory": str(256 << 20)}},
+                    }],
+                },
+            })
+            live.append(name)
+        schedule.append({"create": create, "delete": delete})
+    return nodes, schedule
+
+
 # BASELINE.md benchmark configs 1-5
 BASELINE_CONFIGS = {
     1: dict(pods=100, nodes=10, plugins=["NodeResourcesFit"]),

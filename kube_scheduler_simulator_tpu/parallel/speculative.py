@@ -113,6 +113,7 @@ from ..framework.replay import (
     ReplayResult, _CompactChunks, _compact_plan, _DeviceAttribution,
     _DEVICE_BUDGET, _resolve_device_resident, _scan_for, _SCAN_CACHE,
     _slice_xs, _SlimWorkload, _workload_scan_key)
+from ..control import CONTROLS
 from ..state.compile import CompiledWorkload
 from ..utils.blackbox import BLACKBOX
 from ..utils.env import env_float, env_int
@@ -733,7 +734,13 @@ def _fuse_family(cw: CompiledWorkload, chunk: int, mesh, wide,
     chunk = min(chunk, max(cw.n_pods, 1))
     base_key = _workload_scan_key(cw, chunk, mesh)
     active_eff = set(cw.config.active_plugins()) - set(ignore)
-    kcand = min(max(env_int("KSS_TPU_SPECULATIVE_CANDIDATES", 128), 1),
+    # the autopilot's per-session candidate cap (control/__init__.py)
+    # must resolve HERE exactly as _spec_run resolves it, or two
+    # streams with equal families would pick different sparse-round
+    # executables and the stacking precondition would silently break
+    _, ov_kcand = CONTROLS.spec_overrides(TRACER.current_session())
+    kcand = min(max(ov_kcand if ov_kcand is not None
+                    else env_int("KSS_TPU_SPECULATIVE_CANDIDATES", 128), 1),
                 cw.n_nodes)
     sparse = _sparse_ok(active_eff) and kcand < cw.n_nodes
     return (base_key, wide, sparse, kcand if sparse else None)
@@ -878,7 +885,16 @@ def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
     # speed win at sparse feasibility; label-coupled sets (value-indexed
     # domain tables) and wide-feasibility rounds run the dense eval
     active_eff = set(cw.config.active_plugins()) - set(ignore)
-    kcand = min(max(env_int("KSS_TPU_SPECULATIVE_CANDIDATES", 128), 1), n)
+    # session control-plane overrides (control/autopilot.py): the
+    # candidate cap replaces the static env default, the start rung
+    # replaces the dense/sparse ramp heuristics below.  Both are
+    # parity-invariant: kcand only moves the sparse/dense round split
+    # (wide-feasibility rounds still fall back dense) and the rung only
+    # partitions the same exact rounds differently.
+    ov_rung, ov_kcand = CONTROLS.spec_overrides(TRACER.current_session())
+    kcand = min(max(ov_kcand if ov_kcand is not None
+                    else env_int("KSS_TPU_SPECULATIVE_CANDIDATES", 128),
+                    1), n)
     sparse = _sparse_ok(active_eff) and kcand < n
     if sparse and adaptive:
         # sparse probes are cheap (dense filters + candidate tail), so
@@ -889,6 +905,12 @@ def _spec_run(cw: CompiledWorkload, mesh, chunk: int, unroll: int,
         # dense eval keeps the climb-from-8 ramp — its probes cost a
         # full [B, N] evaluation
         rung = len(ladder) - 1
+    if adaptive and ov_rung is not None:
+        # autopilot starting rung (hysteresis lives in the controller;
+        # the in-wave climb/drop below still reacts within the wave):
+        # <0 = top rung, else clamped to this stream's ladder
+        rung = (len(ladder) - 1 if ov_rung < 0
+                else min(max(ov_rung, 0), len(ladder) - 1))
 
     # per-rung compiled pieces, resolved from the process cache once per
     # stream instead of per round

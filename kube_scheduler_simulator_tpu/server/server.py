@@ -73,6 +73,7 @@ class SimulatorServer:
             self.manager = SessionManager(default_di=di)
         self.port = port if port is not None else self.manager.cfg.port
         self.httpd: ThreadingHTTPServer | None = None
+        self.autopilot = None
         # live long-poll/SSE responses across ALL sessions; shutdown()
         # fires every event so no handler thread outlives the server
         # sleeping on an interval (each session holds its own registry
@@ -92,6 +93,15 @@ class SimulatorServer:
         from ..utils.blackbox import TELEMETRY
 
         TELEMETRY.start()
+        # closed-loop autopilot (control/autopilot.py, docs/autopilot.md):
+        # always-on controller thread unless KSS_TPU_AUTOPILOT opts out
+        # (off — or unparsable — is the byte-identical static baseline)
+        from ..control.autopilot import Autopilot, autopilot_enabled
+
+        if autopilot_enabled() and self.autopilot is None:
+            self.autopilot = Autopilot(self.manager)
+            self.manager.autopilot = self.autopilot
+            self.autopilot.start()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
         self.port = self.httpd.server_address[1]
@@ -101,7 +111,13 @@ class SimulatorServer:
             threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
 
     def shutdown(self):
-        # streams first: a chunked watch or SSE loop parked on its
+        # the controller first: a tick racing teardown would read dead
+        # sessions (its fail-safe would survive that, but why make it)
+        if self.autopilot is not None:
+            self.autopilot.stop()
+            self.autopilot = None
+            self.manager.autopilot = None
+        # streams next: a chunked watch or SSE loop parked on its
         # interval must wake and finish before the sessions tear down
         self.streams.close_all()
         if self.httpd:
@@ -134,12 +150,14 @@ def _make_handler(server: SimulatorServer):
                                  "GET, POST, PUT, DELETE, OPTIONS")
                 self.send_header("Access-Control-Allow-Headers", "Content-Type")
 
-        def _json(self, code: int, obj=None):
+        def _json(self, code: int, obj=None, headers=None):
             body = b"" if obj is None else json.dumps(obj).encode()
             self.send_response(code)
             self._cors()
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             if body:
                 self.wfile.write(body)
@@ -222,6 +240,29 @@ def _make_handler(server: SimulatorServer):
 
         def _dispatch(self, method: str, path: str, url):
             di = self.di
+            if method == "POST" and self._sheddable(path):
+                # autopilot load shedding (docs/autopilot.md): a session
+                # whose SLO window breached its target answers
+                # workload-submitting requests with 429 + Retry-After
+                # (~2x its p99) until the window recovers.  Reads,
+                # session CRUD and observability stay open — an
+                # operator must be able to SEE a shedding session.
+                from ..control import CONTROLS
+
+                shed, retry = CONTROLS.shed_state(self.sess.id)
+                if shed:
+                    from ..utils.tracing import TRACER
+
+                    TRACER.inc("autopilot_shed_total",
+                               session=self.sess.id)
+                    return self._json(
+                        429, {"reason": "Overloaded",
+                              "message": f"session {self.sess.id!r} is "
+                                         "shedding load (SLO breach); "
+                                         "retry after the indicated "
+                                         "interval",
+                              "retryAfterSeconds": retry},
+                        headers={"Retry-After": retry})
             if path in ("", "/", "/ui") and method == "GET":
                 return self._index()
             if path.startswith("/web/") and method == "GET":
@@ -275,6 +316,17 @@ def _make_handler(server: SimulatorServer):
                     return self._resource_crud(method, m, url)
             self._json(404, {"message": f"route not found: {method} {path}"})
 
+        def _sheddable(self, path: str) -> bool:
+            """Workload-submitting routes the autopilot may shed: the
+            resource-create surface (new pods = new scheduling work)
+            and snapshot import (a whole cluster at once).  Everything
+            else — reads, session CRUD, config, observability — stays
+            open while a session sheds."""
+            if path == "/api/v1/import":
+                return True
+            m = re.fullmatch(r"/api/v1/([a-z0-9-]+)", path)
+            return bool(m) and m.group(1) in self.di.store.resources
+
         # ------------------------------------------------ sessions api
 
         def _sessions_collection(self, method: str):
@@ -286,7 +338,8 @@ def _make_handler(server: SimulatorServer):
                                         **manager.stats()})
             if method == "POST":
                 body = self._body() or {}
-                sess = manager.create(body.get("id") or None)
+                sess = manager.create(body.get("id") or None,
+                                      qos=body.get("qos") or None)
                 return self._json(201, sess.info())
             return self._json(405, {"message": "method not allowed"})
 
@@ -455,6 +508,15 @@ def _make_handler(server: SimulatorServer):
                    for s in sessions if s.get("slo")}
             if slo:
                 body["slo"] = slo
+            # autopilot verdict (docs/autopilot.md): controller health +
+            # which sessions are currently shedding, so a probe sees
+            # overload protection engage without walking the stats
+            ap = manager.autopilot
+            if ap is not None:
+                aps = ap.stats()
+                body["autopilot"] = {k: aps[k] for k in
+                                     ("enabled", "running", "ticks",
+                                      "decisions", "failsafes", "shedding")}
             if loop.last_crash is not None:
                 body["lastCrash"] = {k: loop.last_crash[k]
                                      for k in ("time", "error")}

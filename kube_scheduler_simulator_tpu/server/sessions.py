@@ -46,6 +46,7 @@ import uuid
 
 from ..cluster.store import ApiError, NotFound
 from ..config.config import SimulatorConfiguration
+from ..control import CONTROLS, DEFAULT_QOS, QOS_TIERS
 from ..utils.blackbox import BLACKBOX, SLO
 from ..utils.env import env_int as _env_int
 from ..utils.faults import fault_point
@@ -138,8 +139,12 @@ class SimulationSession:
     def __init__(self, session_id: str,
                  cfg: SimulatorConfiguration | None = None,
                  start_scheduler: bool = True,
-                 di: DIContainer | None = None):
+                 di: DIContainer | None = None,
+                 qos: str = DEFAULT_QOS):
         self.id = session_id
+        # QoS tier (docs/api.md): the autopilot's shed/evict ordering —
+        # best-effort sheds first under global overload, critical never
+        self.qos = qos
         if di is None:
             di = DIContainer(cfg, start_scheduler=start_scheduler,
                              session=session_id)
@@ -193,6 +198,11 @@ class SimulationSession:
             # p50/p99 wave latency + cycles/s over the last
             # KSS_TPU_SLO_WINDOW waves; None before the first wave
             "slo": SLO.stats(self.id),
+            # autopilot overload state (docs/autopilot.md): tier + the
+            # live shed gate — a shedding session answers sheddable
+            # POSTs with 429 + Retry-After until its window recovers
+            "qos": self.qos,
+            "shedding": CONTROLS.shed_state(self.id)[0],
             "lastCrash": (loop.last_crash or None) and {
                 k: loop.last_crash[k] for k in ("time", "error")
             },
@@ -233,6 +243,9 @@ class SessionManager:
         self._sessions: dict[str, SimulationSession] = {}
         self._creating: set[str] = set()
         self._down = False
+        # the server attaches its Autopilot here (server.py start());
+        # stats() surfaces it, teardown never touches it
+        self.autopilot = None
         self._stop = threading.Event()
         self._sweeper: threading.Thread | None = None
         # the default session exists from boot and is never evicted —
@@ -270,8 +283,16 @@ class SessionManager:
             sessions = list(self._sessions.values())
         return [s.info() for s in sorted(sessions, key=lambda s: s.created_at)]
 
+    def sessions_brief(self) -> list[tuple[str, str, float, bool]]:
+        """[(id, qos, last_used, busy)] — the autopilot's cheap per-tick
+        view (control/autopilot.py): no store listing, no info() walk."""
+        with self._mu:
+            sessions = list(self._sessions.values())
+        return [(s.id, s.qos, s.last_used, s.busy()) for s in sessions]
+
     def stats(self) -> dict:
         """Process-shell view: admission knobs + the shared pieces."""
+        from ..control.autopilot import autopilot_enabled
         from ..framework.replay import _DEVICE_BUDGET, scan_cache_stats
         from ..parallel.fuse import FUSE
         from ..utils.tracing import TRACER
@@ -301,20 +322,34 @@ class SessionManager:
             # cross-session fused dispatch (parallel/fuse.py): knob
             # state + lifetime outcome tallies (docs/api.md)
             "fuse": FUSE.stats(),
+            # closed-loop control plane (docs/autopilot.md): controller
+            # tick/decision tallies when the server runs one, else just
+            # the (normally empty) override registry
+            "autopilot": (self.autopilot.stats()
+                          if self.autopilot is not None else {
+                              "enabled": autopilot_enabled(),
+                              "running": False,
+                              "controls": CONTROLS.stats()}),
         }
 
     # ------------------------------------------------------- admission
 
-    def create(self, session_id: str | None = None) -> SimulationSession:
+    def create(self, session_id: str | None = None,
+               qos: str | None = None) -> SimulationSession:
         """Admit a new session.  At capacity, the least-recently-used
         idle session (never the default; sessions with live streams
         only if nothing else is evictable) is evicted through the clean
         teardown path; when every slot is the pinned default or
-        mid-construction, admission fails with 429."""
+        mid-construction, admission fails with 429.  `qos` picks the
+        autopilot's shed/evict tier (docs/api.md; default standard)."""
         sid = session_id or f"s-{uuid.uuid4().hex[:8]}"
         if not _SESSION_ID_RE.match(sid):
             raise SessionError(
                 f"invalid session id {sid!r} (want {_SESSION_ID_RE.pattern})")
+        qos = qos or DEFAULT_QOS
+        if qos not in QOS_TIERS:
+            raise SessionError(
+                f"invalid qos {qos!r} (want one of {', '.join(QOS_TIERS)})")
         victim: SimulationSession | None = None
         with self._mu:
             if self._down:
@@ -346,7 +381,8 @@ class SessionManager:
             # admitting — tests/test_faults.py pins create-after-fault
             fault_point("session.create")
             sess = SimulationSession(sid, self.cfg,
-                                     start_scheduler=self.start_scheduler)
+                                     start_scheduler=self.start_scheduler,
+                                     qos=qos)
         finally:
             with self._mu:
                 self._creating.discard(sid)
@@ -364,7 +400,7 @@ class SessionManager:
             raise SessionError("session manager is shutting down")
         TRACER.count("sessions_created_total")
         TRACER.gauge("sessions_active", n)
-        BLACKBOX.record("session.create", id=sid)
+        BLACKBOX.record("session.create", id=sid, qos=qos)
         return sess
 
     def delete(self, session_id: str) -> None:
@@ -404,6 +440,36 @@ class SessionManager:
             self._teardown(sess, reason="idle")
         return len(victims)
 
+    def evict_idle_under_pressure(self, grace_s: float | None = None,
+                                  max_evict: int = 1) -> int:
+        """Autopilot-driven eviction pressure (docs/autopilot.md):
+        under sustained global HBM/SLO stress, evict up to `max_evict`
+        idle sessions — least-recently-used first, best-effort tier
+        before standard, never critical, never the default, never one
+        with a live stream.  Unlike sweep_idle() this runs without a
+        configured TTL; `grace_s` (default KSS_TPU_AUTOPILOT
+        IDLE_GRACE_S 30) keeps a just-created or briefly-quiet session
+        safe."""
+        if grace_s is None:
+            grace_s = max(_env_int("KSS_TPU_AUTOPILOT_IDLE_GRACE_S", 30), 1)
+        cutoff = time.time() - grace_s
+        order = {"best-effort": 0, "standard": 1}
+        victims: list[SimulationSession] = []
+        with self._mu:
+            idle = sorted(
+                (s for k, s in self._sessions.items()
+                 if (k != DEFAULT_SESSION and s.qos in order
+                     and s.last_used < cutoff and not s.busy())),
+                key=lambda s: (order[s.qos], s.last_used))
+            for s in idle[:max_evict]:
+                victims.append(self._sessions.pop(s.id))
+            n = len(self._sessions)
+        if victims:
+            TRACER.gauge("sessions_active", n)
+        for sess in victims:
+            self._teardown(sess, reason="pressure")
+        return len(victims)
+
     def _sweep_loop(self) -> None:
         interval = min(max(self.idle_ttl / 4.0, 0.05), 30.0)
         while not self._stop.wait(interval):
@@ -441,6 +507,7 @@ class SessionManager:
         # id ever seen
         SLO.drop_session(sess.id)
         BLACKBOX.drop_session(sess.id)
+        CONTROLS.drop(sess.id)
 
     # -------------------------------------------------------- shutdown
 

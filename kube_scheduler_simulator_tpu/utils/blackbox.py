@@ -362,6 +362,14 @@ def validate_dump(doc: dict, require_fault: bool = False,
                 if field not in ev:
                     raise ValueError(
                         f"speculative.round missing {field!r}: {ev!r}")
+        if ev["kind"] == "autopilot.decide":
+            # every autopilot decision is structured evidence
+            # (control/autopilot.py): which effector moved which
+            # session from what to what, and why
+            for field in ("effector", "session", "from", "to", "reason"):
+                if field not in ev:
+                    raise ValueError(
+                        f"autopilot.decide missing {field!r}: {ev!r}")
     if not isinstance(doc["counter_deltas"], dict):
         raise ValueError("counter_deltas is not a dict")
     dev = doc["device"]

@@ -44,3 +44,16 @@ def env_bool(name: str, default: bool) -> bool:
     if v in ("1", "true", "yes", "on"):
         return True
     return default
+
+
+def env_switch(name: str, default: bool) -> bool:
+    """Boolean knob for subsystems that must fail OFF: unset/empty
+    falls back to the default, but an UNRECOGNIZED value disables the
+    feature instead of silently keeping it on.  The autopilot
+    (control/autopilot.py) rides this — a typo'd KSS_TPU_AUTOPILOT
+    yields the static-knob parity baseline, never a half-configured
+    controller thread."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")

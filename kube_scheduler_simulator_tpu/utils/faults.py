@@ -76,6 +76,9 @@ SEAMS = (
     "store.columnar_sync",     # columnar bank write mirror — a trip
                                # marks the row opaque; the manifest
                                # stays authoritative (cluster/store.py)
+    "autopilot.decide",        # autopilot decision application — a trip
+                               # reverts every effector to the static
+                               # defaults (control/autopilot.py fail-safe)
 )
 
 

@@ -50,10 +50,16 @@ const API = {
   // multi-session serving (docs/api.md): CRUD + per-session routing —
   // sessionPath("a", "pods") -> "/api/v1/sessions/a/pods"
   sessions: () => api("GET", "/api/v1/sessions"),
-  createSession: (id) =>
-    api("POST", "/api/v1/sessions", id ? { id } : {}),
+  createSession: (id, qos) =>
+    api("POST", "/api/v1/sessions",
+        Object.assign({}, id ? { id } : {}, qos ? { qos } : {})),
   deleteSession: (id) => api("DELETE", "/api/v1/sessions/" + id),
   sessionPath: (id, sub) => "/api/v1/sessions/" + id + "/" + sub,
+  // SLO-driven autopilot (docs/autopilot.md): the controller block on
+  // /api/v1/sessions — enabled/running, tick/decision/failsafe counts,
+  // sessions currently shedding (429 + Retry-After), and the live
+  // per-session control overrides
+  autopilot: () => api("GET", "/api/v1/sessions").then((s) => s.autopilot),
 };
 
 // ---- watch stream (web/api/v1/watcher.ts analogue: fetch ReadableStream

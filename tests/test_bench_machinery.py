@@ -316,6 +316,46 @@ def test_bench_check_scale_sanity_and_trajectory(tmp_path):
     assert err is not None and "parity" in err and rows == []
 
 
+def test_bench_check_soak_sanity_and_trajectory(tmp_path):
+    """check_soak: the newest SOAK round must be green end to end
+    (ok, Retry-After on every shed, ladder back on rung 0), and the
+    p99/shed-rate keys compare newest-vs-previous with union/skip
+    semantics."""
+    import json
+
+    bc = _bench_check()
+    assert bc.check_soak(tmp_path) == (None, [])  # no rounds
+
+    good = {"n": 1, "ok": True, "all_shed_had_retry_after": True,
+            "soak_recovered_to_rung0": True,
+            "soak_p99_wave_seconds": 0.12, "soak_shed_rate": 0.5}
+    (tmp_path / "SOAK_r01.json").write_text(json.dumps(good))
+    err, rows = bc.check_soak(tmp_path)
+    assert err is None and rows == []  # one round: sanity only
+
+    # second round: p99 doubled, shed-rate key absent
+    bad = dict(good, n=2, soak_p99_wave_seconds=0.24)
+    del bad["soak_shed_rate"]
+    (tmp_path / "SOAK_r02.json").write_text(json.dumps(bad))
+    err, rows = bc.check_soak(tmp_path)
+    assert err is None
+    by = {r["metric"]: r["status"] for r in rows}
+    assert by["soak_p99_wave_seconds"] == "regression"
+    assert by["soak_shed_rate"] == "skip"
+
+    # a round whose ladder ended degraded fails sanity outright
+    (tmp_path / "SOAK_r03.json").write_text(json.dumps(
+        dict(good, n=3, soak_recovered_to_rung0=False)))
+    err, rows = bc.check_soak(tmp_path)
+    assert err is not None and "rung 0" in err and rows == []
+
+    # a shed contract violation is also terminal
+    (tmp_path / "SOAK_r03.json").write_text(json.dumps(
+        dict(good, n=3, all_shed_had_retry_after=False)))
+    err, _rows = bc.check_soak(tmp_path)
+    assert err is not None and "Retry-After" in err
+
+
 def test_bench_check_extracts_line_from_round_tail():
     import json
 

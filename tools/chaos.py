@@ -262,6 +262,68 @@ def _session_lifecycle_check(seed: int) -> list[str]:
     return failures
 
 
+def _autopilot_failsafe_check(seed: int) -> list[str]:
+    """The autopilot.decide seam (control/autopilot.py): a fault while
+    a tick applies its decisions must revert EVERY effector to the
+    static-knob defaults (CONTROLS.reset()), count the failsafe, and
+    leave the controller able to keep ticking — fail-safe, never
+    fail-wedged.  The rule is UNSCOPED because the controller thread
+    runs outside any session tracer scope."""
+    from kube_scheduler_simulator_tpu.control import CONTROLS
+    from kube_scheduler_simulator_tpu.control.autopilot import Autopilot
+    from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+    from kube_scheduler_simulator_tpu.utils import faults
+    from kube_scheduler_simulator_tpu.utils.blackbox import SLO
+
+    failures: list[str] = []
+    mgr = SessionManager(max_sessions=4, idle_ttl=0,
+                         start_scheduler=False)
+    try:
+        mgr.create("ap-a", qos="best-effort")
+        ap = Autopilot(mgr, interval=3600, slo_target=0.05)
+
+        def waves(seconds, n=70):   # fill the whole SLO window
+            for _ in range(n):
+                SLO.observe_wave("ap-a", seconds, pods=10)
+
+        waves(1.0)
+        ap.tick()
+        ap.tick()                   # breach x2 ticks -> shed applied
+        if not CONTROLS.shed_state("ap-a")[0]:
+            failures.append("autopilot never shed under synthetic "
+                            "breach")
+        # a second effector's state must ALSO revert on the trip
+        CONTROLS.set_budget_weight("ap-a", 2.0)
+        waves(0.001)                # recovered: the next ticks plan unshed
+        plan = faults.FaultPlan([
+            faults.FaultRule("autopilot.decide", nth=1, error="runtime")],
+            seed=seed)
+        with faults.armed(plan):
+            ap.tick()
+            ap.tick()               # ok x2 ticks -> decision -> trip
+        if plan.stats()["rules"][0]["trips"] != 1:
+            failures.append("autopilot.decide fault never tripped "
+                            "(vacuous)")
+        if ap.stats()["failsafes"] != 1:
+            failures.append("failsafe counter not bumped after the trip")
+        if CONTROLS.stats() != {}:
+            failures.append("controls not reverted to static defaults "
+                            f"after the trip: {CONTROLS.stats()}")
+        # the controller survives: clean ticks run, and a renewed
+        # breach sheds again from the reset state
+        ap.tick()
+        waves(1.0)
+        ap.tick()
+        ap.tick()
+        if not CONTROLS.shed_state("ap-a")[0]:
+            failures.append("controller wedged after the failsafe: "
+                            "renewed breach no longer sheds")
+    finally:
+        CONTROLS.reset()
+        mgr.shutdown()
+    return failures
+
+
 def run_seed(seed: int, shape: dict, witness=None) -> dict:
     """Run one seed: fault-free reference, chaos run, invariants.
     Returns {ok, seed, failures, injected, modes}."""
@@ -294,6 +356,7 @@ def run_seed(seed: int, shape: dict, witness=None) -> dict:
             f"{sid}: {m}" for m in _gang_atomicity_failures(
                 got, chaos["gangs"][sid]))
     failures.extend(_session_lifecycle_check(seed))
+    failures.extend(_autopilot_failsafe_check(seed))
     if witness is not None:
         try:
             witness.assert_no_cycles()
