@@ -11,11 +11,12 @@ sites consult:
 
   * speculative tuning — a session whose rolling accept fraction stays
     high gets the aggressive profile (start at the TOP ladder rung,
-    double the sparse candidate cap); one that keeps collapsing gets
-    the conservative profile (start at the bottom rung, halve the cap).
-    Hysteresis: a profile changes only after HYSTERESIS_TICKS
-    consecutive ticks beyond the threshold — one bad wave never
-    thrashes the ladder.
+    double the operator's KSS_TPU_SPECULATIVE_CANDIDATES cap); one
+    that keeps collapsing gets the conservative profile (start at the
+    bottom rung, halve the cap); a sustained mid-band fraction decays
+    the profile back to the static default.  Hysteresis: a profile
+    changes only after HYSTERESIS_TICKS consecutive ticks beyond the
+    threshold — one bad wave never thrashes the ladder.
   * HBM rebalancing — sessions observed spilling get a larger share of
     KSS_TPU_DEVICE_RESULT_BUDGET_MB (weight steps up per spilling
     tick); calm sessions decay back toward the equal split, and a
@@ -26,7 +27,11 @@ sites consult:
     shed (HTTP 429 + Retry-After ~ 2x its p99) if its QoS tier allows;
     under global overload every best-effort session sheds first, and
     sustained stress applies idle-eviction pressure through the
-    session manager.
+    session manager.  Recovery: ticks back under 0.8x target count
+    toward lifting the shed — and so do ticks where a SHEDDING session
+    ran no new waves at all (the gate stopped inflow, the count-based
+    window froze, and a quiesced session carries no evidence of
+    ongoing breach; without this the shed would latch forever).
 
 Every decision is a structured black-box event (`autopilot.decide
 {effector, session, from, to, reason}`) and a labeled counter
@@ -51,7 +56,7 @@ import sys
 import threading
 
 from ..utils.blackbox import BLACKBOX, SLO
-from ..utils.env import env_float, env_switch
+from ..utils.env import env_float, env_int, env_switch
 from ..utils.faults import fault_point
 from ..utils.tracing import TRACER
 from . import CONTROLS, QOS_TIERS, WEIGHT_CAP, WEIGHT_FLOOR
@@ -70,7 +75,8 @@ _SPEC_PROFILES = {
 }
 _SPEC_HI = 0.90   # rolling accept fraction at/above: climb
 _SPEC_LO = 0.50   # below: back off
-_SPEC_BASE_CANDIDATES = 128   # the static default the multiplier scales
+_SPEC_BASE_CANDIDATES = 128   # KSS_TPU_SPECULATIVE_CANDIDATES default
+_SPEC_MID_TICKS = 4   # mid-band ticks before a profile decays to default
 
 _WEIGHT_STEP = 0.5
 _DONATE_WEIGHT = 0.5   # a no-demand session's share while neighbors spill
@@ -100,20 +106,22 @@ def shed_qos_tiers() -> tuple[str, ...]:
 class _SessState:
     """Controller-internal per-session memory (streaks, baselines)."""
 
-    __slots__ = ("spec_mode", "hi_streak", "lo_streak", "accepted",
-                 "rolled", "spilled", "calm_ticks", "breach_streak",
-                 "ok_streak")
+    __slots__ = ("spec_mode", "hi_streak", "lo_streak", "mid_streak",
+                 "accepted", "rolled", "spilled", "calm_ticks",
+                 "breach_streak", "ok_streak", "waves_total")
 
     def __init__(self):
         self.spec_mode = "default"
         self.hi_streak = 0
         self.lo_streak = 0
+        self.mid_streak = 0
         self.accepted = 0.0    # counter baselines from the previous tick
         self.rolled = 0.0
         self.spilled = 0.0
         self.calm_ticks = 0
         self.breach_streak = 0
         self.ok_streak = 0
+        self.waves_total = 0   # SLO totalWaves baseline (inflow check)
 
 
 class Autopilot:
@@ -261,32 +269,44 @@ class Autopilot:
         frac = a_d / (a_d + r_d)
         if frac >= _SPEC_HI:
             st.hi_streak += 1
-            st.lo_streak = 0
+            st.lo_streak = st.mid_streak = 0
         elif frac < _SPEC_LO:
             st.lo_streak += 1
-            st.hi_streak = 0
+            st.hi_streak = st.mid_streak = 0
         else:
             st.hi_streak = st.lo_streak = 0
+            st.mid_streak += 1
         want = st.spec_mode
+        reason = (f"accept fraction {frac:.2f} over "
+                  f"{int(a_d + r_d)} round(s)")
         if st.hi_streak >= HYSTERESIS_TICKS:
             want = "aggressive"
         elif st.lo_streak >= HYSTERESIS_TICKS:
             want = "conservative"
+        elif st.mid_streak >= _SPEC_MID_TICKS:
+            # sustained mid-band evidence: the static default fits
+            # again — decay back instead of pinning the last profile
+            # forever (mirrors the budget effector's calm-tick decay)
+            want = "default"
+            reason = (f"accept fraction {frac:.2f} mid-band for "
+                      f"{st.mid_streak} tick(s)")
         if want == st.spec_mode:
             return
         rung, mult = _SPEC_PROFILES[want]
-        cand = (None if mult is None
-                else max(int(_SPEC_BASE_CANDIDATES * mult), 16))
+        # scale the OPERATOR's baseline, not the built-in default —
+        # with KSS_TPU_SPECULATIVE_CANDIDATES=512 aggressive must mean
+        # 1024, not 256
+        base = env_int("KSS_TPU_SPECULATIVE_CANDIDATES",
+                       _SPEC_BASE_CANDIDATES)
+        cand = None if mult is None else max(int(base * mult), 16)
         frm, to = st.spec_mode, want
 
         def apply(sid=sid, st=st, want=want, rung=rung, cand=cand):
             st.spec_mode = want
-            st.hi_streak = st.lo_streak = 0
+            st.hi_streak = st.lo_streak = st.mid_streak = 0
             CONTROLS.set_spec(sid, rung, cand)
 
-        plan.append(("speculative", sid, frm, to,
-                     f"accept fraction {frac:.2f} over "
-                     f"{int(a_d + r_d)} round(s)", apply))
+        plan.append(("speculative", sid, frm, to, reason, apply))
 
     # ----------------------------------------------- effector: budget
 
@@ -326,12 +346,26 @@ class Autopilot:
     # ------------------------------------------------- effector: shed
 
     def _plan_shed(self, plan, sid, st, qos, slo_stats) -> bool:
-        """Returns True when this session's window breaches target."""
+        """Returns True when this session's window shows a live breach."""
         if self.slo_target <= 0:
             return False
-        p99 = (slo_stats or {}).get("p99WaveSeconds")
+        stats = slo_stats or {}
+        p99 = stats.get("p99WaveSeconds")
+        fresh = int(stats.get("totalWaves") or 0) - st.waves_total
+        st.waves_total = int(stats.get("totalWaves") or 0)
+        shedding, _ra = CONTROLS.shed_state(sid)
         breach = p99 is not None and p99 > self.slo_target
-        if breach:
+        if shedding and fresh <= 0:
+            # the shed gate blocks inflow, so the count-based SLO
+            # window is frozen at its breach-era percentiles; a
+            # quiesced session carries NO evidence of ongoing breach —
+            # count the tick toward recovery, or the shed latches
+            # forever (clients 429 away, the window never refills, p99
+            # never drops)
+            st.ok_streak += 1
+            st.breach_streak = 0
+            breach = False
+        elif breach:
             st.breach_streak += 1
             st.ok_streak = 0
         else:
@@ -342,7 +376,6 @@ class Autopilot:
                 st.breach_streak = 0
             else:
                 st.ok_streak = 0
-        shedding, _ra = CONTROLS.shed_state(sid)
         sheddable = qos in self.shed_qos and qos != "critical"
         if (not shedding and sheddable
                 and st.breach_streak >= HYSTERESIS_TICKS):

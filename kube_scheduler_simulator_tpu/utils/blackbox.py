@@ -408,6 +408,11 @@ class SLOTracker:
                         else max(env_int("KSS_TPU_SLO_WINDOW", 64), 4))
         self._mu = threading.Lock()
         self._waves: dict[str | None, deque] = {}
+        # monotonic per-session wave count: the window above freezes
+        # when inflow stops, so consumers judging liveness (the
+        # autopilot's shed recovery) need a counter that only moves
+        # when waves actually run
+        self._totals: dict[str | None, int] = {}
 
     def observe_wave(self, session: str | None, seconds: float,
                      pods: int) -> None:
@@ -418,6 +423,7 @@ class SLOTracker:
             if dq is None:
                 dq = self._waves[session] = deque(maxlen=self._window)
             dq.append((seconds, pods))
+            self._totals[session] = self._totals.get(session, 0) + 1
 
     @staticmethod
     def _pct(sorted_vals: list[float], q: float) -> float:
@@ -425,11 +431,15 @@ class SLOTracker:
         return sorted_vals[i]
 
     def stats(self, session: str | None) -> dict | None:
-        """{waves, p50WaveSeconds, p99WaveSeconds, cyclesPerSec} over
-        the window, or None when the session never ran a wave."""
+        """{waves, totalWaves, p50WaveSeconds, p99WaveSeconds,
+        cyclesPerSec} over the window, or None when the session never
+        ran a wave.  `totalWaves` is the lifetime count — unlike
+        `waves` (window occupancy, saturates at `window`) it keeps
+        moving while traffic flows, so a frozen window is detectable."""
         with self._mu:
             dq = self._waves.get(session)
             entries = list(dq) if dq else None
+            total = self._totals.get(session, 0)
         if not entries:
             return None
         secs = sorted(s for s, _ in entries)
@@ -437,6 +447,7 @@ class SLOTracker:
         total_p = sum(p for _, p in entries)
         return {
             "waves": len(entries),
+            "totalWaves": total,
             "window": self._window,
             "p50WaveSeconds": round(self._pct(secs, 0.50), 6),
             "p99WaveSeconds": round(self._pct(secs, 0.99), 6),
@@ -447,6 +458,7 @@ class SLOTracker:
         """Release a torn-down session's window (session eviction)."""
         with self._mu:
             self._waves.pop(session, None)
+            self._totals.pop(session, None)
 
     def snapshot(self) -> dict[str, dict]:
         """{session ("" = sessionless): stats} for every session with
@@ -463,6 +475,7 @@ class SLOTracker:
     def reset(self) -> None:
         with self._mu:
             self._waves.clear()
+            self._totals.clear()
 
 
 SLO = SLOTracker()

@@ -25,7 +25,8 @@ import pytest
 from kube_scheduler_simulator_tpu.config.config import SimulatorConfiguration
 from kube_scheduler_simulator_tpu.control import CONTROLS, QOS_TIERS
 from kube_scheduler_simulator_tpu.control.autopilot import (
-    HYSTERESIS_TICKS, Autopilot, autopilot_enabled, shed_qos_tiers)
+    HYSTERESIS_TICKS, _SPEC_MID_TICKS, Autopilot, autopilot_enabled,
+    shed_qos_tiers)
 from kube_scheduler_simulator_tpu.framework.replay import _DeviceResultBudget
 from kube_scheduler_simulator_tpu.models.workloads import (
     make_churn_workload, make_nodes, make_pods)
@@ -150,6 +151,67 @@ def test_speculative_effector_hysteresis_no_thrash():
         mgr.shutdown()
 
 
+def test_speculative_profile_decays_to_default_on_mid_band():
+    """A profile is not forever: a sustained mid-band accept fraction
+    (no hi/lo evidence either way) decays the session back to the
+    static default, mirroring the budget effector's calm-tick decay."""
+    mgr = _mgr(max_sessions=4)
+    ap = Autopilot(mgr, interval=3600, slo_target=0)
+    try:
+        mgr.create("ap-mid")
+
+        def rounds(accepted: int, rolled: int) -> None:
+            TRACER.inc("speculative_accepted_total", accepted,
+                       session="ap-mid")
+            TRACER.inc("speculative_rolled_back_total", rolled,
+                       session="ap-mid")
+
+        ap.tick()   # baseline
+        for _ in range(HYSTERESIS_TICKS):
+            rounds(95, 5)
+            ap.tick()
+        assert CONTROLS.spec_overrides("ap-mid") == (-1, 256)
+        # mid-band rounds: no transition until the decay streak fills
+        for _ in range(_SPEC_MID_TICKS - 1):
+            rounds(70, 30)
+            ap.tick()
+            assert CONTROLS.spec_overrides("ap-mid") == (-1, 256)
+        rounds(70, 30)
+        ap.tick()
+        assert CONTROLS.spec_overrides("ap-mid") == (None, None)
+    finally:
+        mgr.shutdown()
+
+
+def test_speculative_candidates_scale_operator_baseline(monkeypatch):
+    """The profile multipliers scale KSS_TPU_SPECULATIVE_CANDIDATES as
+    the operator set it — aggressive on a 512 baseline means 1024,
+    never a silent cut back to 2x the built-in 128."""
+    monkeypatch.setenv("KSS_TPU_SPECULATIVE_CANDIDATES", "512")
+    mgr = _mgr(max_sessions=4)
+    ap = Autopilot(mgr, interval=3600, slo_target=0)
+    try:
+        mgr.create("ap-env")
+
+        def rounds(accepted: int, rolled: int) -> None:
+            TRACER.inc("speculative_accepted_total", accepted,
+                       session="ap-env")
+            TRACER.inc("speculative_rolled_back_total", rolled,
+                       session="ap-env")
+
+        ap.tick()   # baseline
+        for _ in range(HYSTERESIS_TICKS):
+            rounds(95, 5)
+            ap.tick()
+        assert CONTROLS.spec_overrides("ap-env") == (-1, 1024)
+        for _ in range(HYSTERESIS_TICKS):
+            rounds(5, 95)
+            ap.tick()
+        assert CONTROLS.spec_overrides("ap-env") == (0, 256)
+    finally:
+        mgr.shutdown()
+
+
 # ----------------------------------------------- effector: HBM rebalance
 
 
@@ -241,9 +303,11 @@ def test_shed_effector_hysteresis_and_recovery_band():
         # critical breaches identically but is never shed
         assert CONTROLS.shed_state("ap-crit") == (False, 0)
         # hovering inside the recovery band (0.8x..1x target) must not
-        # flap the gate open
+        # flap the gate open — live waves keep arriving (in-flight
+        # backlog still runs while shed), each tick sees fresh evidence
         _fill_slo("ap-shed", 0.09)
         for _ in range(4):
+            _fill_slo("ap-shed", 0.09, n=1)
             ap.tick()
         assert CONTROLS.shed_state("ap-shed")[0] is True
         # a genuine recovery under 0.8x target lifts the shed
@@ -253,6 +317,37 @@ def test_shed_effector_hysteresis_and_recovery_band():
         assert CONTROLS.shed_state("ap-shed")[0] is False
         eff = ap.stats()["decisionsByEffector"]
         assert eff.get("shed", 0) >= 2   # one shed + one unshed landed
+    finally:
+        mgr.shutdown()
+
+
+def test_shed_lifts_after_quiescence_and_can_reshed():
+    """The anti-latch contract: once shed, the 429 gate stops inflow,
+    the count-based SLO window freezes at its breach-era p99, and no
+    recovery evidence can ever arrive through it.  Ticks where a
+    shedding session ran ZERO new waves must therefore count toward
+    recovery — and a client that floods again after the lift is shed
+    again from fresh evidence."""
+    mgr = _mgr(max_sessions=4)
+    ap = Autopilot(mgr, interval=3600, slo_target=0.1)
+    try:
+        mgr.create("ap-quiet", qos="best-effort")
+        _fill_slo("ap-quiet", 1.0)
+        for _ in range(HYSTERESIS_TICKS):
+            ap.tick()
+        assert CONTROLS.shed_state("ap-quiet")[0] is True
+        # inflow stops (clients back off per Retry-After): the window
+        # still reads p99=1.0s, but with no new waves the shed must
+        # lift after HYSTERESIS_TICKS quiet ticks, not latch forever
+        ap.tick()
+        assert CONTROLS.shed_state("ap-quiet")[0] is True   # streak 1
+        ap.tick()
+        assert CONTROLS.shed_state("ap-quiet")[0] is False
+        # the returning flood is fresh breach evidence: shed again
+        for _ in range(HYSTERESIS_TICKS):
+            _fill_slo("ap-quiet", 1.0, n=1)
+            ap.tick()
+        assert CONTROLS.shed_state("ap-quiet")[0] is True
     finally:
         mgr.shutdown()
 

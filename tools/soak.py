@@ -309,20 +309,32 @@ def run_soak(ticks: int = 18) -> dict:
 
         # ---- cooldown: overload stops, the shed must lift ---------
         # the still-pending bulk backlog is dropped first (clients gave
-        # up) so the steady cooldown waves reuse the precompiled
-        # WAVE_QUANTUM shape and the window can actually drain
+        # up), then recovery is probed through the REAL client surface:
+        # HTTP POSTs that keep 429ing while shed and succeed once the
+        # controller reopens the gate.  Nothing feeds the engine
+        # directly here — a quiesced shed session must recover on its
+        # own (no new waves is no evidence of ongoing breach), which is
+        # exactly what real backed-off clients would observe.
         _drop_pods(stores[BE], bound=False)
         shed_lifted = False
-        for t in range(3 * window):
-            _fill(stores[BE], _pods(
-                WAVE_QUANTUM, seed=500 + t, prefix=f"soak-cool-{t}",
-                cheap=True))
-            engines[BE].schedule_pending()
-            _drop_pods(stores[BE], bound=True)
-            time.sleep(0.05)
-            if not CONTROLS.shed_state(BE)[0]:
+        for t in range(6 * window):
+            probe = _pods(1, seed=500 + t, prefix=f"soak-cool-{t}",
+                          cheap=True)[0]
+            code, hdrs, body = _req(
+                port, "POST", f"/api/v1/sessions/{BE}/pods", probe)
+            if code == 201:
                 shed_lifted = True
+                engines[BE].schedule_pending()   # bind the probe pod
+                _drop_pods(stores[BE], bound=True)
                 break
+            if code != 429:
+                failures.append(f"cooldown probe -> {code} (tick {t})")
+                break
+            retry_hdr = hdrs.get("Retry-After")
+            if retry_hdr is None or not str(retry_hdr).isdigit():
+                failures.append(
+                    f"cooldown 429 missing Retry-After (tick {t})")
+            time.sleep(0.05)
         if not shed_lifted:
             failures.append("shed never lifted after the overload stopped")
         else:
