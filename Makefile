@@ -9,7 +9,8 @@ PY ?= python
 
 .PHONY: codec native-asan native-tsan test test-asan test-tsan analyze \
         bench bench-check bench-gang bench-serve bench-spec bench-fuse \
-        bench-multichip bench-scale bench-soak blackbox-smoke smoke chaos \
+        bench-multichip bench-scale bench-soak blackbox-smoke obs-smoke \
+        smoke chaos \
         clean \
         parity-fullscale parity-fullscale-device multichip-scaling \
         host-probe tpu-watch
@@ -76,9 +77,12 @@ bench-soak:
 	        'std p99 %.3fs over target' % d['soak_p99_wave_seconds']; \
 	    assert d['all_shed_had_retry_after'], 'shed without Retry-After'; \
 	    assert d['soak_recovered_to_rung0'], 'ladder pinned degraded'; \
-	    print('bench-soak: ok=true (p99 %.3fs, shed rate %.2f, %d decisions)' \
+	    assert d['history_breach_before_shed'] and d['history_shed_lift_recorded'], \
+	        'breach->shed->recovery not reconstructible from the history ring'; \
+	    assert d['shed_evidence_checked'] >= 1, 'no shed evidence checked against the ring'; \
+	    print('bench-soak: ok=true (p99 %.3fs, shed rate %.2f, %d decisions, %d evidence rows ring-checked)' \
 	        % (d['soak_p99_wave_seconds'], d['soak_shed_rate'], \
-	           d['autopilot']['decisions']))"
+	           d['autopilot']['decisions'], d['shed_evidence_checked']))"
 
 host-probe:
 	$(PY) docs/bench/host_page_backing.py
@@ -121,7 +125,15 @@ analyze:
 blackbox-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m tools.blackbox_smoke
 
-test: analyze blackbox-smoke
+# causal-telemetry smoke gate (docs/metrics.md "History & correlation"):
+# run one faulted wave under an explicit trace id and assert the id
+# threads the tracer spans, the post-mortem dump's events, and the
+# Perfetto export (spans + black-box instants), and that the dump's
+# embedded history window validates — one trace id, every surface
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m tools.obs_smoke
+
+test: analyze blackbox-smoke obs-smoke
 	$(PY) -m pytest tests/ -q -m "not slow"
 
 bench:

@@ -1258,6 +1258,92 @@ def measure_blackbox(scale_pods: int, scale_nodes: int, seed: int,
     }
 
 
+def measure_history(scale_pods: int, scale_nodes: int, seed: int,
+                    reps: int = 3):
+    """Telemetry-history + trace-correlation overhead A/B
+    (docs/metrics.md "History & correlation"): the always-on plane —
+    columnar ring sampling (utils/history.py) and trace-id scope
+    propagation — must cost <= 1.05x.  Same-process interleaved
+    best-of-`reps` engine waves: the ON arm runs each wave under an
+    explicit trace scope and takes a feeder sample per wave (the
+    sampler thread's cadence, compressed); the OFF arm is the
+    KSS_TPU_HISTORY=0 lever with no trace scope.  Annotations are
+    asserted byte-identical across arms — the plane must never touch
+    the product."""
+    from kube_scheduler_simulator_tpu.cluster.store import ObjectStore
+    from kube_scheduler_simulator_tpu.framework.engine import SchedulerEngine
+    from kube_scheduler_simulator_tpu.models.workloads import (
+        make_nodes, make_pods)
+    from kube_scheduler_simulator_tpu.plugins.registry import PluginSetConfig
+    from kube_scheduler_simulator_tpu.utils import history
+    from kube_scheduler_simulator_tpu.utils.blackbox import FEEDER
+    from kube_scheduler_simulator_tpu.utils.tracing import TRACER
+
+    nodes = make_nodes(scale_nodes, seed=seed, taint_fraction=0.1)
+    enabled = ["NodeResourcesFit", "NodeResourcesBalancedAllocation",
+               "NodeAffinity", "TaintToleration", "PodTopologySpread"]
+    log(f"history overhead A/B: {scale_pods} pods x {scale_nodes} nodes, "
+        f"{reps} reps/arm interleaved")
+
+    def run(arm: bool) -> tuple[float, dict]:
+        store = ObjectStore()
+        for n in nodes:
+            store.create("nodes", n)
+        for p in make_pods(scale_pods, seed=seed + 1, with_affinity=True,
+                           with_tolerations=True, with_spread=True):
+            store.create("pods", p)
+        engine = SchedulerEngine(
+            store, plugin_config=PluginSetConfig(enabled=enabled), chunk=512)
+        trace = "bench-trace" if arm else None
+        t0 = time.perf_counter()
+        with TRACER.trace_scope(trace):
+            engine.schedule_pending()
+        FEEDER.sample()   # the sampler tick (no-op shape when off)
+        wall = time.perf_counter() - t0
+        state = {}
+        for p in store.list("pods")[0]:
+            meta = p.get("metadata") or {}
+            state[meta.get("name", "")] = (
+                (p.get("spec") or {}).get("nodeName"),
+                dict(meta.get("annotations") or {}))
+        engine.close()
+        return wall, state
+
+    prev = history.enabled()
+    best = {True: float("inf"), False: float("inf")}
+    states: dict = {}
+    try:
+        history.set_enabled(True)
+        run(True)  # warm: XLA compile stays out of the measured reps
+        for _ in range(reps):
+            for arm in (True, False):
+                history.set_enabled(arm)
+                wall, state = run(arm)
+                best[arm] = min(best[arm], wall)
+                states[arm] = state
+    finally:
+        history.set_enabled(prev)
+    identical = states.get(True) == states.get(False)
+    if not identical:
+        raise RuntimeError(
+            "history A/B produced different annotations — the telemetry "
+            "plane must never touch the product")
+    on_cps = round(scale_pods / best[True], 1)
+    off_cps = round(scale_pods / best[False], 1)
+    ratio = round(on_cps / off_cps, 4) if off_cps else None
+    log(f"  history on {on_cps:,.0f} vs off {off_cps:,.0f} cycles/s "
+        f"(ratio {ratio}); annotations byte-identical: {identical}")
+    return {
+        "pods": scale_pods, "nodes": scale_nodes,
+        "on_cycles_per_sec": on_cps,
+        "off_cycles_per_sec": off_cps,
+        "overhead_ratio": ratio,
+        # the <=1.05x acceptance bar: on/off >= 1/1.05 ~= 0.9524
+        "within_bound": ratio is not None and ratio >= 0.95,
+        "annotations_identical": identical,
+    }
+
+
 def measure_cpu_baseline(idx: int, cpu_scale: float, node_scale: float,
                          seed: int, parallelism: int, cache: dict, rev: str):
     from kube_scheduler_simulator_tpu.models.workloads import baseline_config
@@ -1725,6 +1811,19 @@ def _run(args):
             # never trading the headline line for this tap
             log(f"blackbox phase failed: {type(e).__name__}: {e}")
             extra["blackbox"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    # --- telemetry history + trace correlation --------------------------
+    # overhead A/B (on vs KSS_TPU_HISTORY=0) + byte-identity assert,
+    # same discipline as the blackbox tap above: bench_check gates the
+    # history_overhead_ratio, and a divergence raise lands as an error
+    # payload that refuses the round rather than a silent skip
+    if not args.assume_fallback:
+        try:
+            hp, hn = (60, 30) if args.smoke else (1000, 500)
+            extra["history"] = measure_history(hp, hn, args.seed)
+        except Exception as e:
+            log(f"history phase failed: {type(e).__name__}: {e}")
+            extra["history"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     try:
         from kube_scheduler_simulator_tpu.utils.blackbox import TELEMETRY
         extra["hbm"] = TELEMETRY.sample_once()

@@ -59,6 +59,7 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
     serve = extra.get("serve") or {}
     spec = (extra.get("speculative") or {}).get("low_contention") or {}
     bbox = extra.get("blackbox") or {}
+    hist = extra.get("history") or {}
     fuse = extra.get("fuse") or {}
     spans10k = eng10k.get("spans") or {}
     return {
@@ -117,6 +118,12 @@ def key_metrics(bench: dict) -> dict[str, tuple[float | None, str]]:
         # being free (the <=2% acceptance bar, noise-bound)
         "blackbox_overhead_ratio":
             (bbox.get("overhead_ratio"), "higher"),
+        # telemetry-history era metric (absent from pre-history rounds —
+        # union/skip carries them): on/off cycles/s ratio of the
+        # columnar ring + trace-scope A/B; a drop means the always-on
+        # causal plane stopped being free (the <=1.05x acceptance bar)
+        "history_overhead_ratio":
+            (hist.get("overhead_ratio"), "higher"),
         # cross-session fused dispatch era metrics (absent from pre-fuse
         # rounds — union/skip carries them): the K=4 fused arm's
         # aggregate and slowest-session cycles/s; a drop means the fused

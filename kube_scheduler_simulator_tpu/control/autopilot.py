@@ -54,8 +54,10 @@ import atexit
 import math
 import sys
 import threading
+import time
+from collections import deque
 
-from ..utils.blackbox import BLACKBOX, SLO
+from ..utils.blackbox import BLACKBOX, FEEDER
 from ..utils.env import env_float, env_int, env_switch
 from ..utils.faults import fault_point
 from ..utils.tracing import TRACER
@@ -148,6 +150,9 @@ class Autopilot:
         self._ticks = 0
         self._decisions = 0
         self._failsafes = 0
+        # the provenance ring behind /api/v1/sessions lastDecisions:
+        # recent decisions WITH their evidence blocks, newest last
+        self._recent: deque = deque(maxlen=64)
 
     # ------------------------------------------------------- lifecycle
 
@@ -209,13 +214,18 @@ class Autopilot:
     def _tick_inner(self) -> int:
         sessions = self.manager.sessions_brief()
         live = {sid for sid, _q, _t, _b in sessions}
-        accepted = TRACER.labeled_totals("speculative_accepted_total",
-                                         "session")
-        rolled = TRACER.labeled_totals("speculative_rolled_back_total",
-                                       "session")
-        spilled = TRACER.labeled_totals("device_chunks_spilled_total",
-                                        "session")
-        slo = SLO.snapshot()
+        # one feeder tick reads every plane ONCE and appends the ring
+        # sample this tick's decisions cite: the evidence blocks below
+        # come from the SAME dicts that populated the ring at
+        # `hist_idx`, so provenance matches the ring bit-for-bit
+        # (utils/blackbox.py HistoryFeeder).  With KSS_TPU_HISTORY=0
+        # hist_idx is -1 and the planes are identical — one code path,
+        # the parity baseline unchanged.
+        hist_idx, planes = FEEDER.sample()
+        accepted = planes["accepted"]
+        rolled = planes["rolled"]
+        spilled = planes["spilled"]
+        slo = planes["slo"]
         from ..framework.replay import _DEVICE_BUDGET
 
         limit = _DEVICE_BUDGET.limit_bytes()
@@ -236,15 +246,22 @@ class Autopilot:
                 st = self._state.get(sid)
                 if st is None:
                     st = self._state[sid] = _SessState()
-                self._plan_speculative(plan, sid, st, accepted, rolled)
+                # shared evidence base: the session's SLO window as the
+                # effectors saw it this tick, plus the ring index the
+                # feeder wrote it to (absent when history is off)
+                evd = {"sloWindow": slo.get(sid)}
+                if hist_idx >= 0:
+                    evd["historyIndex"] = hist_idx
+                self._plan_speculative(plan, sid, st, accepted, rolled,
+                                       evd)
                 spill_d = spilled.get(sid, 0.0) - st.spilled
                 st.spilled = spilled.get(sid, 0.0)
                 if limit is not None and limit > 0:
                     any_spill |= self._plan_budget(
                         plan, sid, st, spill_d, retained.get(sid, 0),
-                        limit, len(sessions))
+                        limit, len(sessions), evd)
                 any_breach |= self._plan_shed(plan, sid, st, qos,
-                                              slo.get(sid))
+                                              slo.get(sid), evd)
         if plan:
             self._apply(plan)
         if any_spill and any_breach:
@@ -259,7 +276,8 @@ class Autopilot:
 
     # ------------------------------------------------- effector: spec
 
-    def _plan_speculative(self, plan, sid, st, accepted, rolled) -> None:
+    def _plan_speculative(self, plan, sid, st, accepted, rolled,
+                          evd) -> None:
         a_d = accepted.get(sid, 0.0) - st.accepted
         r_d = rolled.get(sid, 0.0) - st.rolled
         st.accepted = accepted.get(sid, 0.0)
@@ -306,12 +324,14 @@ class Autopilot:
             st.hi_streak = st.lo_streak = st.mid_streak = 0
             CONTROLS.set_spec(sid, rung, cand)
 
-        plan.append(("speculative", sid, frm, to, reason, apply))
+        plan.append(("speculative", sid, frm, to, reason,
+                     {**evd, "acceptFraction": round(frac, 6),
+                      "rounds": int(a_d + r_d)}, apply))
 
     # ----------------------------------------------- effector: budget
 
     def _plan_budget(self, plan, sid, st, spill_d, retained_b,
-                     limit, n_sessions) -> bool:
+                     limit, n_sessions, evd) -> bool:
         """Returns True when this session spilled this tick."""
         cur = self._weight(sid)
         want = cur
@@ -340,12 +360,14 @@ class Autopilot:
         def apply(sid=sid, want=want):
             CONTROLS.set_budget_weight(sid, want)
 
-        plan.append(("budget", sid, cur, want, reason, apply))
+        plan.append(("budget", sid, cur, want, reason,
+                     {**evd, "spillDelta": int(spill_d),
+                      "retainedBytes": int(retained_b)}, apply))
         return spill_d > 0
 
     # ------------------------------------------------- effector: shed
 
-    def _plan_shed(self, plan, sid, st, qos, slo_stats) -> bool:
+    def _plan_shed(self, plan, sid, st, qos, slo_stats, evd) -> bool:
         """Returns True when this session's window shows a live breach."""
         if self.slo_target <= 0:
             return False
@@ -387,7 +409,11 @@ class Autopilot:
             plan.append(("shed", sid, "open", "shedding",
                          f"qos={qos} p99 {p99:.3f}s > target "
                          f"{self.slo_target:.3f}s "
-                         f"x{st.breach_streak} ticks", apply))
+                         f"x{st.breach_streak} ticks",
+                         {**evd, "p99WaveSeconds": p99,
+                          "sloTargetP99Seconds": self.slo_target,
+                          "breachStreak": st.breach_streak,
+                          "freshWaves": fresh}, apply))
         elif shedding and st.ok_streak >= HYSTERESIS_TICKS:
             def apply(sid=sid):
                 CONTROLS.set_shed(sid, False)
@@ -395,7 +421,11 @@ class Autopilot:
             plan.append(("shed", sid, "shedding", "open",
                          f"p99 {'n/a' if p99 is None else f'{p99:.3f}s'} "
                          f"back under 0.8x target "
-                         f"x{st.ok_streak} ticks", apply))
+                         f"x{st.ok_streak} ticks",
+                         {**evd, "p99WaveSeconds": p99,
+                          "sloTargetP99Seconds": self.slo_target,
+                          "okStreak": st.ok_streak,
+                          "freshWaves": fresh}, apply))
         return breach
 
     # ------------------------------------------------------- plumbing
@@ -410,17 +440,25 @@ class Autopilot:
         # zero of this tick's decisions land and tick()'s fail-safe
         # reverts whatever previous ticks applied
         fault_point("autopilot.decide")
-        for effector, sid, frm, to, reason, apply in plan:
+        for effector, sid, frm, to, reason, evidence, apply in plan:
             apply()
-            self._decide(effector, sid, frm, to, reason)
+            self._decide(effector, sid, frm, to, reason, evidence)
 
-    def _decide(self, effector, session, frm, to, reason) -> None:
+    def _decide(self, effector, session, frm, to, reason,
+                evidence: dict | None = None) -> None:
         with self._mu:
             self._decisions += 1
+            self._recent.append({
+                "t": round(time.time(), 6), "effector": effector,
+                "session": session, "from": frm, "to": to,
+                "reason": reason, "evidence": evidence,
+            })
         TRACER.inc("autopilot_decisions_total", effector=effector)
         BLACKBOX.record("autopilot.decide", effector=effector,
                         session=session, reason=reason,
-                        **{"from": frm, "to": to})
+                        **{"from": frm, "to": to},
+                        **({"evidence": evidence}
+                           if evidence is not None else {}))
 
     # ---------------------------------------------------------- stats
 
@@ -429,10 +467,17 @@ class Autopilot:
         with self._mu:
             ticks, decisions, failsafes = (self._ticks, self._decisions,
                                            self._failsafes)
+            recent = list(self._recent)
         by_eff = TRACER.labeled_totals("autopilot_decisions_total",
                                        "effector")
         controls = CONTROLS.stats()
+        # decision provenance, grouped per session (None -> "" for the
+        # sessionless evict decisions), last 5 each with evidence
+        last: dict[str, list] = {}
+        for d in recent:
+            last.setdefault(d["session"] or "", []).append(d)
         return {
+            "lastDecisions": {k: v[-5:] for k, v in last.items()},
             "enabled": autopilot_enabled(),
             "running": self.running,
             "intervalSeconds": self.interval,

@@ -770,7 +770,15 @@ class SchedulerEngine:
         gang may complete in a later call's wave); expired ones are
         timeout-rejected — whole gangs at a time — at the top of every
         call (docs/gang-scheduling.md)."""
-        with TRACER.session_scope(self.session):
+        # trace correlation (docs/metrics.md): the wave that drains the
+        # submitted work claims the session's pending trace id (noted by
+        # the server per workload-submitting request, consume-once) so
+        # every span/event below — wave, speculative rounds, fused
+        # dispatch — carries the id of the HTTP request that caused it.
+        # trace_scope(None) is a no-op, so direct engine use under an
+        # explicit caller-provided trace scope is left untouched.
+        with TRACER.session_scope(self.session), \
+                TRACER.trace_scope(TRACER.claim_session_trace(self.session)):
             return self._schedule_pending_scoped()
 
     def _schedule_pending_scoped(self) -> int:

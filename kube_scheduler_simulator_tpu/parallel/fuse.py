@@ -129,13 +129,17 @@ class _Stream:
 
 
 class _Batch:
-    """One in-formation fused dispatch: member args in join order, the
-    per-member output rows, and the done event followers wait on."""
+    """One in-formation fused dispatch: member args in join order, each
+    member's trace id (captured on its own thread at join — the fused
+    event lists EVERY participant's trace so one id finds the shared
+    dispatch from any side), the per-member output rows, and the done
+    event followers wait on."""
 
-    __slots__ = ("args", "outs", "error", "done", "closed")
+    __slots__ = ("args", "traces", "outs", "error", "done", "closed")
 
     def __init__(self):
         self.args: list = []
+        self.traces: list = []
         self.outs: list = []
         self.error: BaseException | None = None
         self.done = threading.Event()
@@ -231,10 +235,12 @@ class FuseCoordinator:
                         and len(batch.args) < MAX_FUSE_SESSIONS:
                     idx = len(batch.args)
                     batch.args.append(args)
+                    batch.traces.append(TRACER.current_trace())
                     self._cv.notify_all()
                 else:
                     batch = self._batches[key] = _Batch()
                     batch.args.append(args)
+                    batch.traces.append(TRACER.current_trace())
                     # wake leaders waiting at OTHER keys: a new leader
                     # here may complete a mutual-leader deadlock they
                     # must detect (see _lead) instead of sleeping out
@@ -291,14 +297,15 @@ class FuseCoordinator:
         except BaseException as e:
             batch.error = e
             BLACKBOX.record("fuse.dispatch", result="error", k=k,
-                            error=type(e).__name__)
+                            error=type(e).__name__,
+                            traces=[t for t in batch.traces if t])
             raise
         finally:
             batch.done.set()
         with self._mu:
             self._fused_dispatches += 1
             self._fused_sessions += k
-        self._record("fused", k)
+        self._record("fused", k, traces=batch.traces)
         return batch.outs[0]
 
     def _follow(self, batch: _Batch, idx: int):
@@ -311,9 +318,10 @@ class FuseCoordinator:
             # session's own wave protocol retries its own suffix
             BLACKBOX.record("fuse.dispatch", result="error",
                             k=len(batch.args),
-                            error=type(batch.error).__name__)
+                            error=type(batch.error).__name__,
+                            traces=[t for t in batch.traces if t])
             raise batch.error
-        self._record("fused", len(batch.args))
+        self._record("fused", len(batch.args), traces=batch.traces)
         return batch.outs[idx]
 
     def _solo(self, solo_fn, args, result: str):
@@ -322,17 +330,24 @@ class FuseCoordinator:
         self._record(result, 1)
         return out
 
-    def _record(self, result: str, k: int) -> None:
+    def _record(self, result: str, k: int, traces=None) -> None:
         """Per-member taps, recorded on the REQUESTING thread so the
         tracer's session scope folds the right session label in —
         device time in a fused call attributes to every session that
-        shared it, through each member's own fused_dispatch span."""
+        shared it, through each member's own fused_dispatch span.
+        `traces` lists EVERY batch member's trace id (fused results),
+        so one request's trace id finds the cross-session dispatch it
+        shared regardless of which member recorded the event."""
         TRACER.inc("fused_dispatch_total", result=result)
         TRACER.observe("fused_sessions_per_dispatch", k)
         if result != "timeshared":
             # timeshared rounds are the steady solo state — recording
             # each would drown the black-box ring in non-events
-            BLACKBOX.record("fuse.dispatch", result=result, k=k)
+            extra = {}
+            ids = [t for t in (traces or ()) if t]
+            if ids:
+                extra["traces"] = ids
+            BLACKBOX.record("fuse.dispatch", result=result, k=k, **extra)
         with self._mu:
             self._tally[result] = self._tally.get(result, 0) + 1
 

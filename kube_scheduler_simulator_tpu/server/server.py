@@ -32,7 +32,15 @@ Observability surface (docs/metrics.md):
   GET  /api/v1/metrics          -> full tracer snapshot JSON (?session=)
   GET  /api/v1/metrics/stream   -> SSE snapshots (?interval=S&count=N)
   GET  /api/v1/trace            -> Perfetto/chrome://tracing JSON
-                                   (?limit=N&session=)
+                                   (?limit=N&session=&trace_id=)
+  GET  /api/v1/history          -> columnar telemetry history window
+                                   (?series=&since=&stride=&session=;
+                                   utils/history.py, docs/metrics.md)
+Trace correlation: every workload-submitting request is stamped with a
+trace id (inbound X-KSS-Trace-Id honored, minted otherwise, echoed
+back on the response) that the next scheduling wave claims — one id
+ties the HTTP request to its wave, speculative rounds and fused
+dispatches across every surface above.
   GET  /api/v1/debug/dump       -> wave black-box post-mortem bundle
                                    (?session=; utils/blackbox.py)
   POST /api/v1/profile          -> XLA profile start/stop (409 on bad state)
@@ -51,6 +59,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -158,6 +167,11 @@ def _make_handler(server: SimulatorServer):
             self.send_header("Content-Length", str(len(body)))
             for k, v in (headers or {}).items():
                 self.send_header(k, str(v))
+            # echo the request's trace id (minted or client-supplied)
+            # so the submitter can later query /api/v1/trace?trace_id=
+            tid = getattr(self, "trace_id", None)
+            if tid:
+                self.send_header("X-KSS-Trace-Id", tid)
             self.end_headers()
             if body:
                 self.wfile.write(body)
@@ -197,6 +211,10 @@ def _make_handler(server: SimulatorServer):
         def _route(self, method: str):
             url = urlparse(self.path)
             path = url.path.rstrip("/")
+            # cleared per request, not per connection: keep-alive reuses
+            # this handler instance and a stale id must never echo onto
+            # an unrelated response
+            self.trace_id = None
             try:
                 # ------- session surface + per-session aliasing -------
                 # /api/v1/sessions[/<id>[/<subpath>]]: the CRUD surface,
@@ -227,7 +245,20 @@ def _make_handler(server: SimulatorServer):
                 self.routed_sid = routed_sid
                 from ..utils.tracing import TRACER
 
-                with TRACER.session_scope(sess.id):
+                # trace correlation (docs/metrics.md): workload-
+                # submitting requests get a trace id — the client's
+                # X-KSS-Trace-Id when present, minted otherwise — that
+                # scopes this request's spans/events, is echoed on the
+                # response, and is noted for the session so the wave
+                # that drains the submitted work claims it
+                # (framework/engine.py schedule_pending)
+                if method == "POST" and self._sheddable(path):
+                    tid = (self.headers.get("X-KSS-Trace-Id")
+                           or f"t-{uuid.uuid4().hex[:16]}")
+                    self.trace_id = tid
+                    TRACER.note_session_trace(sess.id, tid)
+                with TRACER.session_scope(sess.id), \
+                        TRACER.trace_scope(self.trace_id):
                     return self._dispatch(method, path, url)
             except ApiError as e:
                 self._error(e)
@@ -280,6 +311,8 @@ def _make_handler(server: SimulatorServer):
                 return self._metrics_stream(url)
             if path == "/api/v1/trace" and method == "GET":
                 return self._trace(url)
+            if path == "/api/v1/history" and method == "GET":
+                return self._history(url)
             if path == "/api/v1/debug/dump" and method == "GET":
                 return self._debug_dump(url)
             if path == "/api/v1/profile" and method == "POST":
@@ -517,6 +550,14 @@ def _make_handler(server: SimulatorServer):
                 body["autopilot"] = {k: aps[k] for k in
                                      ("enabled", "running", "ticks",
                                       "decisions", "failsafes", "shedding")}
+            # a silently-truncating span ring defeats the history /
+            # provenance claims: surface evictions the moment they start
+            # (KSS_TPU_TRACER_CAPACITY grows the ring)
+            from ..utils.tracing import TRACER
+
+            dropped = TRACER.dropped_events()
+            if dropped:
+                body["tracerDroppedEvents"] = int(dropped)
             if loop.last_crash is not None:
                 body["lastCrash"] = {k: loop.last_crash[k]
                                      for k in ("time", "error")}
@@ -546,7 +587,39 @@ def _make_handler(server: SimulatorServer):
                     return self._json(400, {"reason": "BadRequest",
                                             "message": f"bad limit {v!r}"})
             return self._json(200, TRACER.perfetto(
-                limit=limit, session=self._session_filter(url)))
+                limit=limit, session=self._session_filter(url),
+                trace_id=params.get("trace_id", [None])[0]))
+
+        def _history(self, url):
+            """GET /api/v1/history?series=&since=&stride=&session=
+            (+ the /api/v1/sessions/<id>/history alias) — a windowed,
+            stride-downsampled read of the columnar telemetry history
+            ring (utils/history.py, docs/metrics.md): index/t arrays
+            plus one array per series, never one dict per sample.
+            `since` is an absolute sample index cursor (use the
+            response's nextIndex to poll incrementally); `series` is a
+            comma-separated filter by full name or bare prefix."""
+            from ..utils.history import HISTORY
+
+            params = parse_qs(url.query)
+
+            def _int(name, dflt):
+                v = params.get(name, [""])[0]
+                return int(v) if v else dflt
+
+            try:
+                since = _int("since", 0)
+                stride = _int("stride", 1)
+                limit = _int("limit", None)
+            except ValueError:
+                return self._json(400, {
+                    "reason": "BadRequest",
+                    "message": "since/stride/limit must be integers"})
+            raw = params.get("series", [""])[0]
+            names = [s for s in raw.split(",") if s] or None
+            return self._json(200, HISTORY.window(
+                series=names, since=since, stride=stride,
+                session=self._session_filter(url), limit=limit))
 
         def _metrics_stream(self, url):
             """GET /api/v1/metrics/stream?interval=S&count=N — Server-Sent

@@ -508,6 +508,9 @@ class SessionManager:
         SLO.drop_session(sess.id)
         BLACKBOX.drop_session(sess.id)
         CONTROLS.drop(sess.id)
+        from ..utils.history import HISTORY
+
+        HISTORY.drop_session(sess.id)
 
     # -------------------------------------------------------- shutdown
 

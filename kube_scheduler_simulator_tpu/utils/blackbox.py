@@ -34,9 +34,10 @@ spills chunks.  This module is the always-on flight-data recorder:
     EXPLICIT `hbm_stats_available 0` no-op where the backend has no
     memory stats (the CPU backend) instead of silently absent gauges.
 
-Import discipline: this module depends only on utils.tracing and
-utils.env — everything above it (engine, replay, speculative, faults,
-sessions) records INTO it, never the other way around.
+Import discipline: this module depends only on utils.tracing,
+utils.history and utils.env — everything above it (engine, replay,
+speculative, faults, sessions) records INTO it, never the other way
+around.
 """
 
 from __future__ import annotations
@@ -47,7 +48,9 @@ import threading
 import time
 from collections import deque
 
+from . import history as _history
 from .env import env_float, env_int
+from .history import HISTORY
 from .tracing import TRACER
 
 DUMP_VERSION = 1
@@ -169,6 +172,9 @@ class BlackBox:
         sid = TRACER.current_session()
         if sid is not None:
             ev["session"] = sid
+        tid = TRACER.current_trace()
+        if tid is not None:
+            ev["trace_id"] = tid
         ev.update(fields)
         with self._mu:
             self._seq += 1
@@ -247,6 +253,12 @@ class BlackBox:
             "fault_plan": plan.stats() if plan is not None else None,
             "env": _env_knobs(),
             "device": device_fingerprint(),
+            # the trailing telemetry-history window (utils/history.py):
+            # a wave-abort dump answers "what was trending before this"
+            # by itself — p99 creep, spill bursts, autopilot moves.
+            # Session-scoped bundles keep only that session's series
+            # (the same isolation rule as events/open_spans above).
+            "history": HISTORY.tail(64, session=session),
         }
         # JSON round trip: the bundle must be immutable evidence, never
         # an aliased view of live dicts a later wave keeps mutating
@@ -370,8 +382,37 @@ def validate_dump(doc: dict, require_fault: bool = False,
                 if field not in ev:
                     raise ValueError(
                         f"autopilot.decide missing {field!r}: {ev!r}")
+            # provenance: when the decision carries an evidence block
+            # it must be structured (the planes the effector read) and
+            # any cited history index must be an integer
+            evd = ev.get("evidence")
+            if evd is not None:
+                if not isinstance(evd, dict):
+                    raise ValueError(
+                        f"autopilot.decide evidence not a dict: {ev!r}")
+                hidx = evd.get("historyIndex")
+                if hidx is not None and not isinstance(hidx, int):
+                    raise ValueError(
+                        f"evidence historyIndex not an int: {ev!r}")
     if not isinstance(doc["counter_deltas"], dict):
         raise ValueError("counter_deltas is not a dict")
+    hist = doc.get("history")
+    if hist is not None:
+        # the embedded trailing window must be the columnar shape
+        # (utils/history.py): index/t arrays plus equal-length series
+        # columns — never one dict per sample
+        if (not isinstance(hist, dict) or "index" not in hist
+                or "series" not in hist):
+            raise ValueError("history window missing index/series")
+        n_rows = len(hist["index"])
+        if len(hist.get("t") or []) != n_rows:
+            raise ValueError("history t column length != index length")
+        if not isinstance(hist["series"], dict):
+            raise ValueError("history series is not a dict of columns")
+        for nm, col in hist["series"].items():
+            if len(col) != n_rows:
+                raise ValueError(
+                    f"history column {nm!r} length {len(col)} != {n_rows}")
     dev = doc["device"]
     if not isinstance(dev, dict) or "hbm_available" not in dev:
         raise ValueError("device fingerprint missing hbm_available")
@@ -481,6 +522,124 @@ class SLOTracker:
 SLO = SLOTracker()
 
 
+# ------------------------------------------------------- history feeder
+
+
+class HistoryFeeder:
+    """One tick of the observability planes -> one columnar history
+    sample (utils/history.py).
+
+    gather() reads every plane ONCE into plain dicts — SLO windows,
+    per-session speculative/spill counter totals, the control-plane
+    override state — and sample() derives the ring columns from them.
+    The autopilot plans FROM the same returned dicts, so a decision's
+    evidence cites a ring index whose values match what the effector
+    read bit-for-bit (control/autopilot.py decision provenance), and
+    with KSS_TPU_HISTORY=0 the planes are still returned (index -1):
+    one code path, parity preserved.
+
+    Global series are per-sample counter DELTAS (the feeder keeps its
+    own baselines); per-session series are window stats / fractions at
+    sample time.
+    """
+
+    # plain (unlabeled) counters whose per-sample deltas become global
+    # columns; the labeled speculative/spill families are summed from
+    # the per-session planes instead
+    _PLAIN = ("pods_scheduled_total", "pods_unschedulable_total",
+              "scheduling_waves_total")
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._base: dict[str, float] = {}
+
+    def gather(self) -> dict:
+        from ..control import CONTROLS
+
+        return {
+            "slo": SLO.snapshot(),
+            "accepted": TRACER.labeled_totals(
+                "speculative_accepted_total", "session"),
+            "rolled": TRACER.labeled_totals(
+                "speculative_rolled_back_total", "session"),
+            "spilled": TRACER.labeled_totals(
+                "device_chunks_spilled_total", "session"),
+            "controls": CONTROLS.stats(),
+        }
+
+    def sample(self) -> tuple[int, dict]:
+        """Gather the planes and append one ring sample.  Returns
+        (absolute ring index or -1 when history is off, planes)."""
+        planes = self.gather()
+        if not _history.enabled():
+            return -1, planes
+        totals = TRACER.counter_totals()
+        values: dict[str, float] = {}
+        sums = {
+            "speculative_accepted_total":
+                sum(planes["accepted"].values()),
+            "speculative_rolled_back_total":
+                sum(planes["rolled"].values()),
+            "device_chunks_spilled_total":
+                sum(planes["spilled"].values()),
+        }
+        with self._mu:
+            for name in self._PLAIN:
+                cur = float(totals.get(name, 0.0))
+                values[name] = cur - self._base.get(name, 0.0)
+                self._base[name] = cur
+            for name, cur in sums.items():
+                values[name] = cur - self._base.get(name, 0.0)
+                self._base[name] = cur
+            # per-session accept fraction / spill delta this sample
+            # (baselines keyed per session; a torn-down session's keys
+            # are pruned when its counters vanish from the planes)
+            for sid in set(planes["accepted"]) | set(planes["rolled"]):
+                a = planes["accepted"].get(sid, 0.0)
+                r = planes["rolled"].get(sid, 0.0)
+                a_d = a - self._base.get(f"a\x00{sid}", 0.0)
+                r_d = r - self._base.get(f"r\x00{sid}", 0.0)
+                self._base[f"a\x00{sid}"] = a
+                self._base[f"r\x00{sid}"] = r
+                if a_d + r_d > 0:
+                    values[f"spec.accept{{session={sid}}}"] = round(
+                        a_d / (a_d + r_d), 6)
+            for sid, sp in planes["spilled"].items():
+                sp_d = sp - self._base.get(f"s\x00{sid}", 0.0)
+                self._base[f"s\x00{sid}"] = sp
+                values[f"spill.delta{{session={sid}}}"] = sp_d
+        for sid, stats in planes["slo"].items():
+            tag = f"{{session={sid}}}"
+            values[f"slo.p50{tag}"] = float(stats["p50WaveSeconds"])
+            values[f"slo.p99{tag}"] = float(stats["p99WaveSeconds"])
+            cps = stats.get("cyclesPerSec")
+            if cps is not None:
+                values[f"slo.cps{tag}"] = float(cps)
+        # autopilot effector state, explicit for every ACTIVE session
+        # (any the SLO plane has seen plus any the control plane is
+        # steering): CONTROLS.stats() omits default-state sessions, but
+        # the ring must record 0.0 / 1.0 there rather than a gap — a
+        # shed on/off transition reconstructs from the columns without
+        # guessing what a missing row meant
+        ctls = planes["controls"]
+        for sid in {s for s in planes["slo"] if s} | set(ctls):
+            ctl = ctls.get(sid) or {}
+            tag = f"{{session={sid}}}"
+            values[f"autopilot.shed{tag}"] = 1.0 if ctl.get("shed") else 0.0
+            values[f"autopilot.budget_weight{tag}"] = float(
+                ctl.get("budgetWeight") or 1.0)
+        idx = HISTORY.append(values, t_us=int(time.time() * 1e6))
+        return idx, planes
+
+    def reset(self) -> None:
+        """Tests only: forget the delta baselines."""
+        with self._mu:
+            self._base.clear()
+
+
+FEEDER = HistoryFeeder()
+
+
 # ----------------------------------------------------- device telemetry
 
 
@@ -545,13 +704,17 @@ class DeviceTelemetry:
 
     def start(self, interval: float | None = None) -> None:
         """Start the sampler (idempotent).  interval <= 0 (or
-        KSS_TPU_HBM_SAMPLE_S=0) samples once and starts no thread.
-        The whole start decision runs under the lock so two concurrent
-        start() calls can never spawn two samplers, and a fresh stop
-        event per thread means a racing stop() never leaves a newly
-        started sampler dead."""
+        KSS_TPU_HBM_SAMPLE_S=0) disables the HBM leg; the same thread
+        also feeds the telemetry history ring every
+        KSS_TPU_HISTORY_SAMPLE_S seconds (utils/history.py) — two
+        cadences, one thread, each with its own next-due clock.  No
+        thread starts when both legs are off.  The whole start decision
+        runs under the lock so two concurrent start() calls can never
+        spawn two samplers, and a fresh stop event per thread means a
+        racing stop() never leaves a newly started sampler dead."""
         if interval is None:
             interval = env_float("KSS_TPU_HBM_SAMPLE_S", 5.0)
+        hist_iv = _history.sample_interval() if _history.enabled() else 0.0
         t = None
         with self._mu:
             self._refs += 1
@@ -559,17 +722,38 @@ class DeviceTelemetry:
             # only by the last stop()): an is_alive() check would let a
             # second caller slip in between thread creation and start()
             if self._thread is None:
-                if interval > 0:
+                if interval > 0 or hist_iv > 0:
                     stop = self._stop = threading.Event()
 
                     def loop():
-                        while not stop.wait(interval):
-                            try:
-                                self.sample_once()
-                            # survive a backend teardown race
-                            # kss-analyze: allow(swallowed-exception)
-                            except Exception:
-                                pass
+                        inf = float("inf")
+                        hbm_iv = interval if interval > 0 else inf
+                        h_iv = hist_iv if hist_iv > 0 else inf
+                        now = time.monotonic()
+                        next_hbm = now + hbm_iv
+                        next_hist = now + h_iv
+                        while True:
+                            wake = min(next_hbm, next_hist)
+                            if stop.wait(max(wake - time.monotonic(),
+                                             0.01)):
+                                return
+                            now = time.monotonic()
+                            if now >= next_hbm:
+                                try:
+                                    self.sample_once()
+                                # survive a backend teardown race
+                                # kss-analyze: allow(swallowed-exception)
+                                except Exception:
+                                    pass
+                                next_hbm = now + hbm_iv
+                            if now >= next_hist:
+                                try:
+                                    FEEDER.sample()
+                                # same contract as the HBM leg
+                                # kss-analyze: allow(swallowed-exception)
+                                except Exception:
+                                    pass
+                                next_hist = now + h_iv
 
                     t = self._thread = threading.Thread(
                         target=loop, daemon=True, name="hbm-sampler")

@@ -38,6 +38,8 @@ import time
 from collections import deque
 from contextlib import contextmanager
 
+from .env import env_int
+
 _PREFIX = "kss_tpu"
 
 # open-span bookkeeping rides the wave black box's enable flag
@@ -257,7 +259,13 @@ class _Hist:
 
 
 class Tracer:
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            # KSS_TPU_TRACER_CAPACITY sizes the span ring: a soak whose
+            # trace tail matters can grow it instead of silently losing
+            # events (tracer_events_dropped_total counts evictions and
+            # /readyz surfaces them as tracerDroppedEvents)
+            capacity = max(64, env_int("KSS_TPU_TRACER_CAPACITY", 4096))
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=capacity)
         self._agg: dict[str, dict] = {}
@@ -298,6 +306,12 @@ class Tracer:
         # absolute values, so the aggregate sample stays unlabeled and
         # the session view is a mirror, not a label)
         self._sgauges: dict[str, dict[str, float]] = {}
+        # pending trace-id handoff, session -> trace id: the server
+        # notes the request's trace id when a workload-submitting call
+        # lands, and the scheduling wave that consumes the work CLAIMS
+        # it (consume-once) so the wave's spans correlate back to the
+        # HTTP request that caused them (docs/metrics.md)
+        self._session_traces: dict[str, str] = {}
 
     # ---------------------------------------------------------- sessions
 
@@ -325,6 +339,49 @@ class Tracer:
             yield
         finally:
             st.pop()
+
+    # ------------------------------------------------------------ traces
+
+    def current_trace(self) -> str | None:
+        """The trace id attached to spans/events recorded on this
+        thread (None outside any trace scope)."""
+        st = getattr(self._tls, "traces", None)
+        return st[-1] if st else None
+
+    @contextmanager
+    def trace_scope(self, trace_id: str | None):
+        """Correlate everything recorded on this thread under one trace
+        id: spans and black-box events gain a trace_id attr, so one id
+        ties an HTTP request to the wave, speculative rounds, and fused
+        dispatches it caused.  Propagates exactly like session_scope;
+        None is a no-op scope (an enclosing scope, if any, stays
+        active)."""
+        if trace_id is None:
+            yield
+            return
+        st = getattr(self._tls, "traces", None)
+        if st is None:
+            st = self._tls.traces = []
+        st.append(str(trace_id))
+        try:
+            yield
+        finally:
+            st.pop()
+
+    def note_session_trace(self, session: str, trace_id: str) -> None:
+        """Stash `trace_id` as the pending trace for `session`'s next
+        scheduling wave (the server calls this for workload-submitting
+        requests; engine.schedule_pending claims it)."""
+        with self._lock:
+            self._session_traces[str(session)] = str(trace_id)
+
+    def claim_session_trace(self, session: str | None) -> str | None:
+        """Pop (consume-once) the pending trace id for `session` — the
+        wave that drains the submitted work owns the correlation."""
+        if session is None:
+            return None
+        with self._lock:
+            return self._session_traces.pop(str(session), None)
 
     # ------------------------------------------------------------- spans
 
@@ -361,6 +418,9 @@ class Tracer:
         session = self.current_session()
         if session is not None and "session" not in attrs:
             attrs["session"] = session
+        trace_id = self.current_trace()
+        if trace_id is not None and "trace_id" not in attrs:
+            attrs["trace_id"] = trace_id
         t0 = time.perf_counter()
         if BLACKBOX_OPEN_SPANS:
             with self._lock:
@@ -369,6 +429,8 @@ class Tracer:
                     "parent_id": sp.parent_id,
                     "tid": self._tid(), "t0": time.time(),
                     **({"session": session} if session is not None else {}),
+                    **({"trace_id": trace_id} if trace_id is not None
+                       else {}),
                 }
         try:
             yield sp
@@ -469,6 +531,14 @@ class Tracer:
         for s in spans:
             s["seconds_so_far"] = round(max(now - s.pop("t0"), 0.0), 6)
         return spans
+
+    def dropped_events(self) -> float:
+        """Spans evicted from the full ring so far
+        (tracer_events_dropped_total) — /readyz surfaces this as
+        tracerDroppedEvents when nonzero."""
+        with self._lock:
+            return float(self._counters.get(
+                "tracer_events_dropped_total", 0))
 
     def counter_totals(self) -> dict[str, float]:
         """Every counter flattened to one {key: value} dict: plain
@@ -758,7 +828,8 @@ class Tracer:
     # --------------------------------------------------------- perfetto
 
     def perfetto(self, limit: int | None = None,
-                 session: str | None = None) -> dict:
+                 session: str | None = None,
+                 trace_id: str | None = None) -> dict:
         """chrome://tracing / Perfetto JSON of the recorded span tree.
 
         Complete events ("ph": "X") on per-thread tracks; ts/dur in
@@ -766,18 +837,43 @@ class Tracer:
         args so the tree survives even across thread tracks (the
         commit worker's commit_stream spans visibly overlap the
         replay_and_decode_stream parent on another track —
-        docs/metrics.md walkthrough)."""
+        docs/metrics.md walkthrough).  Black-box events (wave faults,
+        autopilot decisions, speculative rounds) ride along as instant
+        ("ph": "i") events on the same timeline, so a chrome://tracing
+        load shows WHAT happened inline with WHERE the wave was."""
         with self._lock:
             evs = list(self._events)
             tids = dict(self._tids)
+        # black-box events become instants on the correlated timeline;
+        # a function-level import — blackbox imports tracing at module
+        # level, so the reverse edge must stay lazy
+        from .blackbox import BLACKBOX
+        instants = BLACKBOX.events()
         if session is not None:
             # ?session= filtering (docs/metrics.md): only spans recorded
             # under that session's scope — filtered BEFORE the limit cut
             # so a busy neighbor can't push this session's spans out of
             # the window
             evs = [ev for ev in evs if ev.get("session") == str(session)]
+            instants = [ev for ev in instants
+                        if ev.get("session") == str(session)]
+        if trace_id is not None:
+            # ?trace_id= filtering: the causal slice of ONE request —
+            # spans and instants stamped with that id, across sessions
+            # (a fused dispatch lists every participant's trace id)
+            tid_s = str(trace_id)
+
+            def _matches(ev: dict) -> bool:
+                if ev.get("trace_id") == tid_s:
+                    return True
+                traces = ev.get("traces")
+                return isinstance(traces, (list, tuple)) and tid_s in traces
+
+            evs = [ev for ev in evs if _matches(ev)]
+            instants = [ev for ev in instants if _matches(ev)]
         if limit is not None:
             evs = evs[-limit:] if limit > 0 else []  # evs[-0:] is ALL
+            instants = instants[-limit:] if limit > 0 else []
         pid = os.getpid()
         trace: list[dict] = [{
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
@@ -797,6 +893,17 @@ class Tracer:
                 "dur": max(1, int(ev["seconds"] * 1e6)),
                 "pid": pid, "tid": ev["tid"], "args": args,
             })
+        for ev in instants:
+            # black-box events carry wall time; place them on the span
+            # timeline via the tracer's own wall/perf epoch pair
+            args = {k: v for k, v in ev.items() if k not in ("kind", "t")}
+            trace.append({
+                "name": ev.get("kind", "event"), "cat": "blackbox",
+                "ph": "i", "s": "p",
+                "ts": max(0, int((ev.get("t", self._epoch)
+                                  - self._epoch) * 1e6)),
+                "pid": pid, "tid": 0, "args": args,
+            })
         return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
     def reset(self) -> None:
@@ -813,6 +920,7 @@ class Tracer:
             self._sagg.clear()
             self._sgauges.clear()
             self._open.clear()
+            self._session_traces.clear()
 
     # -------------------------------------------------------- XLA profile
 

@@ -43,6 +43,25 @@ const API = {
         (limit ? "limit=" + limit : "") +
         (limit && session ? "&" : "") +
         (session ? "session=" + session : "")),
+  // causal telemetry (docs/metrics.md "History & correlation"): the
+  // columnar metrics history ring — pass since (absolute ring index
+  // cursor from a prior response's nextIndex), stride to downsample,
+  // series (comma-joined names or bare prefixes like "slo.p99"), and
+  // session to filter the labeled columns — and the Perfetto export of
+  // one request's causal slice by its X-KSS-Trace-Id
+  getHistory: (opts) => {
+    const o = opts || {};
+    const q = [
+      o.series ? "series=" + [].concat(o.series).join(",") : "",
+      o.since != null ? "since=" + o.since : "",
+      o.stride ? "stride=" + o.stride : "",
+      o.session ? "session=" + o.session : "",
+    ].filter(Boolean).join("&");
+    return api("GET", "/api/v1/history" + (q ? "?" + q : ""));
+  },
+  getTraceById: (traceId, limit) =>
+    api("GET", "/api/v1/trace?trace_id=" + encodeURIComponent(traceId) +
+        (limit ? "&limit=" + limit : "")),
   // wave black box (docs/metrics.md post-mortem dumps): a live bundle
   // plus metadata of recently stored dumps
   getDebugDump: (session) =>

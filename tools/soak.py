@@ -47,6 +47,13 @@ os.environ["KSS_TPU_AUTOPILOT_SLO_TARGET_P99_S"] = "0.25"
 os.environ["KSS_TPU_AUTOPILOT_SHED_QOS"] = "best-effort"
 os.environ["KSS_TPU_SLO_WINDOW"] = "16"
 os.environ["KSS_TPU_DEGRADE_PROBE_WAVES"] = "3"
+# the telemetry-history ring must be ON (an inherited KSS_TPU_HISTORY=0
+# would make the causal-reconstruction assertions vacuous) and deep
+# enough that a ~0.1s-tick soak never scrolls the breach era away: the
+# autopilot tick itself feeds the ring (control/autopilot.py pulls its
+# evidence through FEEDER.sample), one row per tick
+os.environ["KSS_TPU_HISTORY"] = "1"
+os.environ["KSS_TPU_HISTORY_CAPACITY"] = "4096"
 
 SLO_TARGET_S = 0.25
 STD, BE, DEG = "soak-std", "soak-be", "soak-deg"
@@ -338,9 +345,21 @@ def run_soak(ticks: int = 18) -> dict:
         if not shed_lifted:
             failures.append("shed never lifted after the overload stopped")
         else:
-            code, _h, _b = _req(
-                port, "POST", f"/api/v1/sessions/{BE}/pods",
-                _pods(1, seed=999, prefix="soak-after")[0])
+            # the probe wave just recorded into a window still full of
+            # breach-era percentiles, so on a slow box the controller
+            # may CORRECTLY re-shed for one more quiesce/recover
+            # cycle; post-recovery health means submissions are
+            # accepted again within a bounded horizon, not that the
+            # very next request wins a race against the closing gate
+            code = None
+            for t in range(6 * window):
+                code, _h, _b = _req(
+                    port, "POST", f"/api/v1/sessions/{BE}/pods",
+                    _pods(1, seed=999 + t,
+                          prefix=f"soak-after-{t}")[0])
+                if code != 429:
+                    break
+                time.sleep(0.05)
             if code != 201:
                 failures.append(f"post-recovery submit -> {code}")
 
@@ -363,6 +382,82 @@ def run_soak(ticks: int = 18) -> dict:
             failures.append(f"autopilot tripped its fail-safe "
                             f"{ap['failsafes']}x during a clean soak")
 
+        # ---- causal reconstruction from the history ring ----------
+        # the whole breach -> shed -> recovery arc must be readable
+        # back out of the columnar ring (docs/metrics.md "History &
+        # correlation"), and every shed decision's recorded evidence
+        # must match the ring AT ITS INDEX — provenance, not vibes
+        from kube_scheduler_simulator_tpu.utils.history import HISTORY
+        win = HISTORY.window(series=["slo.p99", "autopilot.shed"],
+                             session=BE, since=0)
+        p99_col = win["series"].get(f"slo.p99{{session={BE}}}") or []
+        shed_col = (win["series"].get(f"autopilot.shed{{session={BE}}}")
+                    or [])
+        hist_rows = len(win["index"])
+        first_shed = next(
+            (i for i, v in enumerate(shed_col) if v == 1.0), None)
+        breach_before_shed = first_shed is not None and any(
+            v is not None and v > SLO_TARGET_S
+            for v in p99_col[:first_shed + 1])
+        shed_lift_in_ring = first_shed is not None and any(
+            v == 0.0 for v in shed_col[first_shed:])
+        if first_shed is None:
+            failures.append("history ring never recorded the "
+                            "best-effort shed (autopilot.shed == 1)")
+        else:
+            if not breach_before_shed:
+                failures.append(
+                    "history ring shows no p99 breach at or before "
+                    "the first shed sample — the causal order "
+                    "breach -> shed is not reconstructible")
+            if not shed_lift_in_ring:
+                failures.append("history ring never recorded the shed "
+                                "lifting (autopilot.shed back to 0)")
+
+        evidence_checked = 0
+        for d in (ap.get("lastDecisions") or {}).get(BE) or []:
+            if d.get("effector") != "shed":
+                continue
+            evd = d.get("evidence") or {}
+            idx = evd.get("historyIndex")
+            if not isinstance(idx, int):
+                failures.append("shed decision carries no historyIndex: "
+                                f"{d.get('reason')}")
+                continue
+            ring_p99 = HISTORY.value(f"slo.p99{{session={BE}}}", idx)
+            ev_p99 = evd.get("p99WaveSeconds")
+            if (ring_p99 is None) != (ev_p99 is None) or (
+                    ring_p99 is not None
+                    and abs(ring_p99 - ev_p99) > 1e-9):
+                failures.append(
+                    f"shed evidence p99 {ev_p99} != ring row {idx} "
+                    f"value {ring_p99} — provenance broken")
+            # the row was sampled BEFORE the decision applied, so it
+            # must show the pre-transition shed state
+            ring_shed = HISTORY.value(
+                f"autopilot.shed{{session={BE}}}", idx)
+            want = 0.0 if d.get("to") == "shedding" else 1.0
+            if ring_shed != want:
+                failures.append(
+                    f"ring row {idx} shed flag {ring_shed} != "
+                    f"pre-decision state {want} ({d.get('from')} -> "
+                    f"{d.get('to')})")
+            if d.get("to") == "open":
+                # the lift rule: back inside the 0.8x recovery band,
+                # or quiesced (no fresh waves — frozen window carries
+                # no evidence of ongoing breach)
+                if not (ev_p99 is None
+                        or ev_p99 <= 0.8 * SLO_TARGET_S
+                        or int(evd.get("freshWaves") or 0) <= 0):
+                    failures.append(
+                        f"shed lifted outside the recovery band: p99 "
+                        f"{ev_p99} with {evd.get('freshWaves')} fresh "
+                        f"waves")
+            evidence_checked += 1
+        if evidence_checked == 0:
+            failures.append("no shed decision evidence to check "
+                            "against the ring (vacuous provenance)")
+
         doc, _path = BLACKBOX.dump("soak", write=False)
         try:
             validate_dump(doc)
@@ -382,6 +477,10 @@ def run_soak(ticks: int = 18) -> dict:
         "shed_responses": shed_responses,
         "shed_lifted": shed_lifted,
         "slo_target_p99_s": SLO_TARGET_S,
+        "history_rows": hist_rows,
+        "history_breach_before_shed": breach_before_shed,
+        "history_shed_lift_recorded": shed_lift_in_ring,
+        "shed_evidence_checked": evidence_checked,
         "ticks": ticks,
         "overload_batch": batch,
         "sessions_churned": churned,
